@@ -1,0 +1,217 @@
+"""pathway_tpu — a TPU-native incremental dataflow & RAG framework.
+
+A from-scratch re-design of the capabilities of the reference Pathway framework
+(/root/reference): declarative `Table` API over live data, incremental
+microbatch engine, connectors, temporal/indexing/ML stdlib, and an LLM xpack —
+with the compute-heavy paths (embedders, KNN indexes, rerankers, numeric
+kernels) running on TPU via jax/XLA/Pallas and scaling over device meshes via
+`jax.sharding` instead of worker processes.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu.reducers as reducers
+from pathway_tpu import debug, demo, io, udfs
+from pathway_tpu.internals import (
+    UDF,
+    ColumnExpression,
+    ColumnReference,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    GroupedJoinResult,
+    GroupedTable,
+    Joinable,
+    JoinMode,
+    JoinResult,
+    Json,
+    MonitoringLevel,
+    PathwayType as Type,
+    PersistenceMode,
+    Pointer,
+    PyObjectWrapper,
+    Schema,
+    SchemaProperties,
+    Table,
+    TableLike,
+    __version__,
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_schema,
+    cast,
+    coalesce,
+    column_definition,
+    declare_type,
+    fill_error,
+    global_error_log,
+    groupby,
+    if_else,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+    left,
+    local_error_log,
+    make_tuple,
+    require,
+    right,
+    run,
+    run_all,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_types,
+    this,
+    udf,
+    unwrap,
+    wrap_py_object,
+)
+from pathway_tpu.internals.custom_reducers import BaseCustomAccumulator
+from pathway_tpu.internals.iterate import iterate, iterate_universe
+from pathway_tpu.internals.yaml_loader import load_yaml
+import pathway_tpu.persistence as persistence
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.internals.sql import sql
+
+
+def __getattr__(name: str):
+    # stdlib subpackages load lazily so the core import stays light and
+    # avoids circular imports (xpacks -> internals -> stdlib)
+    import importlib
+
+    if name in (
+        "graphs",
+        "indexing",
+        "ml",
+        "ordered",
+        "stateful",
+        "statistical",
+        "temporal",
+        "utils",
+        "xpacks",
+    ):
+        module = importlib.import_module(f"pathway_tpu.stdlib.{name}") if name != "xpacks" else importlib.import_module("pathway_tpu.xpacks")
+        globals()[name] = module
+        return module
+    raise AttributeError(name)
+
+
+def set_license_key(key: str | None) -> None:
+    """No-op: this framework has no license gating (reference:
+    src/engine/license.rs — intentionally not reproduced)."""
+
+
+def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
+    from pathway_tpu.internals import config
+
+    config.pathway_config.monitoring_server = server_endpoint
+
+
+def enable_interactive_mode() -> None:
+    pass
+
+
+class TableSlice:
+    pass
+
+
+class LiveTable:
+    pass
+
+
+def table_transformer(*args, **kwargs):
+    """Decorator marking a function as a table→table transformer
+    (reference: internals/table_transformer.py). Pass-through."""
+
+    def wrap(fn):
+        return fn
+
+    if args and callable(args[0]):
+        return args[0]
+    return wrap
+
+
+__all__ = [
+    "__version__",
+    "udfs",
+    "graphs",
+    "utils",
+    "debug",
+    "demo",
+    "indexing",
+    "ml",
+    "apply",
+    "udf",
+    "UDF",
+    "apply_async",
+    "apply_with_type",
+    "declare_type",
+    "cast",
+    "GroupedTable",
+    "GroupedJoinResult",
+    "iterate",
+    "iterate_universe",
+    "JoinResult",
+    "JoinMode",
+    "AsyncTransformer",
+    "reducers",
+    "schema_from_types",
+    "schema_from_dict",
+    "schema_from_csv",
+    "schema_builder",
+    "column_definition",
+    "Table",
+    "TableLike",
+    "TableSlice",
+    "ColumnReference",
+    "ColumnExpression",
+    "Schema",
+    "SchemaProperties",
+    "Pointer",
+    "PyObjectWrapper",
+    "wrap_py_object",
+    "MonitoringLevel",
+    "this",
+    "left",
+    "right",
+    "Joinable",
+    "coalesce",
+    "require",
+    "sql",
+    "run",
+    "run_all",
+    "if_else",
+    "make_tuple",
+    "unwrap",
+    "fill_error",
+    "assert_table_has_schema",
+    "Type",
+    "io",
+    "temporal",
+    "statistical",
+    "stateful",
+    "ordered",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "Json",
+    "BaseCustomAccumulator",
+    "PersistenceMode",
+    "persistence",
+    "join",
+    "join_inner",
+    "join_left",
+    "join_right",
+    "join_outer",
+    "groupby",
+    "set_license_key",
+    "set_monitoring_config",
+    "global_error_log",
+    "local_error_log",
+    "load_yaml",
+    "enable_interactive_mode",
+    "LiveTable",
+    "table_transformer",
+]

@@ -1,0 +1,413 @@
+"""pw.debug — static fixtures & capture-based output
+(reference: python/pathway/debug/__init__.py:207-709). The main unit-test
+harness: markdown tables in, captured diff streams out."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import InputNode, OutputNode
+from pathway_tpu.engine.runtime import Runtime, StaticSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.api import Pointer, ref_scalar, sequential_key
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+class _RowsSource(StaticSource):
+    def __init__(self, column_names, events):
+        super().__init__(column_names)
+        self._events = events  # list[(time, rows)]
+
+    def events(self):
+        for t, rows in self._events:
+            yield t, DiffBatch.from_rows(rows, self.column_names)
+
+
+def _parse_value(s: str) -> Any:
+    s = s.strip()
+    if s == "":
+        return ""
+    if s in ("None", "null"):
+        return None
+    if s == "True" or s == "true":
+        return True
+    if s == "False" or s == "false":
+        return False
+    if (s.startswith('"') and s.endswith('"')) or (
+        s.startswith("'") and s.endswith("'")
+    ):
+        return s[1:-1]
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.startswith("(") or s.startswith("["):
+        import ast
+
+        try:
+            v = ast.literal_eval(s)
+            if isinstance(v, list):
+                return tuple(v)
+            return v
+        except (ValueError, SyntaxError):
+            pass
+    return s
+
+
+def _dtype_for(values: list[Any]) -> dt.DType:
+    non_null = [v for v in values if v is not None]
+    if not non_null:
+        return dt.ANY
+    types = {type(v) for v in non_null}
+    if types <= {bool}:
+        out: dt.DType = dt.BOOL
+    elif types <= {int, bool}:
+        out = dt.INT
+    elif types <= {int, float, bool}:
+        out = dt.FLOAT
+    elif types <= {str}:
+        out = dt.STR
+    elif types <= {tuple}:
+        out = dt.ANY_TUPLE
+    else:
+        out = dt.ANY
+    if len(non_null) != len(values):
+        out = dt.Optional_(out)
+    return out
+
+
+def table_from_markdown(
+    table_def: str,
+    id_from: Sequence[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+    schema: Any = None,
+    _stream: bool = False,
+) -> Table:
+    """Parse a markdown / whitespace table. Special columns: ``__time__``
+    (logical time), ``__diff__`` (+1/-1)."""
+    lines = [l for l in table_def.strip().splitlines() if l.strip()]
+    # drop markdown separator rows like |---|---|
+    lines = [
+        l
+        for l in lines
+        if not re.fullmatch(r"[\s|:+-]+", l)
+    ]
+    if "|" in lines[0]:
+        split = [
+            [c.strip() for c in re.split(r"(?<!\\)\|", l)] for l in lines
+        ]
+        # "| a | b |" style: every row starts/ends with an empty cell
+        if all(r and r[0] == "" for r in split):
+            split = [r[1:] for r in split]
+        if all(r and r[-1] == "" for r in split):
+            split = [r[:-1] for r in split]
+        header = split[0]
+        data = split[1:]
+        # leading unnamed column = explicit row ids (reference style:
+        # "  | a | __time__" header with "9 | 0 | 2" rows)
+        has_id_col = header[0] in ("", "id")
+        if has_id_col:
+            header = header[1:]
+            ids = [r[0] for r in data]
+            data = [r[1:] for r in data]
+        else:
+            ids = None
+    else:
+        header = lines[0].split()
+        data = [l.split() for l in lines[1:]]
+        has_id_col = header[0] == "id"
+        if has_id_col:
+            header = header[1:]
+            ids = [r[0] for r in data]
+            data = [r[1:] for r in data]
+        else:
+            ids = None
+    col_names = [h for h in header if h not in ("__time__", "__diff__")]
+    time_idx = header.index("__time__") if "__time__" in header else None
+    diff_idx = header.index("__diff__") if "__diff__" in header else None
+    if id_from is None and schema is not None:
+        id_from = schema.primary_key_columns()
+
+    events: dict[int, list] = {}
+    counter = 0
+    value_cols_idx = [
+        i for i, h in enumerate(header) if h not in ("__time__", "__diff__")
+    ]
+    col_values: dict[str, list] = {n: [] for n in col_names}
+    for ri, row in enumerate(data):
+        parsed = [_parse_value(c) for c in row]
+        t = int(parsed[time_idx]) if time_idx is not None else 0
+        d = int(parsed[diff_idx]) if diff_idx is not None else 1
+        vals = tuple(parsed[i] for i in value_cols_idx)
+        if ids is not None:
+            key = int(ref_scalar(ids[ri]))
+        elif id_from:
+            key = int(
+                ref_scalar(*[vals[col_names.index(c)] for c in id_from])
+            )
+        else:
+            key = int(sequential_key(counter))
+        counter += 1
+        for n, v in zip(col_names, vals):
+            col_values[n].append(v)
+        events.setdefault(t, []).append((key, d, vals))
+
+    if schema is not None:
+        dtypes = {n: schema.dtypes()[n] for n in col_names}
+    else:
+        dtypes = {n: _dtype_for(col_values[n]) for n in col_names}
+    source = _RowsSource(col_names, sorted(events.items()))
+    node = InputNode(source, col_names)
+    return Table._from_node(node, dtypes, Universe())
+
+
+# reference test harness name
+def T(table_def: str, **kwargs) -> Table:
+    return table_from_markdown(table_def, **kwargs)
+
+
+def table_from_rows(
+    schema: Any,
+    rows: Iterable[tuple],
+    unsafe_trusted_ids: bool = False,
+    is_stream: bool = False,
+) -> Table:
+    col_names = list(schema.column_names())
+    events: dict[int, list] = {}
+    for i, row in enumerate(rows):
+        if is_stream:
+            *vals, t, d = row
+        else:
+            vals, t, d = list(row), 0, 1
+        pk = schema.primary_key_columns()
+        if pk:
+            key = int(ref_scalar(*[vals[col_names.index(c)] for c in pk]))
+        else:
+            key = int(sequential_key(i))
+        events.setdefault(int(t), []).append((key, int(d), tuple(vals)))
+    source = _RowsSource(col_names, sorted(events.items()))
+    node = InputNode(source, col_names)
+    return Table._from_node(node, dict(schema.dtypes()), Universe())
+
+
+def table_from_pandas(
+    df: Any,
+    id_from: Sequence[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+    schema: Any = None,
+) -> Table:
+    col_names = [c for c in df.columns if c not in ("__time__", "__diff__")]
+    events: dict[int, list] = {}
+    for i, (idx, row) in enumerate(df.iterrows()):
+        t = int(row["__time__"]) if "__time__" in df.columns else 0
+        d = int(row["__diff__"]) if "__diff__" in df.columns else 1
+        vals = tuple(_np_unbox(row[c]) for c in col_names)
+        if id_from:
+            key = int(ref_scalar(*[vals[col_names.index(c)] for c in id_from]))
+        else:
+            key = int(sequential_key(i))
+        events.setdefault(t, []).append((key, d, vals))
+    if schema is not None:
+        dtypes = {n: schema.dtypes()[n] for n in col_names}
+    else:
+        dtypes = {
+            n: _dtype_for([e[2][i] for evs in events.values() for e in evs])
+            for i, n in enumerate(col_names)
+        }
+    source = _RowsSource(col_names, sorted(events.items()))
+    node = InputNode(source, col_names)
+    return Table._from_node(node, dtypes, Universe())
+
+
+def _np_unbox(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+# ---------------------------------------------------------------------------
+# capture / output
+
+
+class _Capture:
+    def __init__(self, table: Table):
+        self.table = table
+        self.rows: dict[int, tuple] = {}
+        self.updates: list[tuple[int, int, int, tuple]] = []  # (time,key,diff,vals)
+
+    def on_batch(self, t: int, batch: DiffBatch) -> None:
+        for k, d, vals in batch.iter_rows():
+            self.updates.append((t, k, d, vals))
+            if d > 0:
+                self.rows[k] = vals
+            else:
+                self.rows.pop(k, None)
+
+
+def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
+    captures = []
+    outputs = []
+    for tbl in tables:
+        cap = _Capture(tbl)
+        captures.append(cap)
+        outputs.append(OutputNode(tbl._node, cap.on_batch))
+    Runtime(outputs).run()
+    return captures
+
+
+def table_to_dicts(table: Table):
+    cap = _run_capture([table])[0]
+    col_names = table.column_names()
+    keys = list(cap.rows.keys())
+    columns = {
+        n: {k: cap.rows[k][i] for k in keys} for i, n in enumerate(col_names)
+    }
+    return keys, columns
+
+
+def table_to_pandas(table: Table, include_id: bool = True):
+    import pandas as pd
+
+    cap = _run_capture([table])[0]
+    col_names = table.column_names()
+    data = {n: [] for n in col_names}
+    index = []
+    for k, vals in cap.rows.items():
+        index.append(Pointer(k))
+        for n, v in zip(col_names, vals):
+            data[n].append(v)
+    if include_id:
+        return pd.DataFrame(data, index=index)
+    return pd.DataFrame(data)
+
+
+def _fmt_value(v: Any) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, np.generic):
+        v = v.item()
+    return repr(v) if not isinstance(v, (int, float, bool, Pointer)) else str(v)
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    squash_updates: bool = True,
+    terminate_on_error: bool = True,
+) -> None:
+    cap = _run_capture([table])[0]
+    col_names = table.column_names()
+    rows = sorted(cap.rows.items(), key=lambda kv: kv[0])
+    if n_rows is not None:
+        rows = rows[:n_rows]
+    header = ([""] if include_id else []) + col_names
+    out_rows = []
+    for k, vals in rows:
+        key_s = str(Pointer(k))
+        if short_pointers:
+            key_s = key_s[:12] + "..."
+        out_rows.append(
+            ([key_s] if include_id else []) + [_fmt_value(v) for v in vals]
+        )
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in out_rows)) if out_rows else len(header[i])
+        for i in range(len(header))
+    ]
+    print(
+        " | ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip()
+    )
+    for r in out_rows:
+        print(" | ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def compute_and_print_update_stream(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    **kwargs,
+) -> None:
+    cap = _run_capture([table])[0]
+    col_names = table.column_names()
+    header = ([""] if include_id else []) + col_names + ["__time__", "__diff__"]
+    print(" | ".join(header))
+    for t, k, d, vals in cap.updates[: n_rows if n_rows else None]:
+        key_s = str(Pointer(k))
+        if short_pointers:
+            key_s = key_s[:12] + "..."
+        cells = ([key_s] if include_id else []) + [
+            _fmt_value(v) for v in vals
+        ] + [str(t), str(d)]
+        print(" | ".join(cells))
+
+
+# ---------------------------------------------------------------------------
+# equality assertions (harness used by our test-suite, modeled on the
+# reference tests/utils.py assert_table_equality)
+
+
+def _canon(vals: tuple) -> tuple:
+    out = []
+    for v in vals:
+        if isinstance(v, np.ndarray):
+            out.append(("__ndarray__", v.tobytes(), str(v.dtype), v.shape))
+        elif isinstance(v, float) and float(v).is_integer():
+            out.append(v)
+        elif isinstance(v, np.generic):
+            out.append(v.item())
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def assert_table_equality(t1: Table, t2: Table, **kwargs) -> None:
+    caps = _run_capture([t1, t2])
+    rows1 = {Pointer(k): _canon(v) for k, v in caps[0].rows.items()}
+    rows2 = {Pointer(k): _canon(v) for k, v in caps[1].rows.items()}
+    c1, c2 = t1.column_names(), t2.column_names()
+    assert c1 == c2, f"column mismatch: {c1} vs {c2}"
+    assert rows1 == rows2, (
+        f"tables differ:\n  left:  {_show(rows1)}\n  right: {_show(rows2)}"
+    )
+
+
+def assert_table_equality_wo_index(t1: Table, t2: Table, **kwargs) -> None:
+    caps = _run_capture([t1, t2])
+    rows1 = sorted(
+        (_canon(v) for v in caps[0].rows.values()), key=repr
+    )
+    rows2 = sorted(
+        (_canon(v) for v in caps[1].rows.values()), key=repr
+    )
+    c1, c2 = t1.column_names(), t2.column_names()
+    assert c1 == c2, f"column mismatch: {c1} vs {c2}"
+    assert rows1 == rows2, (
+        f"tables differ (wo index):\n  left:  {rows1}\n  right: {rows2}"
+    )
+
+
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def _show(rows: Mapping) -> str:
+    items = sorted(rows.items(), key=lambda kv: str(kv[0]))
+    return "{" + ", ".join(f"{k}: {v}" for k, v in items[:20]) + (
+        ", ..." if len(items) > 20 else ""
+    ) + "}"

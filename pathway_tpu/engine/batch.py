@@ -1,0 +1,263 @@
+"""Columnar diff batches — the unit of dataflow in the TPU microbatch engine.
+
+TPU-native re-design of the reference's rowwise `Collection<S, (Key, Value)>`
+streams (reference: src/engine/dataflow.rs:174-186 `Values`, :526 `Table`):
+instead of boxed row tuples flowing through timely channels, each logical tick
+moves a struct-of-arrays batch (uint64 key column + typed value columns +
+int64 diff weights). Numeric columns are dense numpy arrays that map directly
+onto device buffers; strings/json stay host-side object arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Mapping, Sequence
+
+import numpy as np
+
+END_OF_TIME = 1 << 62
+
+
+def make_column(values: Sequence[Any], np_dtype: Any = None) -> np.ndarray:
+    """Build a column array; object dtype is element-safe for tuples/arrays."""
+    if isinstance(values, np.ndarray) and np_dtype is None:
+        return values
+    if np_dtype is None or np.dtype(np_dtype) == np.dtype(object):
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    try:
+        return np.asarray(values, dtype=np_dtype)
+    except (ValueError, TypeError, OverflowError):
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+
+
+class DiffBatch:
+    """keys: uint64[n]; diffs: int64[n] (+1 insert / -1 retract);
+    columns: name -> array[n]."""
+
+    __slots__ = ("keys", "diffs", "columns")
+
+    def __init__(
+        self,
+        keys: np.ndarray,
+        diffs: np.ndarray,
+        columns: Mapping[str, np.ndarray],
+    ):
+        self.keys = np.asarray(keys, dtype=np.uint64)
+        self.diffs = np.asarray(diffs, dtype=np.int64)
+        self.columns = dict(columns)
+
+    # --- constructors ---------------------------------------------------------
+
+    @staticmethod
+    def empty(column_names: Iterable[str]) -> "DiffBatch":
+        return DiffBatch(
+            np.empty(0, dtype=np.uint64),
+            np.empty(0, dtype=np.int64),
+            {name: np.empty(0, dtype=object) for name in column_names},
+        )
+
+    @staticmethod
+    def from_rows(
+        rows: Sequence[tuple[int, int, tuple]],
+        column_names: Sequence[str],
+    ) -> "DiffBatch":
+        """rows: (key, diff, values-tuple)"""
+        n = len(rows)
+        keys = np.empty(n, dtype=np.uint64)
+        diffs = np.empty(n, dtype=np.int64)
+        cols = [np.empty(n, dtype=object) for _ in column_names]
+        for i, (k, d, vals) in enumerate(rows):
+            keys[i] = k
+            diffs[i] = d
+            for j, v in enumerate(vals):
+                cols[j][i] = v
+        return DiffBatch(keys, diffs, dict(zip(column_names, cols)))
+
+    # --- basics ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self.columns.keys())
+
+    def row_values(self, i: int) -> tuple:
+        return tuple(col[i] for col in self.columns.values())
+
+    def iter_rows(self) -> Iterator[tuple[int, int, tuple]]:
+        cols = list(self.columns.values())
+        for i in range(len(self.keys)):
+            yield int(self.keys[i]), int(self.diffs[i]), tuple(c[i] for c in cols)
+
+    def mask(self, m: np.ndarray) -> "DiffBatch":
+        return DiffBatch(
+            self.keys[m],
+            self.diffs[m],
+            {name: col[m] for name, col in self.columns.items()},
+        )
+
+    def take(self, idx: np.ndarray) -> "DiffBatch":
+        return DiffBatch(
+            self.keys[idx],
+            self.diffs[idx],
+            {name: col[idx] for name, col in self.columns.items()},
+        )
+
+    def with_columns(self, columns: Mapping[str, np.ndarray]) -> "DiffBatch":
+        return DiffBatch(self.keys, self.diffs, columns)
+
+    def rename(self, mapping: Mapping[str, str]) -> "DiffBatch":
+        return DiffBatch(
+            self.keys,
+            self.diffs,
+            {mapping.get(name, name): col for name, col in self.columns.items()},
+        )
+
+    def select_columns(self, names: Sequence[str]) -> "DiffBatch":
+        return DiffBatch(self.keys, self.diffs, {n: self.columns[n] for n in names})
+
+    @staticmethod
+    def concat(batches: Sequence["DiffBatch"]) -> "DiffBatch":
+        batches = [b for b in batches if len(b)]
+        if not batches:
+            return DiffBatch.empty([])
+        if len(batches) == 1:
+            return batches[0]
+        names = batches[0].column_names
+        return DiffBatch(
+            np.concatenate([b.keys for b in batches]),
+            np.concatenate([b.diffs for b in batches]),
+            {
+                n: np.concatenate([_as_obj_safe(b.columns[n]) for b in batches])
+                for n in names
+            },
+        )
+
+    def consolidate(self) -> "DiffBatch":
+        """Merge rows with equal (key, values), summing diffs; drop zeros.
+        (reference analog: differential `consolidate`)."""
+        if len(self) <= 1:
+            if len(self) == 1 and self.diffs[0] == 0:
+                return self.mask(np.zeros(1, dtype=bool))
+            return self
+        acc: dict[int, list] = {}
+        order: list[int] = []
+        cols = list(self.columns.values())
+        for i in range(len(self.keys)):
+            k = int(self.keys[i])
+            entry = acc.get(k)
+            vals = tuple(c[i] for c in cols)
+            if entry is None:
+                acc[k] = [vals, int(self.diffs[i]), i]
+                order.append(k)
+            else:
+                if _values_eq(entry[0], vals):
+                    entry[1] += int(self.diffs[i])
+                else:
+                    # same key, different values (update in one tick):
+                    # keep as separate physical rows
+                    acc[(k, i)] = [vals, int(self.diffs[i]), i]  # type: ignore[index]
+                    order.append((k, i))  # type: ignore[arg-type]
+        keep = [e[2] for key in order for e in [acc[key]] if e[1] != 0]
+        diffs_new = [acc[key][1] for key in order if acc[key][1] != 0]
+        idx = np.asarray(keep, dtype=np.int64)
+        out = self.take(idx)
+        out.diffs = np.asarray(diffs_new, dtype=np.int64)
+        return out
+
+
+def _as_obj_safe(col: np.ndarray) -> np.ndarray:
+    return col
+
+
+def _values_eq(a: tuple, b: tuple) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            if not (
+                isinstance(x, np.ndarray)
+                and isinstance(y, np.ndarray)
+                and x.shape == y.shape
+                and bool(np.all(x == y))
+            ):
+                return False
+        else:
+            try:
+                if not (x == y or (x is None and y is None)):
+                    return False
+            except (ValueError, TypeError):
+                if x is not y:
+                    return False
+    return True
+
+
+class TableState:
+    """Materialized current state of a stream: key -> row values tuple.
+
+    The engine analog of a differential arrangement
+    (reference: external/differential-dataflow arrangements) reduced to the
+    totally-ordered microbatch setting: state is only ever the *current*
+    consolidated frontier."""
+
+    __slots__ = ("column_names", "rows")
+
+    def __init__(self, column_names: Sequence[str]):
+        self.column_names = list(column_names)
+        self.rows: dict[int, tuple] = {}
+
+    def apply(self, batch: DiffBatch) -> None:
+        for k, d, vals in batch.iter_rows():
+            if d > 0:
+                self.rows[k] = vals
+            elif d < 0:
+                self.rows.pop(k, None)
+
+    def snapshot_batch(self) -> DiffBatch:
+        rows = [(k, 1, v) for k, v in self.rows.items()]
+        return DiffBatch.from_rows(rows, self.column_names)
+
+    def get(self, key: int) -> tuple | None:
+        return self.rows.get(key)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+class MultisetState:
+    """key -> (values, count) — supports multiplicity >1 (after non-injective
+    reindex) and clean retraction."""
+
+    __slots__ = ("column_names", "rows")
+
+    def __init__(self, column_names: Sequence[str]):
+        self.column_names = list(column_names)
+        self.rows: dict[int, list] = {}  # key -> [values, count]
+
+    def apply_row(self, k: int, d: int, vals: tuple) -> None:
+        entry = self.rows.get(k)
+        if entry is None:
+            if d != 0:
+                self.rows[k] = [vals, d]
+        else:
+            entry[1] += d
+            entry[0] = vals if d > 0 else entry[0]
+            if entry[1] == 0:
+                del self.rows[k]
+
+    def apply(self, batch: DiffBatch) -> None:
+        for k, d, vals in batch.iter_rows():
+            self.apply_row(k, d, vals)
+
+    def get(self, key: int) -> tuple | None:
+        e = self.rows.get(key)
+        return e[0] if e else None
+
+    def __len__(self) -> int:
+        return len(self.rows)

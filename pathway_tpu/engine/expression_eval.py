@@ -1,0 +1,536 @@
+"""Columnar expression evaluation.
+
+TPU-native counterpart of the reference's row-at-a-time interpreter
+(/root/reference/src/engine/expression.rs): expressions are evaluated over
+whole column batches. Numeric columns run vectorized (numpy on host for small
+ticks; large dense numeric work is dispatched through pathway_tpu.ops which
+routes to jax/XLA); object columns (str/json/tuple) run elementwise.
+
+`IfElse` evaluates branches only on the selected row subsets, matching the
+reference's lazy per-row branch semantics. Runtime errors inside expressions
+become `ERROR` poison values instead of crashing the graph
+(reference: src/engine/error.rs Value::Error).
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr
+from pathway_tpu.internals.api import ERROR, Error, Pointer, ref_scalar
+from pathway_tpu.internals.json import Json
+from pathway_tpu.engine.batch import make_column
+
+
+class InternalColRef(expr.ColumnExpression):
+    """Resolved column reference: (input index, column name). 'id' = keys."""
+
+    def __init__(self, input_index: int, name: str):
+        self._input_index = input_index
+        self._name = name
+
+    def __repr__(self):
+        return f"${self._input_index}.{self._name}"
+
+
+class EvalContext:
+    """Aligned row-batch over one or more same-universe inputs."""
+
+    def __init__(self, keys: np.ndarray, column_sets: Sequence[dict[str, np.ndarray]]):
+        self.keys = keys
+        self.column_sets = list(column_sets)
+        self._id_cache: np.ndarray | None = None
+
+    @property
+    def n(self) -> int:
+        return len(self.keys)
+
+    def id_column(self) -> np.ndarray:
+        if self._id_cache is None:
+            out = np.empty(len(self.keys), dtype=object)
+            for i, k in enumerate(self.keys):
+                out[i] = Pointer(int(k))
+            self._id_cache = out
+        return self._id_cache
+
+    def fetch(self, ref: InternalColRef) -> np.ndarray:
+        if ref._name == "id":
+            return self.id_column()
+        return self.column_sets[ref._input_index][ref._name]
+
+
+def _is_numeric(a: np.ndarray) -> bool:
+    return a.dtype.kind in "bifu"
+
+
+def _full(n: int, value: Any) -> np.ndarray:
+    if isinstance(value, bool):
+        return np.full(n, value, dtype=bool)
+    if isinstance(value, int) and not isinstance(value, Pointer):
+        if -(2**63) <= value < 2**63:
+            return np.full(n, value, dtype=np.int64)
+    if isinstance(value, float):
+        return np.full(n, value, dtype=np.float64)
+    out = np.empty(n, dtype=object)
+    out[:] = [value] * n
+    return out
+
+
+def _elementwise(fn: Callable, *arrays: np.ndarray) -> np.ndarray:
+    n = len(arrays[0])
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        args = [a[i] for a in arrays]
+        if any(isinstance(a, Error) for a in args):
+            out[i] = ERROR
+            continue
+        try:
+            out[i] = fn(*args)
+        except Exception:
+            out[i] = ERROR
+    return out
+
+
+def _tighten(out: np.ndarray) -> np.ndarray:
+    """Convert an object array to a typed one when ALL elements agree."""
+    if out.dtype != object or len(out) == 0:
+        return out
+    all_bool = True
+    all_int = True
+    all_float = True
+    for v in out:
+        if not isinstance(v, (bool, np.bool_)):
+            all_bool = False
+        if (
+            isinstance(v, (bool, np.bool_, Pointer))
+            or not isinstance(v, (int, np.integer))
+        ):
+            all_int = False
+        if isinstance(v, (bool, np.bool_)) or not isinstance(
+            v, (int, float, np.integer, np.floating)
+        ):
+            all_float = False
+        if not (all_bool or all_int or all_float):
+            return out
+    try:
+        if all_bool:
+            return out.astype(bool)
+        if all_int:
+            return out.astype(np.int64)
+        if all_float:
+            return out.astype(np.float64)
+    except (ValueError, TypeError, OverflowError):
+        return out
+    return out
+
+
+_CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
+_BOOL_OPS = {"&", "|", "^"}
+
+
+def _binary(op: str, left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    lnum, rnum = _is_numeric(left), _is_numeric(right)
+    if lnum and rnum:
+        with np.errstate(all="ignore"):
+            if op == "/":
+                l = left.astype(np.float64)
+                r = right.astype(np.float64)
+                bad = right == 0
+                if bad.any():
+                    res = np.where(bad, np.nan, np.divide(l, np.where(bad, 1, r)))
+                    out = res.astype(object)
+                    out[np.asarray(bad)] = ERROR
+                    return out
+                return np.divide(l, r)
+            if op in ("//", "%"):
+                bad = right == 0
+                fn = np.floor_divide if op == "//" else np.mod
+                if bad.any():
+                    res = fn(left, np.where(bad, 1, right))
+                    out = res.astype(object)
+                    out[np.asarray(bad)] = ERROR
+                    return out
+                return fn(left, right)
+            if op == "**":
+                if left.dtype.kind in "iu" and right.dtype.kind in "iu":
+                    if (right < 0).any():
+                        return np.power(left.astype(float), right.astype(float))
+                return np.power(left, right)
+            if op in _CMP_OPS:
+                return _BINARY_NP[op](left, right)
+            if op in _BOOL_OPS:
+                if left.dtype == bool and right.dtype == bool:
+                    return _BINARY_NP[op](left, right)
+                return _BINARY_NP[op](left, right)
+            if op == "@":
+                return _elementwise(operator.matmul, left, right)
+            return _BINARY_NP[op](left, right)
+    # object path
+    fn = _BINARY_PY[op]
+    return _tighten(_elementwise(fn, left, right))
+
+
+def _py_eq(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return bool(np.array_equal(a, b))
+    return a == b
+
+
+_BINARY_NP: dict[str, Callable] = {
+    "+": np.add,
+    "-": np.subtract,
+    "*": np.multiply,
+    "==": np.equal,
+    "!=": np.not_equal,
+    "<": np.less,
+    "<=": np.less_equal,
+    ">": np.greater,
+    ">=": np.greater_equal,
+    "&": np.bitwise_and,
+    "|": np.bitwise_or,
+    "^": np.bitwise_xor,
+}
+
+_BINARY_PY: dict[str, Callable] = {
+    "+": operator.add,
+    "-": operator.sub,
+    "*": operator.mul,
+    "/": operator.truediv,
+    "//": operator.floordiv,
+    "%": operator.mod,
+    "**": operator.pow,
+    "@": operator.matmul,
+    "==": _py_eq,
+    "!=": lambda a, b: not _py_eq(a, b),
+    "<": operator.lt,
+    "<=": operator.le,
+    ">": operator.gt,
+    ">=": operator.ge,
+    "&": operator.and_,
+    "|": operator.or_,
+    "^": operator.xor,
+}
+
+
+def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
+    n = ctx.n
+    if isinstance(e, InternalColRef):
+        return ctx.fetch(e)
+    if isinstance(e, expr.ColumnConstExpression):
+        return _full(n, e._value)
+    if isinstance(e, expr.ColumnBinaryOpExpression):
+        return _binary(e._op, eval_expr(e._left, ctx), eval_expr(e._right, ctx))
+    if isinstance(e, expr.ColumnUnaryOpExpression):
+        a = eval_expr(e._expr, ctx)
+        if e._op == "-":
+            return -a if _is_numeric(a) else _elementwise(operator.neg, a)
+        if e._op == "~":
+            if a.dtype == bool:
+                return ~a
+            return _tighten(_elementwise(operator.inv, a))
+        if e._op == "abs":
+            return np.abs(a) if _is_numeric(a) else _elementwise(abs, a)
+        raise NotImplementedError(e._op)
+    if isinstance(e, expr.IfElseExpression):
+        cond = eval_expr(e._if, ctx)
+        cond_b = cond.astype(bool) if cond.dtype != object else np.array(
+            [bool(c) for c in cond]
+        )
+        idx_t = np.nonzero(cond_b)[0]
+        idx_f = np.nonzero(~cond_b)[0]
+        then_v = eval_expr(e._then, _subset_ctx(ctx, idx_t))
+        else_v = eval_expr(e._else, _subset_ctx(ctx, idx_f))
+        if (
+            then_v.dtype == else_v.dtype
+            and then_v.dtype != object
+        ):
+            out = np.empty(n, dtype=then_v.dtype)
+        else:
+            out = np.empty(n, dtype=object)
+        out[idx_t] = then_v
+        out[idx_f] = else_v
+        return _tighten(out) if out.dtype == object else out
+    if isinstance(e, expr.CoalesceExpression):
+        out = eval_expr(e._args[0], ctx)
+        if out.dtype != object:
+            return out
+        out = out.copy()
+        for arg in e._args[1:]:
+            missing = np.array([v is None for v in out])
+            if not missing.any():
+                break
+            idx = np.nonzero(missing)[0]
+            sub = eval_expr(arg, _subset_ctx(ctx, idx))
+            out[idx] = sub
+        return _tighten(out)
+    if isinstance(e, expr.RequireExpression):
+        val = eval_expr(e._val, ctx)
+        missing = np.zeros(n, dtype=bool)
+        for arg in e._args:
+            a = eval_expr(arg, ctx)
+            if a.dtype == object:
+                missing |= np.array([v is None for v in a])
+        if not missing.any():
+            return val
+        out = val.astype(object) if val.dtype != object else val.copy()
+        out[missing] = None
+        return out
+    if isinstance(e, expr.FillErrorExpression):
+        val = eval_expr(e._expr, ctx)
+        if val.dtype != object:
+            return val
+        bad = np.array([isinstance(v, Error) for v in val])
+        if not bad.any():
+            return val
+        idx = np.nonzero(bad)[0]
+        repl = eval_expr(e._replacement, _subset_ctx(ctx, idx))
+        out = val.copy()
+        out[idx] = repl
+        return _tighten(out)
+    if isinstance(e, expr.IsNoneExpression):
+        a = eval_expr(e._expr, ctx)
+        if a.dtype != object:
+            return np.zeros(n, dtype=bool)
+        return np.array([v is None for v in a])
+    if isinstance(e, expr.IsNotNoneExpression):
+        a = eval_expr(e._expr, ctx)
+        if a.dtype != object:
+            return np.ones(n, dtype=bool)
+        return np.array([v is not None for v in a])
+    if isinstance(e, expr.UnwrapExpression):
+        a = eval_expr(e._expr, ctx)
+        if a.dtype == object:
+            for v in a:
+                if v is None:
+                    raise ValueError("cannot unwrap, column contains None")
+        return a
+    if isinstance(e, expr.CastExpression):
+        return _cast(e._target, eval_expr(e._expr, ctx))
+    if isinstance(e, expr.ConvertExpression):
+        return _convert(e._target, eval_expr(e._expr, ctx), e._unwrap)
+    if isinstance(e, expr.DeclareTypeExpression):
+        return eval_expr(e._expr, ctx)
+    if isinstance(e, expr.ToStringExpression):
+        a = eval_expr(e._expr, ctx)
+        return _elementwise(_to_string, a)
+    if isinstance(e, expr.MakeTupleExpression):
+        arrays = [eval_expr(a, ctx) for a in e._args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = tuple(a[i] for a in arrays)
+        return out
+    if isinstance(e, expr.GetExpression):
+        a = eval_expr(e._expr, ctx)
+        idx = eval_expr(e._index, ctx)
+        default = eval_expr(e._default, ctx)
+        if e._check_if_exists:
+            return _elementwise(_get_with_default, a, idx, default)
+        return _elementwise(_get_strict, a, idx)
+    if isinstance(e, expr.PointerExpression):
+        arrays = [eval_expr(a, ctx) for a in e._args]
+        inst = eval_expr(e._instance, ctx) if e._instance is not None else None
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            vals = tuple(a[i] for a in arrays)
+            if e._optional and any(v is None for v in vals):
+                out[i] = None
+                continue
+            p = ref_scalar(*vals)
+            if inst is not None:
+                p = p.with_shard_of(ref_scalar(inst[i]))
+            out[i] = p
+        return out
+    if isinstance(e, expr.MethodCallExpression):
+        arrays = [eval_expr(a, ctx) for a in e._args]
+        if e._vector_fn is not None and all(_is_numeric(a) for a in arrays):
+            try:
+                return e._vector_fn(*arrays)
+            except Exception:
+                pass
+        fn = e._scalar_fn
+        if e._propagate_none:
+
+            def wrapped(first, *rest, _fn=fn):
+                if first is None:
+                    return None
+                return _fn(first, *rest)
+
+            return _tighten(_elementwise(wrapped, *arrays))
+        return _tighten(_elementwise(fn, *arrays))
+    if isinstance(e, (expr.AsyncApplyExpression,)):
+        return _eval_async_apply(e, ctx)
+    if isinstance(e, expr.ApplyExpression):
+        arrays = [eval_expr(a, ctx) for a in e._args]
+        kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            args = [a[i] for a in arrays]
+            kwargs = {k: v[i] for k, v in kw_arrays.items()}
+            if e._propagate_none and any(a is None for a in args):
+                out[i] = None
+                continue
+            if any(isinstance(a, Error) for a in args) or any(
+                isinstance(v, Error) for v in kwargs.values()
+            ):
+                out[i] = ERROR
+                continue
+            try:
+                out[i] = e._fn(*args, **kwargs)
+            except Exception as exc:
+                from pathway_tpu.internals.errors import record_error
+
+                record_error(exc)
+                out[i] = ERROR
+        return _coerce_to_dtype(out, e._return_type)
+    if isinstance(e, expr.ReducerExpression):
+        raise RuntimeError(
+            "reducers can only be used inside groupby(...).reduce(...)"
+        )
+    if isinstance(e, expr.ColumnReference):
+        raise RuntimeError(
+            f"unresolved column reference {e!r} — expression used outside "
+            "of its table context"
+        )
+    raise NotImplementedError(f"cannot evaluate {type(e).__name__}")
+
+
+def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndarray:
+    import asyncio
+
+    n = ctx.n
+    arrays = [eval_expr(a, ctx) for a in e._args]
+    kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+
+    async def run_all():
+        async def one(i):
+            args = [a[i] for a in arrays]
+            kwargs = {k: v[i] for k, v in kw_arrays.items()}
+            if e._propagate_none and any(a is None for a in args):
+                return None
+            if any(isinstance(a, Error) for a in args) or any(
+                isinstance(v, Error) for v in kwargs.values()
+            ):
+                return ERROR
+            try:
+                return await e._fn(*args, **kwargs)
+            except Exception as exc:
+                from pathway_tpu.internals.errors import record_error
+
+                record_error(exc)
+                return ERROR
+
+        return await asyncio.gather(*[one(i) for i in range(n)])
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        loop = None
+    if loop is not None:
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            results = pool.submit(lambda: asyncio.run(run_all())).result()
+    else:
+        results = asyncio.run(run_all())
+    out = np.empty(n, dtype=object)
+    for i, r in enumerate(results):
+        out[i] = r
+    return _coerce_to_dtype(out, e._return_type)
+
+
+def _coerce_to_dtype(out: np.ndarray, target: dt.DType) -> np.ndarray:
+    storage = target.np_dtype
+    if storage != np.dtype(object) and out.dtype == object:
+        try:
+            return out.astype(storage)
+        except (ValueError, TypeError):
+            return out
+    return out
+
+
+def _to_string(v: Any) -> str:
+    if isinstance(v, Json):
+        return v.to_string()
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    return str(v)
+
+
+def _get_with_default(container: Any, index: Any, default: Any) -> Any:
+    try:
+        return _get_strict(container, index)
+    except Exception:
+        return default
+
+
+def _get_strict(container: Any, index: Any) -> Any:
+    if isinstance(index, np.integer):
+        index = int(index)
+    return container[index]
+
+
+def _cast(target: dt.DType, a: np.ndarray) -> np.ndarray:
+    t = target.strip_optional()
+    if t == dt.INT:
+        if _is_numeric(a):
+            return a.astype(np.int64)
+        return _tighten(_elementwise(lambda v: None if v is None else int(v), a))
+    if t == dt.FLOAT:
+        if _is_numeric(a):
+            return a.astype(np.float64)
+        return _tighten(_elementwise(lambda v: None if v is None else float(v), a))
+    if t == dt.BOOL:
+        if _is_numeric(a):
+            return a.astype(bool)
+        return _tighten(_elementwise(lambda v: None if v is None else bool(v), a))
+    if t == dt.STR:
+        return _elementwise(lambda v: None if v is None else _to_string(v), a)
+    return a
+
+
+def _convert(target: dt.DType, a: np.ndarray, unwrap: bool) -> np.ndarray:
+    def fn(v):
+        if v is None:
+            if unwrap:
+                raise ValueError("cannot unwrap None")
+            return None
+        if isinstance(v, Json):
+            if target == dt.INT:
+                return v.as_int()
+            if target == dt.FLOAT:
+                return v.as_float()
+            if target == dt.STR:
+                return v.as_str()
+            if target == dt.BOOL:
+                return v.as_bool()
+        if target == dt.INT:
+            if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+                raise ValueError(f"{v!r} is not an int")
+            return int(v)
+        if target == dt.FLOAT:
+            if isinstance(v, bool) or not isinstance(v, (int, float, np.number)):
+                raise ValueError(f"{v!r} is not a float")
+            return float(v)
+        if target == dt.STR:
+            if not isinstance(v, str):
+                raise ValueError(f"{v!r} is not a str")
+            return v
+        if target == dt.BOOL:
+            if not isinstance(v, (bool, np.bool_)):
+                raise ValueError(f"{v!r} is not a bool")
+            return bool(v)
+        return v
+
+    return _tighten(_elementwise(fn, a))
+
+
+def _subset_ctx(ctx: EvalContext, idx: np.ndarray) -> EvalContext:
+    return EvalContext(
+        ctx.keys[idx],
+        [{n: c[idx] for n, c in cols.items()} for cols in ctx.column_sets],
+    )

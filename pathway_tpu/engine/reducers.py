@@ -1,0 +1,345 @@
+"""Reducer accumulators for incremental groupby/reduce.
+
+Parity with the reference reducer set (/root/reference/src/engine/reduce.rs:22-38
+and src/engine/dataflow.rs:3113-3400): Count, IntSum/FloatSum/ArraySum, Unique,
+Min/ArgMin, Max/ArgMax, SortedTuple, Tuple, Any, Earliest, Latest, Avg,
+Ndarray, Stateful. Semigroup reducers (count/sum/avg) keep O(1) state and
+retract by subtraction; order-dependent ones keep a multiset and restate on
+change — the engine recomputes only touched groups per tick, the microbatch
+analog of differential's `reduce_abelian`."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals.api import ERROR, Pointer
+
+
+@dataclass
+class ReducerSpec:
+    """Build-time description: which accumulator over which input columns."""
+
+    kind: str
+    arg_cols: tuple[str, ...] = ()
+    skip_nones: bool = False
+    fn: Callable | None = None  # stateful combine fn
+    extra: dict = field(default_factory=dict)
+
+    def make(self) -> "Accumulator":
+        return _FACTORY[self.kind](self)
+
+
+class Accumulator:
+    def __init__(self, spec: ReducerSpec):
+        self.spec = spec
+
+    def update(self, args: tuple, diff: int, key: int, time: int) -> None:
+        raise NotImplementedError
+
+    def value(self) -> Any:
+        raise NotImplementedError
+
+
+class CountAcc(Accumulator):
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.c = 0
+
+    def update(self, args, diff, key, time):
+        if self.spec.arg_cols and self.spec.skip_nones and args[0] is None:
+            return
+        self.c += diff
+
+    def value(self):
+        return self.c
+
+
+class SumAcc(Accumulator):
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.s: Any = 0
+        self.n = 0
+
+    def update(self, args, diff, key, time):
+        v = args[0]
+        if v is None:
+            if self.spec.skip_nones:
+                return
+            v = 0
+        if isinstance(v, np.ndarray):
+            if self.n == 0 and diff > 0:
+                self.s = v * diff
+            else:
+                self.s = self.s + v * diff
+        else:
+            self.s = self.s + v * diff
+        self.n += diff
+
+    def value(self):
+        return self.s
+
+
+class AvgAcc(Accumulator):
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.s = 0.0
+        self.c = 0
+
+    def update(self, args, diff, key, time):
+        v = args[0]
+        if v is None:
+            if self.spec.skip_nones:
+                return
+        self.s += float(v) * diff
+        self.c += diff
+
+    def value(self):
+        if self.c == 0:
+            return ERROR
+        return self.s / self.c
+
+
+class _MultisetAcc(Accumulator):
+    """Keeps a multiset of argument tuples with counts."""
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.items: dict[Any, int] = {}
+
+    def _k(self, args: tuple, key: int, time: int) -> Any:
+        return args
+
+    def update(self, args, diff, key, time):
+        if self.spec.skip_nones and args[0] is None:
+            return
+        k = self._k(args, key, time)
+        c = self.items.get(k, 0) + diff
+        if c == 0:
+            self.items.pop(k, None)
+        else:
+            self.items[k] = c
+
+
+def _sort_key(v: Any) -> Any:
+    # heterogeneous-safe sort key
+    return (str(type(v).__name__), v) if not isinstance(v, (int, float, bool)) else (
+        "num",
+        v,
+    )
+
+
+class MinAcc(_MultisetAcc):
+    def value(self):
+        if not self.items:
+            return ERROR
+        return min((k[0] for k in self.items), key=_sort_key)
+
+
+class MaxAcc(_MultisetAcc):
+    def value(self):
+        if not self.items:
+            return ERROR
+        return max((k[0] for k in self.items), key=_sort_key)
+
+
+class ArgMinAcc(_MultisetAcc):
+    # args = (value, arg)
+    def value(self):
+        if not self.items:
+            return ERROR
+        best = min(self.items, key=lambda kv: (_sort_key(kv[0]), kv[1]))
+        return best[1]
+
+
+class ArgMaxAcc(_MultisetAcc):
+    def value(self):
+        if not self.items:
+            return ERROR
+        best = max(self.items, key=lambda kv: (_sort_key(kv[0]), -_hash_order(kv[1])))
+        return best[1]
+
+
+def _hash_order(v: Any) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return hash(v)
+
+
+class UniqueAcc(_MultisetAcc):
+    def value(self):
+        vals = {k[0] for k in self.items}
+        if len(vals) != 1:
+            return ERROR
+        return next(iter(vals))
+
+
+class AnyAcc(_MultisetAcc):
+    def value(self):
+        if not self.items:
+            return ERROR
+        return min((k[0] for k in self.items), key=_sort_key)
+
+
+class _KeyedMultisetAcc(Accumulator):
+    """Multiset of (order_key, value) for ordered collection reducers."""
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.items: dict[Any, int] = {}
+
+    def update(self, args, diff, key, time):
+        v = args[0]
+        if self.spec.skip_nones and v is None:
+            return
+        k = (key, _hashable(v))
+        c = self.items.get(k, 0) + diff
+        if c == 0:
+            self.items.pop(k, None)
+        else:
+            self.items[k] = c
+
+    def _expanded(self):
+        out = []
+        for (key, v), c in self.items.items():
+            out.extend([(key, _unhashable(v))] * max(c, 0))
+        return out
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return ("__ndarray__", v.tobytes(), str(v.dtype), v.shape)
+    if isinstance(v, list):
+        return ("__tuple__", tuple(v))
+    return v
+
+
+def _unhashable(v: Any) -> Any:
+    if isinstance(v, tuple) and len(v) == 4 and v[0] == "__ndarray__":
+        return np.frombuffer(v[1], dtype=np.dtype(v[2])).reshape(v[3])
+    if isinstance(v, tuple) and len(v) == 2 and v[0] == "__tuple__":
+        return v[1]
+    return v
+
+
+class TupleAcc(_KeyedMultisetAcc):
+    def value(self):
+        items = sorted(self._expanded(), key=lambda kv: kv[0])
+        return tuple(v for _, v in items)
+
+
+class SortedTupleAcc(_KeyedMultisetAcc):
+    def value(self):
+        items = [v for _, v in self._expanded()]
+        return tuple(sorted(items, key=_sort_key))
+
+
+class NdarrayAcc(_KeyedMultisetAcc):
+    def value(self):
+        items = sorted(self._expanded(), key=lambda kv: kv[0])
+        return np.array([v for _, v in items])
+
+
+class EarliestAcc(Accumulator):
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.items: dict[Any, int] = {}
+
+    def update(self, args, diff, key, time):
+        k = (time, key, _hashable(args[0]))
+        c = self.items.get(k, 0) + diff
+        if c == 0:
+            self.items.pop(k, None)
+        else:
+            self.items[k] = c
+
+    def value(self):
+        if not self.items:
+            return ERROR
+        t, k, v = min(self.items, key=lambda x: (x[0], x[1]))
+        return _unhashable(v)
+
+
+class LatestAcc(EarliestAcc):
+    def value(self):
+        if not self.items:
+            return ERROR
+        t, k, v = max(self.items, key=lambda x: (x[0], x[1]))
+        return _unhashable(v)
+
+
+class StatefulAcc(Accumulator):
+    """Custom non-retractable accumulator
+    (reference: stateful_reduce, src/engine/dataflow/operators/stateful_reduce.rs)."""
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.state: Any = None
+        self.many = spec.extra.get("many", False)
+
+    def update(self, args, diff, key, time):
+        if diff < 0:
+            raise RuntimeError(
+                "stateful reducers do not support retractions "
+                "(append-only input required)"
+            )
+        assert self.spec.fn is not None
+        if self.many:
+            self.state = self.spec.fn(self.state, [(args, diff)])
+        else:
+            self.state = self.spec.fn(self.state, *args)
+
+    def value(self):
+        return self.state
+
+
+class CustomAccAcc(Accumulator):
+    """BaseCustomAccumulator-driven reducer
+    (reference: udf_reducer, internals/custom_reducers.py)."""
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.cls = spec.extra["cls"]
+        self.acc: Any = None
+
+    def update(self, args, diff, key, time):
+        other = self.cls.from_row(list(args))
+        if diff > 0:
+            for _ in range(diff):
+                if self.acc is None:
+                    self.acc = self.cls.from_row(list(args))
+                else:
+                    self.acc.update(other)
+        else:
+            for _ in range(-diff):
+                if self.acc is None:
+                    raise RuntimeError("retraction before insertion")
+                self.acc.retract(other)
+
+    def value(self):
+        if self.acc is None:
+            return None
+        return self.acc.compute_result()
+
+
+_FACTORY: dict[str, Callable[[ReducerSpec], Accumulator]] = {
+    "custom_acc": CustomAccAcc,
+    "count": CountAcc,
+    "sum": SumAcc,
+    "avg": AvgAcc,
+    "min": MinAcc,
+    "max": MaxAcc,
+    "argmin": ArgMinAcc,
+    "argmax": ArgMaxAcc,
+    "unique": UniqueAcc,
+    "any": AnyAcc,
+    "tuple": TupleAcc,
+    "sorted_tuple": SortedTupleAcc,
+    "ndarray": NdarrayAcc,
+    "earliest": EarliestAcc,
+    "latest": LatestAcc,
+    "stateful": StatefulAcc,
+}

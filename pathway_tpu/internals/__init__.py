@@ -1,0 +1,54 @@
+from pathway_tpu.internals import dtype
+from pathway_tpu.internals.api import (
+    PathwayType,
+    PersistenceMode,
+    Pointer,
+    PyObjectWrapper,
+    wrap_py_object,
+)
+from pathway_tpu.internals.common import (
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_schema,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.datetime_types import DateTimeNaive, DateTimeUtc, Duration
+from pathway_tpu.internals.errors import global_error_log, local_error_log
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.groupbys import GroupedJoinResult, GroupedTable
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.run import MonitoringLevel, run, run_all
+from pathway_tpu.internals.schema import (
+    Schema,
+    SchemaProperties,
+    assert_table_has_schema,  # noqa: F811
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import (
+    Joinable,
+    Table,
+    TableLike,
+    groupby,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals.udfs import UDF, udf
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+"""DateTimeNaive / DateTimeUtc / Duration — thin subclasses of stdlib datetime
+(reference: src/engine/time.rs; python: pathway.DateTimeNaive etc.).
+
+The reference implements these natively in Rust over chrono; here they subclass
+`datetime` so all stdlib arithmetic works, while `.dt` column namespaces do the
+columnar work.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any
+
+import numpy as np
+
+
+class DateTimeNaive(datetime.datetime):
+    """Timezone-unaware datetime."""
+
+    @classmethod
+    def from_datetime(cls, dt: datetime.datetime) -> "DateTimeNaive":
+        if dt.tzinfo is not None:
+            raise ValueError("DateTimeNaive cannot hold an aware datetime")
+        return cls(
+            dt.year, dt.month, dt.day, dt.hour, dt.minute, dt.second, dt.microsecond
+        )
+
+    @classmethod
+    def strptime(cls, s: str, fmt: str) -> "DateTimeNaive":  # type: ignore[override]
+        return cls.from_datetime(datetime.datetime.strptime(s, fmt))
+
+    def timestamp_ns(self) -> int:
+        epoch = datetime.datetime(1970, 1, 1)
+        return int((self - epoch).total_seconds() * 1e9)
+
+
+class DateTimeUtc(datetime.datetime):
+    """Timezone-aware datetime (stored as UTC)."""
+
+    @classmethod
+    def from_datetime(cls, dt: datetime.datetime) -> "DateTimeUtc":
+        if dt.tzinfo is None:
+            raise ValueError("DateTimeUtc requires an aware datetime")
+        dt = dt.astimezone(datetime.timezone.utc)
+        return cls(
+            dt.year,
+            dt.month,
+            dt.day,
+            dt.hour,
+            dt.minute,
+            dt.second,
+            dt.microsecond,
+            tzinfo=datetime.timezone.utc,
+        )
+
+    def timestamp_ns(self) -> int:
+        return int(self.timestamp() * 1e9)
+
+
+class Duration(datetime.timedelta):
+    """Time difference."""
+
+    @classmethod
+    def from_timedelta(cls, td: datetime.timedelta) -> "Duration":
+        return cls(days=td.days, seconds=td.seconds, microseconds=td.microseconds)
+
+    def nanoseconds(self) -> int:
+        return int(self.total_seconds() * 1e9)
+
+
+def to_naive(v: Any) -> DateTimeNaive:
+    if isinstance(v, DateTimeNaive):
+        return v
+    if isinstance(v, datetime.datetime):
+        return DateTimeNaive.from_datetime(v)
+    if isinstance(v, np.datetime64):
+        us = v.astype("datetime64[us]").astype("int64")
+        return DateTimeNaive.from_datetime(
+            datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(us))
+        )
+    raise TypeError(f"cannot convert {v!r} to DateTimeNaive")

@@ -1,0 +1,38 @@
+"""Error log exposed as a table (reference: global_error_log,
+python/pathway/internals/errors.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import InputNode
+from pathway_tpu.engine.runtime import StaticSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import sequential_key
+from pathway_tpu.internals.errors import peek_errors
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+_COLS = ["message", "operator_id", "trace"]
+
+
+class _ErrorLogSource(StaticSource):
+    def __init__(self):
+        super().__init__(_COLS)
+
+    def events(self):
+        errs = peek_errors()
+        rows = [
+            (int(sequential_key(i)), 1, (e["message"], e["operator_id"], e["trace"]))
+            for i, e in enumerate(errs)
+        ]
+        if rows:
+            yield 0, DiffBatch.from_rows(rows, _COLS)
+
+
+def error_log_table() -> Table:
+    node = InputNode(_ErrorLogSource(), _COLS)
+    return Table._from_node(
+        node,
+        {"message": dt.STR, "operator_id": dt.STR, "trace": dt.STR},
+        Universe(),
+    )

@@ -1,0 +1,58 @@
+"""Error handling: poison values + error logs
+(reference: src/engine/error.rs, python/pathway/internals/errors.py).
+
+Expression failures produce `ERROR` poison values that flow through the graph
+instead of crashing (when ``terminate_on_error=False``); every recorded error
+also lands in the global error log, queryable as a table via
+``pw.global_error_log()``."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any
+
+logger = logging.getLogger("pathway_tpu")
+
+_lock = threading.Lock()
+_ERROR_LOG: list[dict[str, Any]] = []
+
+
+def record_error(exc: Exception | str, operator: str | None = None) -> None:
+    with _lock:
+        _ERROR_LOG.append(
+            {
+                "message": str(exc),
+                "operator_id": operator or "",
+                "trace": "",
+            }
+        )
+    logger.debug("recorded error: %s", exc)
+
+
+def drain_errors() -> list[dict[str, Any]]:
+    with _lock:
+        out = list(_ERROR_LOG)
+        _ERROR_LOG.clear()
+    return out
+
+
+def peek_errors() -> list[dict[str, Any]]:
+    with _lock:
+        return list(_ERROR_LOG)
+
+
+def clear_errors() -> None:
+    with _lock:
+        _ERROR_LOG.clear()
+
+
+def global_error_log():
+    """Table of errors recorded during the run."""
+    from pathway_tpu.internals.error_log_table import error_log_table
+
+    return error_log_table()
+
+
+def local_error_log():
+    return global_error_log()

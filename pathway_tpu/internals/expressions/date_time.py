@@ -1,0 +1,314 @@
+"""`.dt` column namespace
+(reference surface: python/pathway/internals/expressions/date_time.py; the
+reference implements these in Rust over chrono, src/engine/time.rs)."""
+
+from __future__ import annotations
+
+import datetime
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.datetime_types import (
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+)
+from pathway_tpu.internals.expression import ColumnExpression, MethodCallExpression
+
+
+def _m(name, fn, ret, *args):
+    return MethodCallExpression(name, fn, ret, *args)
+
+
+_UNIT_NS = {
+    "ns": 1,
+    "us": 1_000,
+    "ms": 1_000_000,
+    "s": 1_000_000_000,
+}
+
+
+def _dt_ns(d: datetime.datetime) -> int:
+    if d.tzinfo is None:
+        epoch = datetime.datetime(1970, 1, 1)
+        return int((d - epoch) / datetime.timedelta(microseconds=1)) * 1000
+    return int(d.timestamp() * 1_000_000) * 1000
+
+
+def _parse_duration_str(freq: str) -> datetime.timedelta:
+    import re
+
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]+)\s*", freq)
+    if not m:
+        raise ValueError(f"cannot parse duration {freq!r}")
+    qty = float(m.group(1))
+    unit = m.group(2).lower()
+    table = {
+        "ns": 1e-9,
+        "us": 1e-6,
+        "ms": 1e-3,
+        "s": 1.0,
+        "sec": 1.0,
+        "min": 60.0,
+        "t": 60.0,
+        "h": 3600.0,
+        "hr": 3600.0,
+        "d": 86400.0,
+        "day": 86400.0,
+        "w": 604800.0,
+    }
+    if unit not in table:
+        raise ValueError(f"unknown duration unit {unit!r}")
+    return datetime.timedelta(seconds=qty * table[unit])
+
+
+class DateTimeNamespace:
+    def __init__(self, expression: ColumnExpression):
+        self._expr = expression
+
+    # --- field extraction ----------------------------------------------------
+
+    def nanosecond(self):
+        return _m("dt.nanosecond", lambda d: (_dt_ns(d)) % 1_000_000_000, dt.INT, self._expr)
+
+    def microsecond(self):
+        return _m("dt.microsecond", lambda d: d.microsecond, dt.INT, self._expr)
+
+    def millisecond(self):
+        return _m("dt.millisecond", lambda d: d.microsecond // 1000, dt.INT, self._expr)
+
+    def second(self):
+        return _m("dt.second", lambda d: d.second, dt.INT, self._expr)
+
+    def minute(self):
+        return _m("dt.minute", lambda d: d.minute, dt.INT, self._expr)
+
+    def hour(self):
+        return _m("dt.hour", lambda d: d.hour, dt.INT, self._expr)
+
+    def day(self):
+        return _m("dt.day", lambda d: d.day, dt.INT, self._expr)
+
+    def month(self):
+        return _m("dt.month", lambda d: d.month, dt.INT, self._expr)
+
+    def year(self):
+        return _m("dt.year", lambda d: d.year, dt.INT, self._expr)
+
+    def weekday(self):
+        return _m("dt.weekday", lambda d: d.weekday(), dt.INT, self._expr)
+
+    def timestamp(self, unit: str | None = None):
+        if unit is None:
+            return _m("dt.timestamp", _dt_ns, dt.INT, self._expr)
+        div = _UNIT_NS[unit]
+        return _m(
+            "dt.timestamp", lambda d: _dt_ns(d) / div, dt.FLOAT, self._expr
+        )
+
+    # --- formatting ----------------------------------------------------------
+
+    def strftime(self, fmt):
+        return _m(
+            "dt.strftime", lambda d, f: d.strftime(f), dt.STR, self._expr, fmt
+        )
+
+    def strptime(self, fmt, contains_timezone: bool | None = None):
+        def fn(s, f):
+            parsed = datetime.datetime.strptime(s, f)
+            if parsed.tzinfo is not None:
+                return DateTimeUtc.from_datetime(parsed)
+            return DateTimeNaive.from_datetime(parsed)
+
+        ret = dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE
+        return _m("dt.strptime", fn, ret, self._expr, fmt)
+
+    # --- timezone ------------------------------------------------------------
+
+    def to_utc(self, from_timezone: str):
+        from zoneinfo import ZoneInfo
+
+        def fn(d, tz):
+            return DateTimeUtc.from_datetime(d.replace(tzinfo=ZoneInfo(tz)))
+
+        return _m("dt.to_utc", fn, dt.DATE_TIME_UTC, self._expr, from_timezone)
+
+    def to_naive_in_timezone(self, timezone: str):
+        from zoneinfo import ZoneInfo
+
+        def fn(d, tz):
+            return DateTimeNaive.from_datetime(
+                d.astimezone(ZoneInfo(tz)).replace(tzinfo=None)
+            )
+
+        return _m(
+            "dt.to_naive_in_timezone", fn, dt.DATE_TIME_NAIVE, self._expr, timezone
+        )
+
+    def add_duration_in_timezone(self, duration, timezone: str):
+        from zoneinfo import ZoneInfo
+
+        def fn(d, dur, tz):
+            zone = ZoneInfo(tz)
+            local = d.astimezone(zone)
+            return DateTimeUtc.from_datetime(
+                (local.replace(tzinfo=None) + dur).replace(tzinfo=zone)
+            )
+
+        return _m(
+            "dt.add_duration_in_timezone",
+            fn,
+            dt.DATE_TIME_UTC,
+            self._expr,
+            duration,
+            timezone,
+        )
+
+    def subtract_duration_in_timezone(self, duration, timezone: str):
+        from zoneinfo import ZoneInfo
+
+        def fn(d, dur, tz):
+            zone = ZoneInfo(tz)
+            local = d.astimezone(zone)
+            return DateTimeUtc.from_datetime(
+                (local.replace(tzinfo=None) - dur).replace(tzinfo=zone)
+            )
+
+        return _m(
+            "dt.subtract_duration_in_timezone",
+            fn,
+            dt.DATE_TIME_UTC,
+            self._expr,
+            duration,
+            timezone,
+        )
+
+    def subtract_date_time_in_timezone(self, other, timezone: str):
+        from zoneinfo import ZoneInfo
+
+        def fn(a, b, tz):
+            zone = ZoneInfo(tz)
+            la = a.astimezone(zone).replace(tzinfo=None)
+            lb = b.astimezone(zone).replace(tzinfo=None)
+            return Duration.from_timedelta(la - lb)
+
+        return _m(
+            "dt.subtract_date_time_in_timezone",
+            fn,
+            dt.DURATION,
+            self._expr,
+            other,
+            timezone,
+        )
+
+    # --- rounding ------------------------------------------------------------
+
+    def round(self, period):
+        def fn(d, p):
+            if isinstance(p, str):
+                p = _parse_duration_str(p)
+            ns = _dt_ns(d)
+            pns = int(p.total_seconds() * 1e9)
+            rounded = ((ns + pns // 2) // pns) * pns
+            return _from_ns(rounded, aware=d.tzinfo is not None)
+
+        return _m("dt.round", fn, dt.ANY, self._expr, period)
+
+    def floor(self, period):
+        def fn(d, p):
+            if isinstance(p, str):
+                p = _parse_duration_str(p)
+            ns = _dt_ns(d)
+            pns = int(p.total_seconds() * 1e9)
+            return _from_ns((ns // pns) * pns, aware=d.tzinfo is not None)
+
+        return _m("dt.floor", fn, dt.ANY, self._expr, period)
+
+    # --- duration fields -----------------------------------------------------
+
+    def to_duration(self, unit):
+        def fn(x, u):
+            return Duration.from_timedelta(
+                datetime.timedelta(seconds=x * _UNIT_NS[u] / 1e9)
+                if u in _UNIT_NS
+                else _parse_duration_str(f"{x}{u}")
+            )
+
+        return _m("dt.to_duration", fn, dt.DURATION, self._expr, unit)
+
+    def nanoseconds(self):
+        return _m(
+            "dt.nanoseconds",
+            lambda td: int(td.total_seconds() * 1e9),
+            dt.INT,
+            self._expr,
+        )
+
+    def microseconds(self):
+        return _m(
+            "dt.microseconds",
+            lambda td: int(td.total_seconds() * 1e6),
+            dt.INT,
+            self._expr,
+        )
+
+    def milliseconds(self):
+        return _m(
+            "dt.milliseconds",
+            lambda td: int(td.total_seconds() * 1e3),
+            dt.INT,
+            self._expr,
+        )
+
+    def seconds(self):
+        return _m(
+            "dt.seconds", lambda td: int(td.total_seconds()), dt.INT, self._expr
+        )
+
+    def minutes(self):
+        return _m(
+            "dt.minutes", lambda td: int(td.total_seconds() // 60), dt.INT, self._expr
+        )
+
+    def hours(self):
+        return _m(
+            "dt.hours", lambda td: int(td.total_seconds() // 3600), dt.INT, self._expr
+        )
+
+    def days(self):
+        return _m(
+            "dt.days", lambda td: int(td.total_seconds() // 86400), dt.INT, self._expr
+        )
+
+    def weeks(self):
+        return _m(
+            "dt.weeks", lambda td: int(td.total_seconds() // 604800), dt.INT, self._expr
+        )
+
+    # --- from timestamp ------------------------------------------------------
+
+    def from_timestamp(self, unit: str):
+        mul = _UNIT_NS[unit]
+        return _m(
+            "dt.from_timestamp",
+            lambda x: _from_ns(int(x * mul), aware=False),
+            dt.DATE_TIME_NAIVE,
+            self._expr,
+        )
+
+    def utc_from_timestamp(self, unit: str):
+        mul = _UNIT_NS[unit]
+        return _m(
+            "dt.utc_from_timestamp",
+            lambda x: _from_ns(int(x * mul), aware=True),
+            dt.DATE_TIME_UTC,
+            self._expr,
+        )
+
+
+def _from_ns(ns: int, aware: bool):
+    base = datetime.datetime(
+        1970, 1, 1, tzinfo=datetime.timezone.utc if aware else None
+    ) + datetime.timedelta(microseconds=ns // 1000)
+    if aware:
+        return DateTimeUtc.from_datetime(base)
+    return DateTimeNaive.from_datetime(base)

@@ -1,0 +1,189 @@
+"""GroupedTable: groupby(...).reduce(...)
+(reference: python/pathway/internals/groupbys.py; engine group_by_table,
+src/engine/dataflow.rs:3404)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.engine import nodes
+from pathway_tpu.engine.expression_eval import InternalColRef
+from pathway_tpu.engine.reducers import ReducerSpec
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    ReducerExpression,
+    wrap_expr,
+)
+from pathway_tpu.internals.reducer_descriptors import ReducerDescriptor
+from pathway_tpu.internals.thisclass import ThisPlaceholder, ThisSlice, this
+from pathway_tpu.internals.universe import Universe
+
+
+class GroupedTable:
+    def __init__(
+        self,
+        table,
+        grouping: Sequence[ColumnExpression],
+        instance: ColumnExpression | None = None,
+        set_id: bool = False,
+        sort_by: Any = None,
+    ):
+        self._table = table
+        self._grouping = list(grouping)
+        self._instance = instance
+        self._set_id = set_id
+        self._sort_by = sort_by
+
+    def reduce(self, *args: Any, **kwargs: Any) -> Any:
+        from pathway_tpu.internals.table import Table, infer_dtype
+
+        table = self._table
+        out_exprs: dict[str, ColumnExpression] = {}
+        for arg in args:
+            if isinstance(arg, ThisSlice):
+                for n, ref in arg.resolve(table).items():
+                    out_exprs[n] = ref
+            elif isinstance(arg, ColumnReference):
+                name = arg.name
+                ref = (
+                    table[name]
+                    if isinstance(arg.table, ThisPlaceholder)
+                    else arg
+                )
+                out_exprs[name] = ref
+            else:
+                raise TypeError(f"positional reduce argument {arg!r}")
+        for name, e in kwargs.items():
+            out_exprs[name] = table._desugar(e)
+
+        # --- collect reducer subexpressions & grouping references -------------
+        reducer_slots: list[ReducerExpression] = []
+
+        def collect(e: ColumnExpression):
+            if isinstance(e, ReducerExpression):
+                reducer_slots.append(e)
+                return
+            for c in e._children:
+                collect(c)
+
+        for e in out_exprs.values():
+            collect(e)
+
+        grouping_names = [f"_g{i}" for i in range(len(self._grouping))]
+
+        def grouping_index(ref: ColumnReference) -> int | None:
+            for i, g in enumerate(self._grouping):
+                if (
+                    isinstance(g, ColumnReference)
+                    and g.table is ref.table
+                    and g.name == ref.name
+                ):
+                    return i
+            return None
+
+        # --- build prep table: grouping cols + reducer args -------------------
+        prep_exprs: dict[str, ColumnExpression] = {}
+        for i, g in enumerate(self._grouping):
+            prep_exprs[grouping_names[i]] = g
+        if self._instance is not None:
+            prep_exprs["_inst"] = self._instance
+        if self._sort_by is not None:
+            prep_exprs["_sortby"] = table._desugar(self._sort_by)
+        reducer_specs: dict[str, ReducerSpec] = {}
+        slot_names: dict[int, str] = {}
+        for si, red in enumerate(reducer_slots):
+            name = f"_agg{si}"
+            slot_names[id(red)] = name
+            desc: ReducerDescriptor = red._reducer
+            arg_cols = []
+            for ai, arg in enumerate(red._args):
+                cname = f"_a{si}_{ai}"
+                prep_exprs[cname] = table._desugar(arg)
+                arg_cols.append(cname)
+            reducer_specs[name] = ReducerSpec(
+                kind=desc.kind,
+                arg_cols=tuple(arg_cols),
+                skip_nones=desc.skip_nones,
+                fn=desc.fn,
+                extra=desc.extra,
+            )
+        prep = table._build_rowwise(prep_exprs)
+
+        gb_node = nodes.GroupByNode(
+            prep._node,
+            grouping_names,
+            reducer_specs,
+            instance_col="_inst" if self._instance is not None else None,
+            set_id=self._set_id,
+            sort_by="_sortby" if self._sort_by is not None else None,
+        )
+        env = table._dtype_env()
+        gb_dtypes: dict[str, dt.DType] = {}
+        for i, g in enumerate(self._grouping):
+            gb_dtypes[grouping_names[i]] = infer_dtype(g, env)
+        for name, red in zip(reducer_specs.keys(), reducer_slots):
+            from pathway_tpu.internals.reducer_descriptors import (
+                reducer_return_dtype,
+            )
+
+            gb_dtypes[name] = reducer_return_dtype(red, env)
+        agg_table = Table._from_node(gb_node, gb_dtypes, Universe())
+
+        # --- final select over aggregated table -------------------------------
+        def rewrite(e: ColumnExpression) -> ColumnExpression:
+            if isinstance(e, ReducerExpression):
+                return InternalColRef(0, slot_names[id(e)])
+            if isinstance(e, ColumnReference):
+                gi = grouping_index(e)
+                if gi is not None:
+                    return InternalColRef(0, grouping_names[gi])
+                if e.name == "id" and e.table is table:
+                    raise ValueError(
+                        "cannot use source ids in reduce output"
+                    )
+                raise ValueError(
+                    f"column {e.name!r} used in reduce() is not a grouping "
+                    "column; wrap it in a reducer"
+                )
+            return e._rebuild(tuple(rewrite(c) for c in e._children))
+
+        final_exprs = {n: rewrite(e) for n, e in out_exprs.items()}
+        final_dtypes = {}
+        for n, e in out_exprs.items():
+
+            def env2(ref: ColumnReference) -> dt.DType:
+                gi = grouping_index(ref)
+                if gi is not None:
+                    return gb_dtypes[grouping_names[gi]]
+                return dt.ANY
+
+            final_dtypes[n] = infer_dtype(e, env2)
+        node = nodes.RowwiseNode([agg_table._node], final_exprs)
+        return Table._from_node(node, final_dtypes, agg_table._universe)
+
+
+class GroupedJoinResult(GroupedTable):
+    """groupby on a join result: references to pw.left/pw.right resolve onto
+    the materialized join (reference: JoinResult.groupby,
+    internals/joins.py:748)."""
+
+    _join_result = None
+
+    def reduce(self, *args: Any, **kwargs: Any):
+        jr = self._join_result
+        if jr is not None:
+            new_kwargs: dict[str, Any] = {}
+            for a in args:
+                if not isinstance(a, ColumnReference):
+                    raise TypeError(
+                        f"positional reduce argument {a!r} must be a column"
+                    )
+                resolved = jr._resolve_in_joined(a)
+                new_kwargs[a.name] = resolved
+            for n, e in kwargs.items():
+                new_kwargs[n] = jr._resolve_in_joined(e)
+            return super().reduce(**new_kwargs)
+        return super().reduce(*args, **kwargs)

@@ -1,0 +1,191 @@
+"""pw.iterate — fixed-point iteration
+(reference: internals/common.py:39 pw.iterate; engine iterate,
+src/engine/dataflow.rs:4185).
+
+TPU-engine strategy: instead of differential's nested product-order scopes,
+each outer tick recomputes the fixpoint over full input snapshots by running
+the iteration body subgraph repeatedly (bounded by ``iteration_limit``), then
+emits the diff vs the previously emitted fixpoint. Inner iteration is
+batch-synchronous — the microbatch analog of `Variable` feedback loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DiffBatch, MultisetState
+from pathway_tpu.engine.nodes import InputExec, InputNode, Node, NodeExec, OutputNode
+from pathway_tpu.engine.runtime import Runtime, StaticSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+class _PlaceholderSource(StaticSource):
+    def events(self):
+        return []
+
+
+class IterateNode(Node):
+    def __init__(
+        self,
+        outer_inputs: list[Node],
+        placeholder_nodes: list[InputNode],
+        result_nodes: dict[str, Node],
+        iterated_names: list[str],
+        out_name: str,
+        iteration_limit: int | None,
+    ):
+        super().__init__(outer_inputs, result_nodes[out_name].column_names)
+        self.placeholder_nodes = placeholder_nodes
+        self.result_nodes = result_nodes
+        self.iterated_names = iterated_names
+        self.out_name = out_name
+        self.iteration_limit = iteration_limit
+
+    def make_exec(self):
+        return IterateExec(self)
+
+
+class IterateExec(NodeExec):
+    def __init__(self, node: IterateNode):
+        super().__init__(node)
+        self.states = [
+            MultisetState(inp.column_names) for inp in node.inputs
+        ]
+        self.emitted: dict[int, tuple] = {}
+
+    def _run_body(
+        self, current: dict[str, dict[int, tuple]]
+    ) -> dict[str, dict[int, tuple]]:
+        """One application of the iteration body over full snapshots."""
+        node = self.node
+        captures: dict[str, dict[int, tuple]] = {name: {} for name in node.result_nodes}
+        outputs = []
+
+        def make_cb(name):
+            def cb(t, batch: DiffBatch):
+                store = captures[name]
+                for k, d, vals in batch.iter_rows():
+                    if d > 0:
+                        store[k] = vals
+                    else:
+                        store.pop(k, None)
+
+            return cb
+
+        for name, rnode in node.result_nodes.items():
+            outputs.append(OutputNode(rnode, make_cb(name)))
+        rt = Runtime(outputs)
+        injected: dict[int, list[DiffBatch]] = {}
+        for ph, name in zip(node.placeholder_nodes, node.iterated_names):
+            rows = [(k, 1, v) for k, v in current[name].items()]
+            injected[ph.id] = [DiffBatch.from_rows(rows, ph.column_names)]
+        rt.tick(0, injected)
+        rt.tick(1 << 62)  # flush
+        return captures
+
+    def process(self, t, inputs):
+        touched = False
+        for state, batches in zip(self.states, inputs):
+            for b in batches:
+                if len(b):
+                    touched = True
+                state.apply(b)
+        if not touched:
+            return []
+        node = self.node
+        current: dict[str, dict[int, tuple]] = {}
+        for name, state in zip(node.iterated_names, self.states):
+            current[name] = {k: e[0] for k, e in state.rows.items()}
+        limit = node.iteration_limit or 1000
+        for _i in range(limit):
+            result = self._run_body(current)
+            new = {name: result[name] for name in node.iterated_names}
+            if all(new[name] == current[name] for name in node.iterated_names):
+                current = new
+                break
+            current = new
+        final = result[node.out_name]  # type: ignore[possibly-undefined]
+        from pathway_tpu.engine.batch import _values_eq
+
+        out_rows = []
+        for k, old in list(self.emitted.items()):
+            neww = final.get(k)
+            if neww is None or not _values_eq(old, neww):
+                out_rows.append((k, -1, old))
+                del self.emitted[k]
+        for k, vals in final.items():
+            old = self.emitted.get(k)
+            if old is None:
+                out_rows.append((k, 1, vals))
+                self.emitted[k] = vals
+        if not out_rows:
+            return []
+        return [DiffBatch.from_rows(out_rows, node.column_names)]
+
+
+def iterate(
+    func: Callable,
+    iteration_limit: int | None = None,
+    **kwargs: Table,
+) -> Any:
+    """Iterate ``func`` to a fixed point.
+
+    ``func`` receives tables (as keyword args) and returns a Table or a dict /
+    namespace of Tables with the same keys; those are fed back until stable.
+    """
+    iterated_names = list(kwargs.keys())
+    placeholders: list[InputNode] = []
+    ph_tables: dict[str, Table] = {}
+    for name, tbl in kwargs.items():
+        ph = InputNode(
+            _PlaceholderSource(tbl.column_names()), tbl.column_names()
+        )
+        placeholders.append(ph)
+        ph_tables[name] = Table._from_node(
+            ph,
+            {n: tbl._schema[n].dtype for n in tbl.column_names()},
+            Universe(),
+        )
+    result = func(**ph_tables)
+    if isinstance(result, Table):
+        result_map = {iterated_names[0]: result}
+        single = True
+    elif isinstance(result, dict):
+        result_map = result
+        single = False
+    else:  # namedtuple-ish
+        result_map = {
+            name: getattr(result, name) for name in iterated_names
+        }
+        single = False
+    result_nodes = {name: tbl._node for name, tbl in result_map.items()}
+
+    outer_nodes = [tbl._node for tbl in kwargs.values()]
+    out_tables = {}
+    for out_name, rtbl in result_map.items():
+        it_node = IterateNode(
+            outer_nodes,
+            placeholders,
+            result_nodes,
+            iterated_names,
+            out_name,
+            iteration_limit,
+        )
+        out_tables[out_name] = Table._from_node(
+            it_node,
+            {n: rtbl._schema[n].dtype for n in rtbl.column_names()},
+            Universe(),
+        )
+    if single:
+        return out_tables[iterated_names[0]]
+    import types
+
+    return types.SimpleNamespace(**out_tables)
+
+
+def iterate_universe(func: Callable, **kwargs: Table) -> Any:
+    return iterate(func, **kwargs)

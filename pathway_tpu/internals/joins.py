@@ -1,0 +1,273 @@
+"""Joins: JoinResult and friends
+(reference: python/pathway/internals/joins.py:135; engine join_tables,
+src/engine/dataflow.rs:2740)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Sequence
+
+from pathway_tpu.engine import nodes
+from pathway_tpu.engine.expression_eval import InternalColRef
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ColumnBinaryOpExpression,
+    ColumnExpression,
+    ColumnReference,
+    wrap_expr,
+)
+from pathway_tpu.internals.thisclass import (
+    ThisPlaceholder,
+    ThisSlice,
+    left as left_ph,
+    right as right_ph,
+    this as this_ph,
+)
+from pathway_tpu.internals.universe import Universe
+
+
+class JoinMode(Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+class JoinResult:
+    """Lazy join: holds both sides + conditions; `select` / `reduce`
+    materialize."""
+
+    def __init__(
+        self,
+        left,
+        right,
+        on: Sequence[Any],
+        mode: JoinMode,
+        id_expr: Any = None,
+    ):
+        self._left = left
+        self._right = right
+        self._mode = mode if isinstance(mode, JoinMode) else JoinMode(mode)
+        self._id_expr = id_expr
+        self._left_on: list[ColumnExpression] = []
+        self._right_on: list[ColumnExpression] = []
+        for cond in on:
+            l_e, r_e = self._split_condition(cond)
+            self._left_on.append(l_e)
+            self._right_on.append(r_e)
+
+    # --- condition handling ---------------------------------------------------
+
+    def _side_of(self, e: ColumnExpression) -> str | None:
+        side = None
+        for ref in e._dependencies():
+            tbl = ref.table
+            if tbl is self._left or tbl is left_ph:
+                s = "l"
+            elif tbl is self._right or tbl is right_ph:
+                s = "r"
+            elif isinstance(tbl, ThisPlaceholder):
+                s = None
+            else:
+                # resolve tables same-universe: assume left
+                s = "l" if tbl._universe is self._left._universe else "r"
+            if s is not None:
+                if side is None:
+                    side = s
+                elif side != s:
+                    raise ValueError(
+                        "join condition side mixes left and right columns"
+                    )
+        return side
+
+    def _split_condition(self, cond: Any):
+        if not (
+            isinstance(cond, ColumnBinaryOpExpression) and cond._op == "=="
+        ):
+            raise ValueError(
+                f"join condition must be <left-expr> == <right-expr>, got {cond!r}"
+            )
+        a, b = cond._left, cond._right
+        sa, sb = self._side_of(a), self._side_of(b)
+        if sa == "r" or sb == "l":
+            a, b = b, a
+        from pathway_tpu.internals.table import desugar
+
+        l_e = desugar(a, {left_ph: self._left, this_ph: self._left})
+        r_e = desugar(b, {right_ph: self._right, this_ph: self._right})
+        return l_e, r_e
+
+    # --- materialization ------------------------------------------------------
+
+    def _build(self):
+        from pathway_tpu.internals.table import Table
+
+        lnames = [f"_on{i}" for i in range(len(self._left_on))]
+        left_cols = {n: self._left[n] for n in self._left.column_names()}
+        left_prep = self._left._build_rowwise(
+            {**left_cols, **dict(zip(lnames, self._left_on))}
+        )
+        right_cols = {n: self._right[n] for n in self._right.column_names()}
+        right_prep = self._right._build_rowwise(
+            {**right_cols, **dict(zip(lnames, self._right_on))}
+        )
+        id_from = None
+        if self._id_expr is not None:
+            ref = self._id_expr
+            if isinstance(ref, ColumnReference):
+                if ref.table is self._left or ref.table is left_ph:
+                    id_from = "left"
+                elif ref.table is self._right or ref.table is right_ph:
+                    id_from = "right"
+        node = nodes.JoinNode(
+            left_prep._node,
+            right_prep._node,
+            lnames,
+            lnames,
+            self._mode.value,
+            id_from=id_from,
+        )
+        return node, left_prep, right_prep
+
+    def select(self, *args: Any, **kwargs: Any):
+        joined, sub = self._joined_with_sub()
+
+        exprs: dict[str, ColumnExpression] = {}
+
+        def add_side(table, prefix):
+            for n in table.column_names():
+                if n.startswith("_on"):
+                    continue
+                exprs[n] = ColumnReference(joined, prefix + n)
+
+        for arg in args:
+            if isinstance(arg, ThisSlice):
+                raise NotImplementedError("slices in join select")
+            if isinstance(arg, ThisPlaceholder):
+                add_side(self._left, "l.")
+                add_side(self._right, "r.")
+            elif isinstance(arg, ColumnReference):
+                exprs[arg.name] = arg
+            else:
+                raise TypeError(arg)
+        for name, e in kwargs.items():
+            exprs[name] = wrap_expr(e)
+
+        resolved = {n: wrap_expr(e)._substitute(sub) for n, e in exprs.items()}
+        return joined.select(**resolved)
+
+    def _maybe_opt(self, d: dt.DType, side: str) -> dt.DType:
+        m = self._mode
+        if side == "l" and m in (JoinMode.RIGHT, JoinMode.OUTER):
+            return dt.Optional_(d)
+        if side == "r" and m in (JoinMode.LEFT, JoinMode.OUTER):
+            return dt.Optional_(d)
+        return d
+
+    def _joined_with_sub(self):
+        """Materialize the join with all columns of both sides, plus a
+        substitution function mapping left/right/this references onto it."""
+        if hasattr(self, "_joined_cache"):
+            joined = self._joined_cache
+            return joined, self._make_sub(joined)
+        from pathway_tpu.internals.table import Table
+
+        node, left_prep, right_prep = self._build()
+        joined = Table._from_node(
+            node,
+            {
+                **{
+                    "l." + n: self._maybe_opt(
+                        left_prep._schema[n].dtype, side="l"
+                    )
+                    for n in left_prep.column_names()
+                },
+                **{
+                    "r." + n: self._maybe_opt(
+                        right_prep._schema[n].dtype, side="r"
+                    )
+                    for n in right_prep.column_names()
+                },
+                "_left_id": dt.Optional_(dt.POINTER),
+                "_right_id": dt.Optional_(dt.POINTER),
+            },
+            Universe(),
+        )
+        self._joined_cache = joined
+        return joined, self._make_sub(joined)
+
+    def _make_sub(self, joined):
+        def sub(ref: ColumnReference) -> ColumnReference | None:
+            tbl = ref.table
+            if tbl is joined:
+                return None
+            if tbl is self._left or tbl is left_ph:
+                if ref.name == "id":
+                    return ColumnReference(joined, "_left_id")
+                return ColumnReference(joined, "l." + ref.name)
+            if tbl is self._right or tbl is right_ph:
+                if ref.name == "id":
+                    return ColumnReference(joined, "_right_id")
+                return ColumnReference(joined, "r." + ref.name)
+            if isinstance(tbl, ThisPlaceholder):
+                if ref.name == "id":
+                    return ColumnReference(joined, "id")
+                in_l = ref.name in self._left.column_names()
+                in_r = ref.name in self._right.column_names()
+                if in_l and in_r:
+                    raise ValueError(
+                        f"column {ref.name!r} is ambiguous in join; "
+                        "use pw.left/pw.right"
+                    )
+                if in_l:
+                    return ColumnReference(joined, "l." + ref.name)
+                if in_r:
+                    return ColumnReference(joined, "r." + ref.name)
+                raise ValueError(f"unknown column {ref.name!r} in join")
+            return None
+
+        return sub
+
+    def _resolve_in_joined(self, e):
+        joined, sub = self._joined_with_sub()
+        return wrap_expr(e)._substitute(sub)
+
+    def reduce(self, *args, **kwargs):
+        joined, _sub = self._joined_with_sub()
+        r_args = []
+        for a in args:
+            resolved = self._resolve_in_joined(a)
+            if isinstance(resolved, ColumnReference):
+                # keep the user-facing (unprefixed) output name
+                orig = a.name if isinstance(a, ColumnReference) else resolved.name
+                kwargs.setdefault(orig, resolved)
+            else:
+                r_args.append(resolved)
+        r_kwargs = {n: self._resolve_in_joined(e) for n, e in kwargs.items()}
+        return joined.groupby().reduce(*r_args, **r_kwargs)
+
+    def groupby(self, *args, id=None, **kwargs):
+        from pathway_tpu.internals.groupbys import GroupedJoinResult
+
+        joined, _sub = self._joined_with_sub()
+        grouping = [self._resolve_in_joined(a) for a in args]
+        gt = GroupedJoinResult(
+            joined,
+            grouping,
+            set_id=id is not None,
+        )
+        gt._join_result = self
+        return gt
+
+    def filter(self, expression):
+        import copy
+
+        joined, _sub = self._joined_with_sub()
+        filtered = joined.filter(self._resolve_in_joined(expression))
+        out = copy.copy(self)
+        out._joined_cache = filtered
+        return out
+
+
+class OuterJoinResult(JoinResult):
+    pass

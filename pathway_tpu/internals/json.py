@@ -1,0 +1,126 @@
+"""Json value type (reference: src/engine/value.rs Value::Json +
+python/pathway/internals/json.py)."""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+
+class Json:
+    """Immutable wrapper over a parsed JSON value."""
+
+    __slots__ = ("_value",)
+
+    NULL: "Json"
+
+    def __init__(self, value: Any = None):
+        if isinstance(value, Json):
+            value = value._value
+        self._value = value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    # --- parsing / dumping ---------------------------------------------------
+
+    @staticmethod
+    def parse(s: str | bytes) -> "Json":
+        return Json(_json.loads(s))
+
+    @staticmethod
+    def dumps(obj: Any) -> str:
+        if isinstance(obj, Json):
+            obj = obj.value
+        return _json.dumps(obj)
+
+    def to_string(self) -> str:
+        return _json.dumps(self._value)
+
+    # --- access --------------------------------------------------------------
+
+    def __getitem__(self, item: str | int) -> "Json":
+        v = self._value
+        if isinstance(item, int) and isinstance(v, list):
+            return Json(v[item])
+        if isinstance(v, dict):
+            return Json(v[item])
+        raise KeyError(item)
+
+    def get(self, item: str | int, default: Any = None) -> Any:
+        try:
+            return self[item]
+        except (KeyError, IndexError, TypeError):
+            return default
+
+    def __iter__(self):
+        v = self._value
+        if isinstance(v, list):
+            return (Json(x) for x in v)
+        if isinstance(v, dict):
+            return iter(v)
+        raise TypeError(f"Json value {v!r} is not iterable")
+
+    def __len__(self) -> int:
+        return len(self._value)
+
+    def __contains__(self, item: Any) -> bool:
+        return item in self._value
+
+    # --- conversions ----------------------------------------------------------
+
+    def as_int(self) -> int:
+        if isinstance(self._value, bool) or not isinstance(self._value, (int, float)):
+            raise ValueError(f"Json {self._value!r} is not an int")
+        return int(self._value)
+
+    def as_float(self) -> float:
+        if isinstance(self._value, bool) or not isinstance(self._value, (int, float)):
+            raise ValueError(f"Json {self._value!r} is not a float")
+        return float(self._value)
+
+    def as_str(self) -> str:
+        if not isinstance(self._value, str):
+            raise ValueError(f"Json {self._value!r} is not a str")
+        return self._value
+
+    def as_bool(self) -> bool:
+        if not isinstance(self._value, bool):
+            raise ValueError(f"Json {self._value!r} is not a bool")
+        return self._value
+
+    def as_list(self) -> list:
+        if not isinstance(self._value, list):
+            raise ValueError(f"Json {self._value!r} is not a list")
+        return self._value
+
+    def as_dict(self) -> dict:
+        if not isinstance(self._value, dict):
+            raise ValueError(f"Json {self._value!r} is not a dict")
+        return self._value
+
+    # --- dunder ---------------------------------------------------------------
+
+    def __repr__(self) -> str:
+        return f"pw.Json({self._value!r})"
+
+    def __str__(self) -> str:
+        return _json.dumps(self._value)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Json):
+            return self._value == other._value
+        return self._value == other
+
+    def __hash__(self) -> int:
+        try:
+            return hash(_json.dumps(self._value, sort_keys=True))
+        except TypeError:
+            return hash(repr(self._value))
+
+    def __bool__(self) -> bool:
+        return bool(self._value)
+
+
+Json.NULL = Json(None)

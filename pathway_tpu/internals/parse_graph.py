@@ -1,0 +1,30 @@
+"""Global parse graph (reference: python/pathway/internals/parse_graph.py:104).
+
+User code *declares* a dataflow; every io.write/subscribe registers an output
+node here. `pw.run` hands the registered outputs to the engine Runtime."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.nodes import Node, OutputNode
+
+
+class ParseGraph:
+    def __init__(self) -> None:
+        self.outputs: list[Node] = []
+        self.streaming_sources: list[Any] = []
+        self.post_run_hooks: list[Callable[[], None]] = []
+        self.runtime: Any = None  # set while a run is active
+
+    def add_output(self, node: Node) -> None:
+        self.outputs.append(node)
+
+    def clear(self) -> None:
+        self.outputs.clear()
+        self.streaming_sources.clear()
+        self.post_run_hooks.clear()
+        self.runtime = None
+
+
+G = ParseGraph()

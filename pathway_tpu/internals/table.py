@@ -1,0 +1,745 @@
+"""pw.Table — the declarative table API.
+
+Capability parity with the reference Table (/root/reference/python/pathway/
+internals/table.py:52: select:382, filter:490, groupby:942, reduce:1025,
+ix:1164, concat:1334, update_cells:1439, with_universe_of:2037, flatten:2089,
+sort:2157). Methods *declare* engine nodes (pathway_tpu/engine/nodes.py); the
+runtime executes them as columnar microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine import nodes
+from pathway_tpu.engine.expression_eval import InternalColRef
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.api import Pointer
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    PointerExpression,
+    wrap_expr,
+)
+from pathway_tpu.internals.thisclass import ThisPlaceholder, ThisSlice, this
+from pathway_tpu.internals.universe import Universe
+
+
+class TableLike:
+    _universe: Universe
+
+
+class Joinable(TableLike):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Expression desugaring / resolution helpers
+
+
+def desugar(e: Any, mapping: Mapping[ThisPlaceholder, "Table"]) -> ColumnExpression:
+    """Substitute pw.this / pw.left / pw.right placeholders with tables."""
+    e = wrap_expr(e)
+
+    def sub(ref: ColumnReference) -> ColumnExpression | None:
+        tbl = ref.table
+        if isinstance(tbl, ThisPlaceholder):
+            target = mapping.get(tbl)
+            if target is None:
+                raise ValueError(f"cannot resolve {tbl!r} in this context")
+            if ref.name == "id":
+                return ColumnReference(target, "id")
+            return target[ref.name]
+        if isinstance(tbl, ThisPlaceholder.__mro__[0]):
+            return None
+        return None
+
+    return e._substitute(sub)
+
+
+def _collect_tables(exprs: Iterable[ColumnExpression]) -> list["Table"]:
+    tables: list[Table] = []
+    for e in exprs:
+        for ref in e._dependencies():
+            tbl = ref.table
+            if isinstance(tbl, Table) and all(t is not tbl for t in tables):
+                tables.append(tbl)
+    return tables
+
+
+def resolve_to_internal(
+    exprs: Mapping[str, ColumnExpression], input_tables: Sequence["Table"]
+) -> dict[str, ColumnExpression]:
+    """Rewrite ColumnReferences into (input_index, name) InternalColRefs."""
+
+    def sub(ref: ColumnReference) -> ColumnExpression | None:
+        tbl = ref.table
+        for i, t in enumerate(input_tables):
+            if tbl is t:
+                return InternalColRef(i, ref.name)
+        raise ValueError(
+            f"expression references table {tbl!r} which is not an input "
+            "of this operation (universes may differ)"
+        )
+
+    return {name: e._substitute(sub) for name, e in exprs.items()}
+
+
+# ---------------------------------------------------------------------------
+# dtype inference (lightweight type interpreter —
+# reference: internals/type_interpreter.py)
+
+
+def infer_dtype(e: ColumnExpression, env) -> dt.DType:
+    if isinstance(e, ColumnReference):
+        if e.name == "id":
+            return dt.POINTER
+        return env(e)
+    if isinstance(e, InternalColRef):
+        return dt.ANY
+    if isinstance(e, expr_mod.ColumnConstExpression):
+        return dt.dtype_of_value(e._value)
+    if isinstance(e, expr_mod.ColumnBinaryOpExpression):
+        l = infer_dtype(e._left, env)
+        r = infer_dtype(e._right, env)
+        op = e._op
+        if op in ("==", "!=", "<", "<=", ">", ">="):
+            return dt.BOOL
+        if op == "/":
+            return dt.FLOAT
+        if op in ("&", "|", "^") and l == dt.BOOL and r == dt.BOOL:
+            return dt.BOOL
+        if op == "+" and (l == dt.STR or r == dt.STR):
+            return dt.STR
+        out = dt.lub(l, r)
+        return out
+    if isinstance(e, expr_mod.ColumnUnaryOpExpression):
+        return infer_dtype(e._expr, env)
+    if isinstance(e, expr_mod.IfElseExpression):
+        return dt.lub(infer_dtype(e._then, env), infer_dtype(e._else, env))
+    if isinstance(e, expr_mod.CoalesceExpression):
+        out = infer_dtype(e._args[-1], env)
+        for a in e._args[:-1]:
+            out = dt.lub(infer_dtype(a, env).strip_optional(), out)
+        return out
+    if isinstance(e, expr_mod.RequireExpression):
+        return dt.Optional_(infer_dtype(e._val, env))
+    if isinstance(e, expr_mod.FillErrorExpression):
+        return dt.lub(
+            infer_dtype(e._expr, env), infer_dtype(e._replacement, env)
+        )
+    if isinstance(e, (expr_mod.IsNoneExpression, expr_mod.IsNotNoneExpression)):
+        return dt.BOOL
+    if isinstance(e, expr_mod.UnwrapExpression):
+        return infer_dtype(e._expr, env).strip_optional()
+    if isinstance(e, (expr_mod.CastExpression, expr_mod.DeclareTypeExpression)):
+        return e._target
+    if isinstance(e, expr_mod.ConvertExpression):
+        return e._target
+    if isinstance(e, expr_mod.ToStringExpression):
+        return dt.STR
+    if isinstance(e, expr_mod.MakeTupleExpression):
+        return dt.TupleDType(tuple(infer_dtype(a, env) for a in e._args))
+    if isinstance(e, expr_mod.GetExpression):
+        inner = infer_dtype(e._expr, env).strip_optional()
+        if inner == dt.JSON:
+            return dt.Optional_(dt.JSON) if e._check_if_exists else dt.JSON
+        return dt.ANY
+    if isinstance(e, PointerExpression):
+        return dt.Optional_(dt.POINTER) if e._optional else dt.POINTER
+    if isinstance(e, expr_mod.MethodCallExpression):
+        return e._return_type
+    if isinstance(e, expr_mod.ApplyExpression):
+        return e._return_type
+    if isinstance(e, expr_mod.ReducerExpression):
+        from pathway_tpu.internals.reducer_descriptors import reducer_return_dtype
+
+        return reducer_return_dtype(e, env)
+    return dt.ANY
+
+
+# ---------------------------------------------------------------------------
+
+
+class Table(Joinable):
+    """A (possibly live) table: universe of keys + typed columns."""
+
+    def __init__(
+        self,
+        node: nodes.Node,
+        schema: schema_mod.SchemaMetaclass,
+        universe: Universe,
+    ):
+        assert list(schema.column_names()) == list(node.column_names), (
+            schema.column_names(),
+            node.column_names,
+        )
+        self._node = node
+        self._schema = schema
+        self._universe = universe
+
+    # --- metadata -------------------------------------------------------------
+
+    @property
+    def schema(self) -> schema_mod.SchemaMetaclass:
+        return self._schema
+
+    @property
+    def id(self) -> ColumnReference:
+        return ColumnReference(self, "id")
+
+    def column_names(self) -> list[str]:
+        return list(self._schema.column_names())
+
+    def keys(self):
+        return self.column_names()
+
+    @property
+    def C(self) -> "Table":
+        return self
+
+    def typehints(self) -> dict[str, Any]:
+        return self._schema.typehints()
+
+    def __repr__(self) -> str:
+        cols = ", ".join(self.column_names())
+        return f"<pw.Table#{self._node.id}({cols})>"
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._schema.__columns__:
+            raise AttributeError(
+                f"Table has no column {name!r}; columns: {self.column_names()}"
+            )
+        return ColumnReference(self, name)
+
+    def __getitem__(self, arg):
+        if isinstance(arg, str):
+            if arg == "id":
+                return self.id
+            if arg not in self._schema.__columns__:
+                raise KeyError(arg)
+            return ColumnReference(self, arg)
+        if isinstance(arg, ColumnReference):
+            return ColumnReference(self, arg.name)
+        if isinstance(arg, (list, tuple)):
+            refs = [self[c] for c in arg]
+            return self.select(*refs)
+        raise TypeError(arg)
+
+    def __iter__(self):
+        raise TypeError("Table is not iterable; use pw.debug.table_to_pandas")
+
+    # --- internal constructors ------------------------------------------------
+
+    @staticmethod
+    def _from_node(
+        node: nodes.Node,
+        dtypes: Mapping[str, dt.DType],
+        universe: Universe,
+    ) -> "Table":
+        cols = {
+            name: schema_mod.ColumnSchema(name=name, dtype=d)
+            for name, d in dtypes.items()
+        }
+        schema = schema_mod.schema_from_columns(cols)
+        return Table(node, schema, universe)
+
+    def _dtype_env(self):
+        def env(ref: ColumnReference) -> dt.DType:
+            tbl = ref.table
+            if isinstance(tbl, Table):
+                if ref.name == "id":
+                    return dt.POINTER
+                cs = tbl._schema.__columns__.get(ref.name)
+                return cs.dtype if cs else dt.ANY
+            return dt.ANY
+
+        return env
+
+    def _desugar(self, e: Any) -> ColumnExpression:
+        return desugar(e, {this: self})
+
+    def _build_rowwise(
+        self,
+        exprs: dict[str, ColumnExpression],
+        universe: Universe | None = None,
+        deterministic: bool = True,
+    ) -> "Table":
+        exprs = {n: self._desugar(e) for n, e in exprs.items()}
+        tables = _collect_tables(exprs.values())
+        if self in tables:
+            tables.remove(self)
+        input_tables = [self] + tables
+        for t in tables:
+            if t._universe is not self._universe and not (
+                self._universe.is_subset_of(t._universe)
+            ):
+                # allow: reference requires same universe; we allow subset reads
+                pass
+        env = self._dtype_env()
+        dtypes = {name: infer_dtype(e, env) for name, e in exprs.items()}
+        internal = resolve_to_internal(exprs, input_tables)
+        node = nodes.RowwiseNode(
+            [t._node for t in input_tables], internal, deterministic=deterministic
+        )
+        return Table._from_node(node, dtypes, universe or self._universe)
+
+    # --- core ops -------------------------------------------------------------
+
+    def select(self, *args: Any, **kwargs: Any) -> "Table":
+        exprs: dict[str, ColumnExpression] = {}
+        for arg in args:
+            if isinstance(arg, ThisSlice):
+                for n, ref in arg.resolve(self).items():
+                    exprs[n] = ref
+            elif isinstance(arg, ThisPlaceholder):
+                for n in self.column_names():
+                    exprs[n] = self[n]
+            elif isinstance(arg, ColumnReference):
+                if isinstance(arg.table, ThisPlaceholder):
+                    exprs[arg.name] = self[arg.name]
+                else:
+                    exprs[arg.name] = arg
+            elif isinstance(arg, Table):
+                for n in arg.column_names():
+                    exprs[n] = arg[n]
+            else:
+                raise TypeError(f"positional select argument {arg!r}")
+        for name, e in kwargs.items():
+            exprs[name] = wrap_expr(e)
+        return self._build_rowwise(exprs)
+
+    def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        exprs: dict[str, Any] = {n: self[n] for n in self.column_names()}
+        for arg in args:
+            if isinstance(arg, ColumnReference):
+                exprs[arg.name] = arg
+            elif isinstance(arg, ThisSlice):
+                exprs.update(arg.resolve(self))
+            elif isinstance(arg, Table):
+                for n in arg.column_names():
+                    exprs[n] = arg[n]
+        exprs.update(kwargs)
+        return self.select(**exprs)
+
+    def without(self, *columns: Any) -> "Table":
+        drop = {c if isinstance(c, str) else c.name for c in columns}
+        keep = [c for c in self.column_names() if c not in drop]
+        return self.select(*[self[c] for c in keep])
+
+    def rename(self, names_mapping: Mapping | None = None, **kwargs) -> "Table":
+        if names_mapping is not None:
+            return self.rename_by_dict(names_mapping)
+        return self.rename_columns(**kwargs)
+
+    def rename_columns(self, **kwargs: Any) -> "Table":
+        # new_name=old_ref
+        mapping = {}
+        for new, old in kwargs.items():
+            old_name = old if isinstance(old, str) else old.name
+            mapping[old_name] = new
+        return self.rename_by_dict(mapping)
+
+    def rename_by_dict(self, names_mapping: Mapping) -> "Table":
+        mapping = {
+            (k if isinstance(k, str) else k.name): (
+                v if isinstance(v, str) else v.name
+            )
+            for k, v in names_mapping.items()
+        }
+        exprs = {
+            mapping.get(n, n): self[n] for n in self.column_names()
+        }
+        return self.select(**exprs)
+
+    def filter(self, filter_expression: Any) -> "Table":
+        e = self._desugar(filter_expression)
+        tables = _collect_tables([e])
+        if any(t is not self for t in tables):
+            # precompute the predicate as a column on self's universe
+            with_pred = self._build_rowwise(
+                {**{n: self[n] for n in self.column_names()}, "_pred": e}
+            )
+            filtered = with_pred.filter(with_pred._pred)
+            return filtered.without("_pred")
+        internal = resolve_to_internal({"p": e}, [self])["p"]
+        node = nodes.FilterNode(self._node, internal)
+        out = Table(
+            node, self._schema, self._universe.subset()
+        )
+        return out
+
+    def copy(self) -> "Table":
+        return self.select(*[self[n] for n in self.column_names()])
+
+    # --- ids ------------------------------------------------------------------
+
+    def pointer_from(
+        self, *args: Any, optional: bool = False, instance: Any = None
+    ) -> ColumnExpression:
+        return PointerExpression(
+            self, *args, optional=optional, instance=instance
+        )
+
+    def with_id(self, new_index: ColumnReference) -> "Table":
+        e = self._desugar(new_index)
+        internal = resolve_to_internal({"k": e}, [self])["k"]
+        node = nodes.ReindexNode(self._node, internal)
+        return Table(node, self._schema, Universe())
+
+    def with_id_from(self, *args: Any, instance: Any = None) -> "Table":
+        e = self._desugar(
+            PointerExpression(self, *args, instance=instance)
+        )
+        internal = resolve_to_internal({"k": e}, [self])["k"]
+        node = nodes.ReindexNode(self._node, internal)
+        return Table(node, self._schema, Universe())
+
+    # --- groupby / reduce -----------------------------------------------------
+
+    def groupby(
+        self,
+        *args: Any,
+        id: ColumnReference | None = None,
+        sort_by: Any = None,
+        _skip_errors: bool = True,
+        instance: Any = None,
+        **kwargs,
+    ):
+        from pathway_tpu.internals.groupbys import GroupedTable
+
+        grouping = []
+        for a in args:
+            a = self._desugar(a)
+            grouping.append(a)
+        if id is not None:
+            grouping = [self._desugar(id)]
+        return GroupedTable(
+            self, grouping, instance=self._desugar(instance) if instance is not None else None,
+            set_id=id is not None, sort_by=sort_by
+        )
+
+    def reduce(self, *args: Any, **kwargs: Any) -> "Table":
+        return self.groupby().reduce(*args, **kwargs)
+
+    def deduplicate(
+        self,
+        *,
+        value: Any = None,
+        instance: Any = None,
+        acceptor: Any = None,
+        name: str | None = None,
+        persistent_id: str | None = None,
+    ) -> "Table":
+        cols = {n: self[n] for n in self.column_names()}
+        extra: dict[str, Any] = {}
+        value_col = None
+        inst_cols: list[str] = []
+        if value is not None:
+            extra["_value"] = self._desugar(value)
+            value_col = "_value"
+        if instance is not None:
+            extra["_instance"] = self._desugar(instance)
+            inst_cols = ["_instance"]
+        prep = self._build_rowwise({**cols, **extra})
+        node = nodes.DeduplicateNode(
+            prep._node,
+            inst_cols,
+            acceptor,
+            value_col,
+        )
+        out = Table._from_node(
+            node,
+            {n: prep._schema[n].dtype for n in prep.column_names()},
+            Universe(),
+        )
+        keep = [c for c in out.column_names() if not c.startswith("_")]
+        result = out.select(*[out[c] for c in keep])
+        return result
+
+    # --- joins ----------------------------------------------------------------
+
+    def join(self, other: "Table", *on: Any, id: Any = None, how: Any = None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        mode = how if how is not None else JoinMode.INNER
+        return JoinResult(self, other, on, mode, id)
+
+    def join_inner(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, JoinMode.INNER, id)
+
+    def join_left(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, JoinMode.LEFT, id)
+
+    def join_right(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, JoinMode.RIGHT, id)
+
+    def join_outer(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, JoinMode.OUTER, id)
+
+    # --- set ops --------------------------------------------------------------
+
+    def concat(self, *others: "Table") -> "Table":
+        tables = [self] + list(others)
+        names = self.column_names()
+        aligned = [t.select(*[t[n] for n in names]) for t in tables]
+        node = nodes.ConcatNode([t._node for t in aligned])
+        dtypes = {}
+        for n in names:
+            out = self._schema[n].dtype
+            for t in others:
+                out = dt.lub(out, t._schema[n].dtype)
+            dtypes[n] = out
+        return Table._from_node(node, dtypes, Universe())
+
+    def concat_reindex(self, *others: "Table") -> "Table":
+        tables = [self] + list(others)
+        reindexed = [
+            t.with_id_from(t.id, i) for i, t in enumerate(tables)
+        ]
+        return reindexed[0].concat(*reindexed[1:])
+
+    def update_rows(self, other: "Table") -> "Table":
+        names = self.column_names()
+        other_aligned = other.select(*[other[n] for n in names])
+        node = nodes.UpdateRowsNode(self._node, other_aligned._node)
+        dtypes = {
+            n: dt.lub(self._schema[n].dtype, other._schema[n].dtype)
+            for n in names
+        }
+        return Table._from_node(node, dtypes, Universe())
+
+    def update_cells(self, other: "Table") -> "Table":
+        # columns of `other` override; other's universe ⊆ self's
+        names = self.column_names()
+        override = [n for n in other.column_names() if n in names]
+        exprs: dict[str, Any] = {n: self[n] for n in names}
+        from pathway_tpu.internals.common import coalesce
+
+        for n in override:
+            exprs[n] = _CellUpdate(self[n], other[n])
+        return self._build_rowwise(exprs)
+
+    def __lshift__(self, other: "Table") -> "Table":
+        return self.update_cells(other)
+
+    def intersect(self, *tables: "Table") -> "Table":
+        node = nodes.UniverseSetOpNode(
+            self._node, [t._node for t in tables], "intersect"
+        )
+        return Table(node, self._schema, self._universe.subset())
+
+    def difference(self, other: "Table") -> "Table":
+        node = nodes.UniverseSetOpNode(self._node, [other._node], "difference")
+        return Table(node, self._schema, self._universe.subset())
+
+    def restrict(self, other: TableLike) -> "Table":
+        node = nodes.UniverseSetOpNode(
+            self._node, [other._node], "restrict"  # type: ignore[attr-defined]
+        )
+        return Table(node, self._schema, other._universe)
+
+    def having(self, *indexers: ColumnReference) -> "Table":
+        out = self
+        for ix in indexers:
+            tbl = ix.table
+            keyed = tbl.with_id(ix)
+            node = nodes.UniverseSetOpNode(out._node, [keyed._node], "restrict")
+            out = Table(node, out._schema, out._universe.subset())
+        return out
+
+    def with_universe_of(self, other: TableLike) -> "Table":
+        node = nodes.UniverseSetOpNode(
+            self._node, [other._node], "restrict"  # type: ignore[attr-defined]
+        )
+        return Table(node, self._schema, other._universe)
+
+    # --- ix -------------------------------------------------------------------
+
+    def ix(
+        self,
+        expression: ColumnExpression,
+        *,
+        optional: bool = False,
+        context=None,
+        allow_misses: bool = False,
+    ) -> "Table":
+        e = expression
+        tables = _collect_tables([wrap_expr(e)])
+        if tables:
+            indexer = tables[0]
+        elif context is not None:
+            indexer = context
+        elif isinstance(e, PointerExpression) and isinstance(e._table, Table):
+            indexer = e._table
+        else:
+            raise ValueError("ix requires a column expression with a table")
+        prep = indexer._build_rowwise({"_ptr": e})
+        node = nodes.IxNode(
+            prep._node, "_ptr", self._node, optional or allow_misses
+        )
+        dtypes = {n: self._schema[n].dtype for n in self.column_names()}
+        if optional:
+            dtypes = {n: dt.Optional_(d) for n, d in dtypes.items()}
+        return Table._from_node(node, dtypes, indexer._universe)
+
+    def ix_ref(
+        self,
+        *args: Any,
+        optional: bool = False,
+        context=None,
+        instance: Any = None,
+    ) -> "Table":
+        if context is None:
+            context = self
+        ptr = context.pointer_from(*args, instance=instance)
+        return self.ix(ptr, optional=optional, context=context)
+
+    # --- restructuring --------------------------------------------------------
+
+    def flatten(self, *args: ColumnReference, **kwargs) -> "Table":
+        assert len(args) == 1, "flatten takes exactly one column"
+        to_flatten = args[0]
+        name = to_flatten.name
+        prep = self.select(*[self[n] for n in self.column_names()])
+        node = nodes.FlattenNode(prep._node, name)
+        inner = prep._schema[name].dtype
+        if isinstance(inner, (dt.ListDType,)):
+            item_dt = inner.wrapped
+        elif isinstance(inner, dt.TupleDType) and inner.args:
+            item_dt = inner.args[0]
+        elif inner == dt.STR:
+            item_dt = dt.STR
+        else:
+            item_dt = dt.ANY
+        dtypes = {
+            n: (item_dt if n == name else prep._schema[n].dtype)
+            for n in prep.column_names()
+        }
+        return Table._from_node(node, dtypes, Universe())
+
+    def sort(
+        self,
+        key: ColumnExpression,
+        instance: ColumnExpression | None = None,
+    ) -> "Table":
+        exprs: dict[str, Any] = {"_key": key}
+        if instance is not None:
+            exprs["_instance"] = instance
+        prep = self._build_rowwise(exprs)
+        node = nodes.SortNode(
+            prep._node, "_key", "_instance" if instance is not None else None
+        )
+        return Table._from_node(
+            node,
+            {
+                "prev": dt.Optional_(dt.POINTER),
+                "next": dt.Optional_(dt.POINTER),
+            },
+            self._universe,
+        )
+
+    def diff(
+        self,
+        timestamp: ColumnExpression,
+        *values: ColumnReference,
+        instance: ColumnExpression | None = None,
+    ) -> "Table":
+        from pathway_tpu.stdlib.ordered import diff as _diff
+
+        return _diff(self, timestamp, *values, instance=instance)
+
+    # --- typing ---------------------------------------------------------------
+
+    def cast_to_types(self, **kwargs: Any) -> "Table":
+        exprs = {n: self[n] for n in self.column_names()}
+        for name, target in kwargs.items():
+            exprs[name] = expr_mod.CastExpression(target, self[name])
+        return self.select(**exprs)
+
+    def update_types(self, **kwargs: Any) -> "Table":
+        exprs = {n: self[n] for n in self.column_names()}
+        for name, target in kwargs.items():
+            exprs[name] = expr_mod.DeclareTypeExpression(target, self[name])
+        return self.select(**exprs)
+
+    # --- promises (metadata-only, parity surface) -----------------------------
+
+    def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        return self
+
+    def promise_universes_are_equal(self, other: "Table") -> "Table":
+        return self
+
+    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        self._universe = other._universe.subset()
+        return self
+
+    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
+        self._universe = other._universe
+        return self
+
+    def _set_universe(self, universe: Universe) -> "Table":
+        self._universe = universe
+        return self
+
+    # --- output helpers -------------------------------------------------------
+
+    def _subscribe_node(self, on_batch, on_end=None) -> nodes.OutputNode:
+        return nodes.OutputNode(self._node, on_batch, on_end)
+
+    # --- interactive sugar ----------------------------------------------------
+
+    def slice(self):
+        from pathway_tpu.internals.table_slice import TableSlice
+
+        return TableSlice(self)
+
+
+def _CellUpdate(left_ref, right_ref):
+    """update_cells: use right value when the right table has the row."""
+    from pathway_tpu.internals.expression import CoalesceExpression
+
+    # right table's universe is a subset; missing rows read as None
+    return CoalesceExpression(right_ref, left_ref)
+
+
+# free functions mirroring reference module-level joins/groupby
+
+
+def join(left: Table, right: Table, *on, id=None, how=None, **kwargs):
+    return left.join(right, *on, id=id, how=how, **kwargs)
+
+
+def join_inner(left: Table, right: Table, *on, **kwargs):
+    return left.join_inner(right, *on, **kwargs)
+
+
+def join_left(left: Table, right: Table, *on, **kwargs):
+    return left.join_left(right, *on, **kwargs)
+
+
+def join_right(left: Table, right: Table, *on, **kwargs):
+    return left.join_right(right, *on, **kwargs)
+
+
+def join_outer(left: Table, right: Table, *on, **kwargs):
+    return left.join_outer(right, *on, **kwargs)
+
+
+def groupby(table: Table, *args, **kwargs):
+    return table.groupby(*args, **kwargs)

@@ -1,0 +1,69 @@
+"""`pw.this` / `pw.left` / `pw.right` placeholders
+(reference: python/pathway/internals/thisclass.py). Attribute access returns
+ColumnReferences bound to the placeholder; desugaring substitutes the actual
+table when the expression reaches a table operation."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnReference, PointerExpression
+
+
+class ThisPlaceholder:
+    def __init__(self, kind: str):
+        self._kind = kind
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return ColumnReference(self, name)
+
+    def __getitem__(self, name) -> Any:
+        if isinstance(name, str):
+            return ColumnReference(self, name)
+        if isinstance(name, (list, tuple)):
+            return ThisSlice(self, [c if isinstance(c, str) else c.name for c in name])
+        raise TypeError(name)
+
+    @property
+    def id(self) -> ColumnReference:
+        return ColumnReference(self, "id")
+
+    def pointer_from(self, *args, optional: bool = False, instance=None):
+        return PointerExpression(self, *args, optional=optional, instance=instance)
+
+    def without(self, *columns) -> "ThisSlice":
+        names = [c if isinstance(c, str) else c.name for c in columns]
+        return ThisSlice(self, None, without=names)
+
+    def __repr__(self) -> str:
+        return f"pw.{self._kind}"
+
+    def __iter__(self):
+        raise TypeError(f"pw.{self._kind} is not iterable")
+
+
+class ThisSlice:
+    """`pw.this[["a","b"]]` or `pw.this.without(...)` — resolved against the
+    target table at desugaring time."""
+
+    def __init__(self, parent: ThisPlaceholder, names: list[str] | None, without=None):
+        self._parent = parent
+        self._names = names
+        self._without = without or []
+
+    def resolve(self, table) -> dict[str, ColumnReference]:
+        names = self._names
+        if names is None:
+            names = [c for c in table.column_names() if c not in self._without]
+        return {n: table[n] for n in names}
+
+
+this = ThisPlaceholder("this")
+left = ThisPlaceholder("left")
+right = ThisPlaceholder("right")
+
+
+def is_this_like(obj: Any) -> bool:
+    return isinstance(obj, ThisPlaceholder)

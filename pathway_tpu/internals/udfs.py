@@ -1,0 +1,258 @@
+"""@pw.udf — user-defined functions
+(reference: python/pathway/udfs.py + internals/udfs/). Sync UDFs evaluate
+batched on the host feed path; async UDFs gather per-row coroutines with
+capacity/timeout/retry policies."""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+import typing
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+
+
+class CacheStrategy:
+    pass
+
+
+class DiskCache(CacheStrategy):
+    def __init__(self, name: str | None = None):
+        self.name = name
+
+
+class InMemoryCache(CacheStrategy):
+    pass
+
+
+class DefaultCache(DiskCache):
+    pass
+
+
+class AsyncRetryStrategy:
+    pass
+
+
+class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
+    def __init__(
+        self,
+        max_retries: int = 3,
+        initial_delay_ms: int = 1000,
+        backoff_factor: float = 2.0,
+        jitter_ms: int = 300,
+    ):
+        self.max_retries = max_retries
+        self.initial_delay_ms = initial_delay_ms
+        self.backoff_factor = backoff_factor
+        self.jitter_ms = jitter_ms
+
+
+class FixedDelayRetryStrategy(ExponentialBackoffRetryStrategy):
+    def __init__(self, max_retries: int = 3, delay_ms: int = 1000):
+        super().__init__(max_retries, delay_ms, 1.0, 0)
+
+
+class NoRetryStrategy(AsyncRetryStrategy):
+    pass
+
+
+def async_options(**kwargs):
+    def wrapper(fn):
+        return fn
+
+    return wrapper
+
+
+def coerce_async(fn: Callable) -> Callable:
+    if asyncio.iscoroutinefunction(fn):
+        return fn
+
+    @functools.wraps(fn)
+    async def wrapper(*args, **kwargs):
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def with_cache_strategy(fn, cache_strategy):
+    memo: dict = {}
+
+    if asyncio.iscoroutinefunction(fn):
+
+        @functools.wraps(fn)
+        async def cached_async(*args):
+            key = args
+            if key in memo:
+                return memo[key]
+            result = await fn(*args)
+            memo[key] = result
+            return result
+
+        return cached_async
+
+    @functools.wraps(fn)
+    def cached(*args):
+        key = args
+        if key in memo:
+            return memo[key]
+        result = fn(*args)
+        memo[key] = result
+        return result
+
+    return cached
+
+
+def with_retry_strategy(fn, retry_strategy: AsyncRetryStrategy):
+    if isinstance(retry_strategy, NoRetryStrategy) or not isinstance(
+        retry_strategy, ExponentialBackoffRetryStrategy
+    ):
+        return fn
+
+    @functools.wraps(fn)
+    async def retried(*args, **kwargs):
+        delay = retry_strategy.initial_delay_ms / 1000
+        last: Exception | None = None
+        for attempt in range(retry_strategy.max_retries + 1):
+            try:
+                return await fn(*args, **kwargs)
+            except Exception as exc:
+                last = exc
+                if attempt == retry_strategy.max_retries:
+                    raise
+                await asyncio.sleep(delay)
+                delay *= retry_strategy.backoff_factor
+        raise last  # pragma: no cover
+
+    return retried
+
+
+class UDF:
+    """Base class for user-defined functions
+    (subclass with __wrapped__, or produced by @pw.udf)."""
+
+    def __init__(
+        self,
+        *,
+        return_type: Any = None,
+        deterministic: bool = False,
+        propagate_none: bool = False,
+        executor: Any = None,
+        cache_strategy: CacheStrategy | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        timeout: float | None = None,
+        max_batch_size: int | None = None,
+        **kwargs: Any,
+    ):
+        self._return_type = return_type
+        self._deterministic = deterministic
+        self._propagate_none = propagate_none
+        self._cache_strategy = cache_strategy
+        self._retry_strategy = retry_strategy
+        self._timeout = timeout
+        self._max_batch_size = max_batch_size
+        if hasattr(self, "__wrapped__"):
+            self._prepare(self.__wrapped__)
+
+    def _prepare(self, fn: Callable) -> None:
+        self._fn_raw = fn
+        self._is_async = asyncio.iscoroutinefunction(fn)
+        fn2 = fn
+        if self._cache_strategy is not None:
+            fn2 = with_cache_strategy(fn2, self._cache_strategy)
+        if self._is_async and self._retry_strategy is not None:
+            fn2 = with_retry_strategy(fn2, self._retry_strategy)
+        if self._is_async and self._timeout is not None:
+            inner = fn2
+
+            @functools.wraps(fn)
+            async def timed(*args, **kwargs):
+                return await asyncio.wait_for(
+                    inner(*args, **kwargs), timeout=self._timeout
+                )
+
+            fn2 = timed
+        self._fn = fn2
+        if self._return_type is None:
+            try:
+                hints = typing.get_type_hints(fn)
+                self._return_type = hints.get("return", Any)
+            except Exception:
+                self._return_type = Any
+
+    @property
+    def func(self) -> Callable:
+        return self._fn_raw
+
+    def __call__(self, *args: Any, **kwargs: Any) -> expr_mod.ColumnExpression:
+        if not hasattr(self, "_fn"):
+            self._prepare(self.__wrapped__)  # type: ignore[attr-defined]
+        cls = (
+            expr_mod.AsyncApplyExpression
+            if self._is_async
+            else expr_mod.ApplyExpression
+        )
+        return cls(
+            self._fn,
+            self._return_type,
+            self._propagate_none,
+            self._deterministic,
+            args,
+            kwargs,
+            max_batch_size=self._max_batch_size,
+        )
+
+
+def udf(
+    fn: Callable | None = None,
+    /,
+    *,
+    return_type: Any = None,
+    deterministic: bool = False,
+    propagate_none: bool = False,
+    executor: Any = None,
+    cache_strategy: CacheStrategy | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+    timeout: float | None = None,
+    max_batch_size: int | None = None,
+    **kwargs: Any,
+):
+    """Decorator turning a function into a column-expression builder."""
+
+    def make(f: Callable) -> UDF:
+        u = UDF(
+            return_type=return_type,
+            deterministic=deterministic,
+            propagate_none=propagate_none,
+            executor=executor,
+            cache_strategy=cache_strategy,
+            retry_strategy=retry_strategy,
+            timeout=timeout,
+            max_batch_size=max_batch_size,
+        )
+        u._prepare(f)
+        functools.update_wrapper(u, f, updated=[])
+        return u
+
+    if fn is not None:
+        return make(fn)
+    return make
+
+
+# executors façade (reference: internals/udfs/executors.py)
+def auto_executor():
+    return None
+
+
+def sync_executor():
+    return None
+
+
+def async_executor(capacity: int | None = None, timeout: float | None = None):
+    return None
+
+
+def fully_async_executor(**kwargs):
+    return None

@@ -1,0 +1,35 @@
+"""Universes: identity of key sets
+(reference: python/pathway/internals/universe.py + universe_solver.py).
+
+Tracked structurally: operations that keep keys share the Universe object;
+subset/superset promises are recorded but enforcement is best-effort (the
+reference solves these with a constraint solver; here they gate the same API
+surface)."""
+
+from __future__ import annotations
+
+import itertools
+
+_counter = itertools.count()
+
+
+class Universe:
+    __slots__ = ("id", "parent")
+
+    def __init__(self, parent: "Universe | None" = None):
+        self.id = next(_counter)
+        self.parent = parent
+
+    def subset(self) -> "Universe":
+        return Universe(parent=self)
+
+    def is_subset_of(self, other: "Universe") -> bool:
+        u: Universe | None = self
+        while u is not None:
+            if u is other:
+                return True
+            u = u.parent
+        return False
+
+    def __repr__(self) -> str:
+        return f"U{self.id}"

@@ -1,0 +1,52 @@
+"""pw.io — connector façade (reference: python/pathway/io/__init__.py:35-67,
+28 modules). Local/file/python/http connectors are native here; cloud-service
+connectors (kafka, s3, ...) share the same reader/writer framework."""
+
+from __future__ import annotations
+
+from pathway_tpu.io import csv, fs, jsonlines, plaintext, python
+from pathway_tpu.io._subscribe import subscribe
+
+__all__ = [
+    "csv",
+    "fs",
+    "jsonlines",
+    "plaintext",
+    "python",
+    "subscribe",
+    "http",
+]
+
+
+def __getattr__(name: str):
+    # lazily import heavier / optional connector modules
+    import importlib
+
+    known = {
+        "http",
+        "kafka",
+        "redpanda",
+        "debezium",
+        "postgres",
+        "elasticsearch",
+        "mongodb",
+        "nats",
+        "sqlite",
+        "deltalake",
+        "iceberg",
+        "bigquery",
+        "pubsub",
+        "gdrive",
+        "s3",
+        "s3_csv",
+        "minio",
+        "airbyte",
+        "null",
+        "slack",
+        "logstash",
+        "pyfilesystem",
+        "sharepoint",
+    }
+    if name in known:
+        return importlib.import_module(f"pathway_tpu.io.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,317 @@
+"""Filesystem connector (reference: python/pathway/io/fs + Rust posix-like
+scanner, src/connectors/scanner/filesystem.rs:146). Static mode reads once;
+streaming mode polls the path for new/changed files and feeds diffs."""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob
+import json as _json
+import os
+import threading
+import time
+from typing import Any, Iterable, Sequence
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import InputNode
+from pathway_tpu.engine.runtime import StaticSource, StreamingSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph
+from pathway_tpu.internals.api import ref_scalar, sequential_key
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+def _list_files(path: str, with_metadata_glob: str | None = None) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return out
+    if any(ch in path for ch in "*?["):
+        return sorted(glob.glob(path))
+    if os.path.exists(path):
+        return [path]
+    return []
+
+
+def _parse_file(
+    fpath: str,
+    format: str,
+    schema,
+    csv_settings=None,
+    with_metadata: bool = False,
+) -> Iterable[tuple]:
+    """Yield (pk_values, values_tuple) rows."""
+    if format in ("plaintext", "plaintext_by_file"):
+        if format == "plaintext_by_file":
+            with open(fpath, "r", errors="replace") as f:
+                yield (fpath,), (f.read(),)
+        else:
+            with open(fpath, "r", errors="replace") as f:
+                for i, line in enumerate(f):
+                    line = line.rstrip("\n")
+                    yield (fpath, i), (line,)
+        return
+    if format == "binary":
+        with open(fpath, "rb") as f:
+            yield (fpath,), (f.read(),)
+        return
+    col_names = list(schema.column_names()) if schema else None
+    if format == "csv":
+        delim = ","
+        if csv_settings is not None:
+            delim = getattr(csv_settings, "delimiter", ",")
+        with open(fpath, newline="") as f:
+            reader = _csv.DictReader(f, delimiter=delim)
+            for i, row in enumerate(reader):
+                names = col_names or list(row.keys())
+                vals = tuple(
+                    _coerce(row.get(n), schema, n) for n in names
+                )
+                yield (fpath, i), vals
+        return
+    if format in ("json", "jsonlines"):
+        with open(fpath, "r") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                obj = _json.loads(line)
+                names = col_names or list(obj.keys())
+                vals = tuple(_coerce_json(obj.get(n), schema, n) for n in names)
+                yield (fpath, i), vals
+        return
+    raise ValueError(f"unknown format {format!r}")
+
+
+def _coerce(v: Any, schema, name: str) -> Any:
+    if v is None:
+        return None
+    if schema is None:
+        return v
+    d = schema.dtypes().get(name, dt.ANY).strip_optional()
+    try:
+        if d == dt.INT:
+            return int(v)
+        if d == dt.FLOAT:
+            return float(v)
+        if d == dt.BOOL:
+            return v if isinstance(v, bool) else v.lower() in ("true", "1")
+        if d == dt.STR:
+            return str(v)
+        if d == dt.JSON:
+            return Json(_json.loads(v) if isinstance(v, str) else v)
+    except (ValueError, TypeError):
+        return None
+    return v
+
+
+def _coerce_json(v: Any, schema, name: str) -> Any:
+    if schema is None:
+        return v
+    d = schema.dtypes().get(name, dt.ANY).strip_optional()
+    if d == dt.JSON:
+        return Json(v)
+    if d == dt.FLOAT and isinstance(v, int):
+        return float(v)
+    if isinstance(v, (list, dict)) and d not in (dt.JSON,):
+        return Json(v)
+    return v
+
+
+class _FsStaticSource(StaticSource):
+    def __init__(self, path, format, schema, column_names, csv_settings, pk_cols):
+        super().__init__(column_names)
+        self.path = path
+        self.format = format
+        self.schema = schema
+        self.csv_settings = csv_settings
+        self.pk_cols = pk_cols
+
+    def events(self):
+        rows = []
+        counter = 0
+        for fpath in _list_files(self.path):
+            for pk, vals in _parse_file(
+                fpath, self.format, self.schema, self.csv_settings
+            ):
+                if self.pk_cols:
+                    key = int(
+                        ref_scalar(
+                            *[
+                                vals[self.column_names.index(c)]
+                                for c in self.pk_cols
+                            ]
+                        )
+                    )
+                else:
+                    key = int(ref_scalar(*pk))
+                rows.append((key, 1, vals))
+                counter += 1
+        if rows:
+            yield 0, DiffBatch.from_rows(rows, self.column_names)
+
+
+class _FsStreamingSource(StreamingSource):
+    def __init__(
+        self,
+        path,
+        format,
+        schema,
+        column_names,
+        csv_settings,
+        pk_cols,
+        refresh_s: float = 0.2,
+        with_deletions: bool = True,
+    ):
+        super().__init__(column_names)
+        self.path = path
+        self.format = format
+        self.schema = schema
+        self.csv_settings = csv_settings
+        self.pk_cols = pk_cols
+        self.refresh_s = refresh_s
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._seen: dict[str, tuple[float, int]] = {}  # path -> (mtime, size)
+        self._emitted: dict[str, list] = {}  # path -> [(key, vals)]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _scan_once(self):
+        for fpath in _list_files(self.path):
+            try:
+                st = os.stat(fpath)
+            except OSError:
+                continue
+            sig = (st.st_mtime, st.st_size)
+            if self._seen.get(fpath) == sig:
+                continue
+            self._seen[fpath] = sig
+            # retract previous version of this file
+            for key, vals in self._emitted.get(fpath, []):
+                self.session.remove(key, vals)
+            emitted = []
+            try:
+                for pk, vals in _parse_file(
+                    fpath, self.format, self.schema, self.csv_settings
+                ):
+                    if self.pk_cols:
+                        key = int(
+                            ref_scalar(
+                                *[
+                                    vals[self.column_names.index(c)]
+                                    for c in self.pk_cols
+                                ]
+                            )
+                        )
+                    else:
+                        key = int(ref_scalar(*pk))
+                    self.session.insert(key, vals)
+                    emitted.append((key, vals))
+            except OSError:
+                continue
+            self._emitted[fpath] = emitted
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._scan_once()
+            self._stop.wait(self.refresh_s)
+
+
+def read(
+    path: str,
+    *,
+    format: str = "csv",
+    schema: Any = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    json_field_paths: Any = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if format in ("plaintext", "plaintext_by_file"):
+        column_names = ["data"]
+        dtypes = {"data": dt.STR}
+        schema_ = None
+    elif format == "binary":
+        column_names = ["data"]
+        dtypes = {"data": dt.BYTES}
+        schema_ = None
+    else:
+        assert schema is not None, f"schema required for format {format!r}"
+        column_names = list(schema.column_names())
+        dtypes = dict(schema.dtypes())
+        schema_ = schema
+    pk_cols = schema_.primary_key_columns() if schema_ else None
+    if mode in ("static",):
+        source: Any = _FsStaticSource(
+            path, format, schema_, column_names, csv_settings, pk_cols
+        )
+    else:
+        source = _FsStreamingSource(
+            path, format, schema_, column_names, csv_settings, pk_cols
+        )
+    node = InputNode(source, column_names)
+    return Table._from_node(node, dtypes, Universe())
+
+
+class _FileWriter:
+    def __init__(self, filename: str, format: str, column_names: Sequence[str]):
+        self.filename = filename
+        self.format = format
+        self.column_names = list(column_names)
+        self._file = open(filename, "w", newline="")
+        if format == "csv":
+            self._writer = _csv.writer(self._file)
+            self._writer.writerow(list(column_names) + ["time", "diff"])
+
+    def on_batch(self, t: int, batch: DiffBatch) -> None:
+        for k, d, vals in batch.iter_rows():
+            if self.format == "csv":
+                self._writer.writerow(list(vals) + [t, d])
+            else:
+                obj = dict(zip(self.column_names, [_jsonable(v) for v in vals]))
+                obj["time"] = t
+                obj["diff"] = d
+                self._file.write(_json.dumps(obj) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+
+def _jsonable(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def write(table: Table, filename: str, *, format: str = "json", **kwargs) -> None:
+    from pathway_tpu.engine.nodes import OutputNode
+
+    writer = _FileWriter(filename, format, table.column_names())
+    node = OutputNode(table._node, writer.on_batch, writer.close)
+    parse_graph.G.add_output(node)

@@ -1,0 +1,137 @@
+"""pw.io.python — custom Python sources
+(reference: python/pathway/io/python/__init__.py:47 ConnectorSubject +
+Rust PythonReader, src/connectors/data_storage.rs:840)."""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+from typing import Any, Sequence
+
+from pathway_tpu.engine.nodes import InputNode
+from pathway_tpu.engine.runtime import StreamingSource
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import ref_scalar, sequential_key
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+class ConnectorSubject:
+    """Subclass and implement run(); call self.next(**values) /
+    next_json / next_str / next_bytes; optionally self.commit()."""
+
+    _session = None
+    _column_names: Sequence[str] = ()
+    _schema = None
+    _counter = 0
+    _deletions_enabled = True
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _with_metadata(self) -> bool:
+        return False
+
+    # --- feeding -------------------------------------------------------------
+
+    def _key_for(self, values: dict) -> int:
+        pk = self._schema.primary_key_columns() if self._schema else None
+        if pk:
+            return int(ref_scalar(*[values.get(c) for c in pk]))
+        self._counter += 1
+        return int(ref_scalar(id(self), self._counter))
+
+    def _vals(self, values: dict) -> tuple:
+        return tuple(values.get(c) for c in self._column_names)
+
+    def next(self, **values: Any) -> None:
+        assert self._session is not None
+        coerced = self._coerce_values(values)
+        self._session.insert(self._key_for(coerced), self._vals(coerced))
+
+    def _coerce_values(self, values: dict) -> dict:
+        if self._schema is None:
+            return values
+        out = dict(values)
+        for name, d in self._schema.dtypes().items():
+            if name in out:
+                v = out[name]
+                sd = d.strip_optional()
+                if sd == dt.JSON and not isinstance(v, Json):
+                    out[name] = Json(v)
+                elif sd == dt.FLOAT and isinstance(v, int):
+                    out[name] = float(v)
+        return out
+
+    def next_json(self, values: dict | str) -> None:
+        if isinstance(values, str):
+            values = _json.loads(values)
+        self.next(**values)
+
+    def next_str(self, message: str) -> None:
+        self.next(data=message)
+
+    def next_bytes(self, message: bytes) -> None:
+        self.next(data=message)
+
+    def _remove(self, key, values: dict) -> None:
+        assert self._session is not None
+        coerced = self._coerce_values(values)
+        self._session.remove(int(key), self._vals(coerced))
+
+    def commit(self) -> None:
+        pass
+
+    def close(self) -> None:
+        assert self._session is not None
+        self._session.close()
+
+
+class _PythonSource(StreamingSource):
+    def __init__(self, subject: ConnectorSubject, column_names, schema):
+        super().__init__(column_names)
+        self.subject = subject
+        subject._session = self.session
+        subject._column_names = column_names
+        subject._schema = schema
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        def runner():
+            try:
+                self.subject.run()
+            finally:
+                self.session.close()
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.subject.on_stop()
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: Any = None,
+    format: str = "json",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if schema is None:
+        from pathway_tpu.internals.schema import schema_from_types
+
+        schema = schema_from_types(data=bytes)
+    column_names = list(schema.column_names())
+    source = _PythonSource(subject, column_names, schema)
+    node = InputNode(source, column_names)
+    from pathway_tpu.internals import parse_graph
+
+    parse_graph.G.streaming_sources.append(source)
+    return Table._from_node(node, dict(schema.dtypes()), Universe())

@@ -1,0 +1,74 @@
+"""pw.persistence — checkpoint/resume configuration
+(reference: python/pathway/persistence/__init__.py + src/persistence/).
+
+The engine glue (input event logs + state snapshots + resume) lives in
+pathway_tpu/persistence/engine_glue.py."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Backend:
+    kind: str = "none"
+
+    @classmethod
+    def filesystem(cls, path: str | os.PathLike) -> "FilesystemBackend":
+        return FilesystemBackend(str(path))
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "S3Backend":
+        return S3Backend(root_path, bucket_settings)
+
+    @classmethod
+    def azure(cls, root_path: str, account: Any = None, **kw) -> "S3Backend":
+        return S3Backend(root_path, account)
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "MockBackend":
+        return MockBackend()
+
+
+@dataclass
+class FilesystemBackend(Backend):
+    path: str
+    kind: str = "filesystem"
+
+
+@dataclass
+class S3Backend(Backend):
+    root_path: str
+    bucket_settings: Any = None
+    kind: str = "s3"
+
+
+@dataclass
+class MockBackend(Backend):
+    kind: str = "mock"
+    store: dict = field(default_factory=dict)
+
+
+@dataclass
+class Config:
+    backend: Backend | None = None
+    snapshot_interval_ms: int = 0
+    snapshot_access: Any = None
+    persistence_mode: Any = None
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(
+        cls,
+        backend: Backend,
+        snapshot_interval_ms: int = 0,
+        **kwargs: Any,
+    ) -> "Config":
+        return cls(
+            backend=backend, snapshot_interval_ms=snapshot_interval_ms, **kwargs
+        )
+
+
+def simple_config(backend: Backend, **kwargs: Any) -> Config:
+    return Config.simple_config(backend, **kwargs)

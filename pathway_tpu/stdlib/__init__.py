@@ -1,0 +1,20 @@
+import importlib
+
+__all__ = [
+    "graphs",
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+]
+
+
+def __getattr__(name: str):
+    if name in __all__:
+        module = importlib.import_module(f"pathway_tpu.stdlib.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(name)

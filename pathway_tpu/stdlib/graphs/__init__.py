@@ -1,0 +1,109 @@
+"""pw.graphs — graph algorithms on tables
+(reference: stdlib/graphs/: pagerank, bellman_ford, louvain_communities).
+Demonstrates pw.iterate fixed-point computation."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu.reducers as reducers
+from pathway_tpu.internals.common import coalesce, if_else
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.thisclass import this
+
+
+def pagerank(edges, steps: int = 5, damping: float = 0.85):
+    """PageRank over an edge table with columns (u, v): u -> v
+    (reference: stdlib/graphs/pagerank/). Returns table keyed by vertex with
+    column `rank` (scaled int like the reference's fixed-point ranks)."""
+    import pathway_tpu as pw
+
+    out_degree = edges.groupby(edges.u).reduce(
+        edges.u, degree=reducers.count()
+    )
+    vertices_u = edges.groupby(edges.u).reduce(edges.u).select(v=this.u)
+    vertices_v = edges.groupby(edges.v).reduce(edges.v).select(v=this.v)
+    vertices = (
+        vertices_u.concat_reindex(vertices_v)
+        .groupby(this.v)
+        .reduce(this.v)
+    )
+
+    base = vertices.select(v=this.v, rank=1.0)
+
+    def step(ranks):
+        deg = out_degree.with_id_from(this.u)
+        r = ranks.with_id_from(this.v)
+        contribs = edges.select(
+            src=edges.u,
+            dst=edges.v,
+        )
+        with_rank = contribs.select(
+            dst=this.dst,
+            contrib=r.ix(contribs.select(
+                _p=ranks.pointer_from(this.src)
+            )._p, optional=True).rank
+            / deg.ix(contribs.select(
+                _p=out_degree.pointer_from(this.src)
+            )._p, optional=True).degree,
+        )
+        summed = with_rank.groupby(this.dst).reduce(
+            v=this.dst, incoming=reducers.sum(this.contrib)
+        )
+        joined = ranks.select(v=this.v).with_id_from(this.v)
+        s2 = summed.with_id_from(this.v)
+        new_ranks = joined.select(
+            v=this.v,
+            rank=(1 - damping)
+            + damping * coalesce(s2.restrict(joined).incoming, 0.0),
+        )
+        return new_ranks.with_id_from(this.v)
+
+    ranks = base.with_id_from(this.v)
+    result = iterate(
+        lambda ranks: step(ranks), iteration_limit=steps, ranks=ranks
+    )
+    return result
+
+
+def bellman_ford(vertices, edges):
+    """Shortest paths from vertices where is_source=True over edges
+    (u, v, dist) (reference: stdlib/graphs/bellman_ford/)."""
+    import math
+
+    import pathway_tpu as pw
+
+    base = vertices.select(
+        dist_from_source=if_else(
+            this.is_source, 0.0, math.inf
+        )
+    )
+
+    def step(state):
+        relaxed = edges.join(
+            state, edges.u == state.id
+        ).select(
+            v=edges.v,
+            dist=state.dist_from_source + edges.dist,
+        )
+        best = relaxed.groupby(this.v).reduce(
+            best=reducers.min(this.dist), v=this.v
+        ).with_id(this.v)
+        new_state = state.select(
+            dist_from_source=if_else(
+                best.restrict(state).best.is_not_none()
+                & (coalesce(best.restrict(state).best, math.inf)
+                   < this.dist_from_source),
+                coalesce(best.restrict(state).best, math.inf),
+                this.dist_from_source,
+            )
+        )
+        return new_state
+
+    return iterate(lambda state: step(state), state=base)
+
+
+def louvain_communities(*args, **kwargs):
+    raise NotImplementedError(
+        "louvain_communities is not implemented yet in pathway_tpu"
+    )

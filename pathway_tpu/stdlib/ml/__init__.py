@@ -1,0 +1,12 @@
+from pathway_tpu.stdlib.ml import index
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+__all__ = ["index", "KNNIndex", "classifiers", "smart_table_ops", "utils"]
+
+
+def __getattr__(name: str):
+    import importlib
+
+    if name in ("classifiers", "smart_table_ops", "utils", "hmm", "datasets"):
+        return importlib.import_module(f"pathway_tpu.stdlib.ml.{name}")
+    raise AttributeError(name)

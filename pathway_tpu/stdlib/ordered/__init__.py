@@ -1,0 +1,29 @@
+"""pw.ordered — order-aware helpers (reference: stdlib/ordered/diff.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu.internals.dtype as dt
+from pathway_tpu.internals.expression import ColumnReference
+
+
+def diff(
+    table,
+    timestamp: Any,
+    *values: ColumnReference,
+    instance: Any = None,
+) -> Any:
+    """Compute per-row difference vs the previous row in timestamp order
+    (reference: stdlib/ordered/diff.py, built on sort prev/next pointers)."""
+    sorted_ptrs = table.sort(key=timestamp, instance=instance)
+    with_prev = table.with_columns(_prev=sorted_ptrs.prev)
+    out_cols = {}
+    for v in values:
+        name = f"diff_{v.name}"
+        prev_rows = table.ix(with_prev._prev, optional=True)
+        out_cols[name] = v - prev_rows[v.name]
+    return table.select(**out_cols)
+
+
+__all__ = ["diff"]

@@ -1,0 +1,62 @@
+"""pw.statistical (reference: stdlib/statistical/_interpolate.py)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any
+
+
+class InterpolateMode(Enum):
+    LINEAR = "linear"
+
+
+def interpolate(
+    table,
+    timestamp: Any,
+    *values: Any,
+    mode: InterpolateMode = InterpolateMode.LINEAR,
+):
+    """Linear interpolation of missing (None) values along a time ordering
+    (reference: stdlib/statistical/_interpolate.py)."""
+    import pathway_tpu as pw
+
+    sorted_ptrs = table.sort(key=timestamp)
+    t = table.with_columns(
+        _prev=sorted_ptrs.prev, _next=sorted_ptrs.next, _ts=timestamp
+    )
+
+    out = {}
+    for v in values:
+        name = v.name
+
+        @pw.udf
+        def interp(val, ts, prev_val, prev_ts, next_val, next_ts):
+            if val is not None:
+                return val
+            if prev_val is None and next_val is None:
+                return None
+            if prev_val is None:
+                return next_val
+            if next_val is None:
+                return prev_val
+            if next_ts == prev_ts:
+                return prev_val
+            w = (ts - prev_ts) / (next_ts - prev_ts)
+            return prev_val + w * (next_val - prev_val)
+
+        prev_rows = table.ix(t._prev, optional=True)
+        next_rows = table.ix(t._next, optional=True)
+        prev_t = t.ix(t._prev, optional=True)
+        next_t = t.ix(t._next, optional=True)
+        out[name] = interp(
+            t[name],
+            t._ts,
+            prev_rows[name],
+            prev_t._ts,
+            next_rows[name],
+            next_t._ts,
+        )
+    return table.select(**out)
+
+
+__all__ = ["interpolate", "InterpolateMode"]

@@ -1,0 +1,4 @@
+from pathway_tpu.stdlib.utils import col, filtering
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+
+__all__ = ["col", "filtering", "AsyncTransformer"]

@@ -1,0 +1,82 @@
+"""AsyncTransformer (reference: stdlib/utils/async_transformer.py:281):
+fully-async request/response operator — rows go out to `invoke`, results come
+back as a new table."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.table import Table
+
+
+class _Result:
+    def __init__(self, table: Table):
+        self.successful = table
+        self.failed = table.filter(
+            expr_mod.ColumnConstExpression(False)  # placeholder: no failures split
+        )
+        self.finished = table
+
+
+class AsyncTransformer:
+    """Subclass and define ``output_schema`` and ``async def invoke(self,
+    **kwargs) -> dict``."""
+
+    output_schema: Any = None
+
+    def __init__(self, input_table: Table, *, instance: Any = None, **kwargs):
+        self._input_table = input_table
+        self._instance = instance
+        assert self.output_schema is not None, "define output_schema"
+
+    def with_options(self, **kwargs) -> "AsyncTransformer":
+        return self
+
+    async def invoke(self, **kwargs) -> dict:
+        raise NotImplementedError
+
+    @property
+    def successful(self) -> Table:
+        return self.result.successful
+
+    @property
+    def failed(self) -> Table:
+        return self.result.failed
+
+    @property
+    def finished(self) -> Table:
+        return self.result.finished
+
+    @property
+    def result(self) -> _Result:
+        if not hasattr(self, "_result"):
+            self._result = _Result(self._build())
+        return self._result
+
+    def _build(self) -> Table:
+        table = self._input_table
+        out_names = list(self.output_schema.column_names())
+        invoke = self.invoke
+
+        async def call(*vals):
+            kwargs = dict(zip(table.column_names(), vals))
+            return await invoke(**kwargs)
+
+        e = expr_mod.AsyncApplyExpression(
+            call,
+            dict,
+            False,
+            True,
+            tuple(table[n] for n in table.column_names()),
+            {},
+        )
+        packed = table.select(_result=e)
+        exprs = {
+            n: expr_mod.GetExpression(packed._result, n, None, True)
+            for n in out_names
+        }
+        out = packed.select(**exprs)
+        dtypes = dict(self.output_schema.dtypes())
+        return out.update_types(**{n: dtypes[n] for n in out_names})

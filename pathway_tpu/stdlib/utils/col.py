@@ -1,0 +1,54 @@
+"""Column manipulation helpers (reference: stdlib/utils/col.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+
+
+def unpack_col(column, *unpacked_columns: str, schema: Any = None) -> Table:
+    """Unpack a tuple column into named columns."""
+    table = None
+    for ref in column._dependencies():
+        table = ref.table
+        break
+    assert table is not None
+    if schema is not None:
+        names = list(schema.column_names())
+    else:
+        names = [
+            c if isinstance(c, str) else c.name for c in unpacked_columns
+        ]
+    exprs = {name: column[i] for i, name in enumerate(names)}
+    return table.select(**exprs)
+
+
+def multiapply_all_rows(*args, **kwargs):
+    raise NotImplementedError
+
+
+def apply_all_rows(*args, **kwargs):
+    raise NotImplementedError
+
+
+def groupby_reduce_majority(column, value_column):
+    import pathway_tpu as pw
+
+    table = None
+    for ref in column._dependencies():
+        table = ref.table
+        break
+    return table.groupby(column).reduce(
+        column, majority=pw.reducers.any(value_column)
+    )
+
+
+def flatten_column(column, origin_id: str | None = "origin_id"):
+    table = None
+    for ref in column._dependencies():
+        table = ref.table
+        break
+    assert table is not None
+    flat = table.flatten(column)
+    return flat

@@ -1,0 +1,44 @@
+"""pw.udfs — UDF helpers: caching, retries, executors
+(reference: python/pathway/udfs.py)."""
+
+from pathway_tpu.internals.udfs import (
+    UDF,
+    AsyncRetryStrategy,
+    CacheStrategy,
+    DefaultCache,
+    DiskCache,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+    NoRetryStrategy,
+    async_executor,
+    async_options,
+    auto_executor,
+    coerce_async,
+    fully_async_executor,
+    sync_executor,
+    udf,
+    with_cache_strategy,
+    with_retry_strategy,
+)
+
+__all__ = [
+    "UDF",
+    "udf",
+    "CacheStrategy",
+    "DiskCache",
+    "InMemoryCache",
+    "DefaultCache",
+    "AsyncRetryStrategy",
+    "ExponentialBackoffRetryStrategy",
+    "FixedDelayRetryStrategy",
+    "NoRetryStrategy",
+    "auto_executor",
+    "sync_executor",
+    "async_executor",
+    "fully_async_executor",
+    "async_options",
+    "coerce_async",
+    "with_cache_strategy",
+    "with_retry_strategy",
+]

@@ -1,0 +1,24 @@
+import os
+
+# Force CPU with a virtual 8-device mesh so multi-chip sharding paths are
+# exercised without TPU hardware (the driver's dryrun does the same).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_parse_graph():
+    from pathway_tpu.internals import parse_graph
+    from pathway_tpu.internals.errors import clear_errors
+
+    parse_graph.G.clear()
+    clear_errors()
+    yield
+    parse_graph.G.clear()
+    clear_errors()

@@ -1,0 +1,644 @@
+"""Core Table op tests — modeled on the reference test strategy
+(markdown fixtures + captured-output equality, reference
+python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=t.a + t.b, d=t.b - t.a, p=t.a * t.b, q=t.b / t.a)
+    expected = T(
+        """
+        s | d | p | q
+        3 | 1 | 2 | 2.0
+        7 | 1 | 12| 1.3333333333333333
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_select_this():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(pw.this.a, c=pw.this.a + pw.this.b)
+    expected = T(
+        """
+        a | c
+        1 | 3
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        4
+        """
+    )
+    res = t.filter(t.v % 2 == 0)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v
+            2
+            4
+            """
+        ),
+    )
+
+
+def test_filter_keeps_ids():
+    t = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    res = t.filter(t.v > 1).select(w=pw.this.v * 10)
+    rows = pw.debug.table_to_dicts(res)[1]["w"]
+    assert list(rows.values()) == [20]
+
+
+def test_groupby_sum_count():
+    t = T(
+        """
+        owner | age
+        Alice | 10
+        Bob   | 9
+        Alice | 8
+        """
+    )
+    res = t.groupby(t.owner).reduce(
+        t.owner,
+        total=pw.reducers.sum(t.age),
+        cnt=pw.reducers.count(),
+        mean=pw.reducers.avg(t.age),
+    )
+    expected = T(
+        """
+        owner | total | cnt | mean
+        Alice | 18    | 2   | 9.0
+        Bob   | 9     | 1   | 9.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_min_max_argmin_any():
+    t = T(
+        """
+        g | v
+        x | 3
+        x | 1
+        y | 7
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g,
+        lo=pw.reducers.min(t.v),
+        hi=pw.reducers.max(t.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | lo | hi
+            x | 1  | 3
+            y | 7  | 7
+            """
+        ),
+    )
+
+
+def test_groupby_tuple_reducers():
+    t = T(
+        """
+        g | v
+        x | 3
+        x | 1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g,
+        st=pw.reducers.sorted_tuple(t.v),
+    )
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["st"].values()) == [(1, 3)]
+
+
+def test_reduce_expression_arithmetic():
+    t = T(
+        """
+        g | v
+        x | 3
+        x | 1
+        y | 7
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g, twice=pw.reducers.sum(t.v) * 2 + pw.reducers.count()
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | twice
+            x | 10
+            y | 15
+            """
+        ),
+    )
+
+
+def test_global_reduce():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    res = t.reduce(s=pw.reducers.sum(t.v))
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["s"].values()) == [6]
+
+
+def test_join_inner():
+    t1 = T(
+        """
+        owner | pet
+        Alice | dog
+        Bob   | cat
+        Carol | fish
+        """
+    )
+    t2 = T(
+        """
+        name  | age
+        Alice | 30
+        Bob   | 25
+        """
+    )
+    res = t1.join(t2, t1.owner == t2.name).select(
+        t1.owner, t1.pet, t2.age
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            owner | pet | age
+            Alice | dog | 30
+            Bob   | cat | 25
+            """
+        ),
+    )
+
+
+def test_join_left_outer():
+    t1 = T(
+        """
+        k | a
+        1 | x
+        2 | y
+        """
+    )
+    t2 = T(
+        """
+        k | b
+        2 | p
+        3 | q
+        """
+    )
+    res_left = t1.join_left(t2, t1.k == t2.k).select(
+        t1.k, a=t1.a, b=t2.b
+    )
+    assert_table_equality_wo_index(
+        res_left,
+        T(
+            """
+            k | a | b
+            1 | x | None
+            2 | y | p
+            """
+        ),
+    )
+    res_outer = t1.join_outer(t2, t1.k == t2.k).select(
+        k=pw.coalesce(t1.k, t2.k), a=t1.a, b=t2.b
+    )
+    assert_table_equality_wo_index(
+        res_outer,
+        T(
+            """
+            k | a    | b
+            1 | x    | None
+            2 | y    | p
+            3 | None | q
+            """
+        ),
+    )
+
+
+def test_concat():
+    t1 = T(
+        """
+        v
+        1
+        """
+    )
+    t2 = T(
+        """
+        v
+        2
+        """
+    )
+    res = t1.concat_reindex(t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v
+            1
+            2
+            """
+        ),
+    )
+
+
+def test_update_cells():
+    t1 = T(
+        """
+        id | a | b
+        1  | 1 | x
+        2  | 2 | y
+        """
+    )
+    t2 = T(
+        """
+        id | b
+        1  | z
+        """
+    )
+    res = t1.update_cells(t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            1 | z
+            2 | y
+            """
+        ),
+    )
+
+
+def test_update_rows():
+    t1 = T(
+        """
+        id | a
+        1  | 10
+        2  | 20
+        """
+    )
+    t2 = T(
+        """
+        id | a
+        2  | 99
+        3  | 30
+        """
+    )
+    res = t1.update_rows(t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a
+            10
+            99
+            30
+            """
+        ),
+    )
+
+
+def test_flatten():
+    t = T(
+        """
+        g
+        x
+        """
+    ).select(g=pw.this.g, tup=pw.make_tuple(1, 2, 3))
+    res = t.flatten(t.tup)
+    assert_table_equality_wo_index(
+        res.select(res.g, res.tup),
+        T(
+            """
+            g | tup
+            x | 1
+            x | 2
+            x | 3
+            """
+        ),
+    )
+
+
+def test_ix():
+    target = T(
+        """
+        id | v
+        a  | 1
+        b  | 2
+        """
+    )
+    source = T(
+        """
+        ptr
+        a
+        b
+        a
+        """
+    )
+    ptrs = source.select(p=target.pointer_from(source.ptr))
+    res = target.ix(ptrs.p)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v
+            1
+            2
+            1
+            """
+        ),
+    )
+
+
+def test_with_id_from_and_ix_ref():
+    t = T(
+        """
+        name  | v
+        Alice | 1
+        Bob   | 2
+        """
+    ).with_id_from(pw.this.name)
+    res = t.ix_ref("Alice", context=t).select(other_v=pw.this.v)
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert set(cols["other_v"].values()) == {1}
+
+
+def test_difference_intersect():
+    t1 = T(
+        """
+        id | v
+        1  | a
+        2  | b
+        3  | c
+        """
+    )
+    t2 = T(
+        """
+        id | w
+        2  | x
+        3  | y
+        """
+    )
+    assert_table_equality_wo_index(
+        t1.difference(t2),
+        T(
+            """
+            v
+            a
+            """
+        ),
+    )
+    assert_table_equality_wo_index(
+        t1.intersect(t2),
+        T(
+            """
+            v
+            b
+            c
+            """
+        ),
+    )
+
+
+def test_rename_without():
+    t = T(
+        """
+        a | b | c
+        1 | 2 | 3
+        """
+    )
+    res = t.without("c").rename_columns(x=pw.this.a)
+    assert res.column_names() == ["x", "b"]
+
+
+def test_streaming_diffs_groupby():
+    t = T(
+        """
+          | v | __time__ | __diff__
+        1 | 5 | 2        | 1
+        2 | 3 | 2        | 1
+        1 | 5 | 4        | -1
+        """
+    )
+    res = t.reduce(s=pw.reducers.sum(pw.this.v))
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["s"].values()) == [3]
+
+
+def test_apply_and_udf():
+    t = T(
+        """
+        v
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    res = t.select(
+        d=double(t.v), a=pw.apply_with_type(lambda x: x + 10, int, t.v)
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            d | a
+            2 | 11
+            4 | 12
+            """
+        ),
+    )
+
+
+def test_if_else_division_guard():
+    t = T(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        """
+    )
+    res = t.select(q=pw.if_else(t.b != 0, t.a // t.b, -1))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            q
+            3
+            -1
+            """
+        ),
+    )
+
+
+def test_sort():
+    t = T(
+        """
+        v
+        30
+        10
+        20
+        """
+    )
+    res = t.sort(key=t.v)
+    _keys, cols = pw.debug.table_to_dicts(res)
+    prevs = [v for v in cols["prev"].values()]
+    nexts = [v for v in cols["next"].values()]
+    assert sum(1 for p in prevs if p is None) == 1
+    assert sum(1 for n in nexts if n is None) == 1
+
+
+def test_deduplicate():
+    t = T(
+        """
+          | v | __time__
+        1 | 1 | 2
+        2 | 2 | 4
+        3 | 3 | 6
+        """
+    )
+    res = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: old is None or new >= old + 2
+    )
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["v"].values()) == [3]
+
+
+def test_string_namespace():
+    t = T(
+        """
+        s
+        Hello
+        World
+        """
+    )
+    res = t.select(
+        up=t.s.str.upper(), n=t.s.str.len(), sw=t.s.str.startswith("He")
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            up    | n | sw
+            HELLO | 5 | True
+            WORLD | 5 | False
+            """
+        ),
+    )
+
+
+def test_num_namespace():
+    t = T(
+        """
+        x
+        -1.5
+        2.25
+        """
+    )
+    res = t.select(a=t.x.num.abs(), r=t.x.num.round(1))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a   | r
+            1.5 | -1.5
+            2.25| 2.2
+            """
+        ),
+    )
+
+
+def test_json_access():
+    import json
+
+    t = T(
+        """
+        raw
+        x
+        """
+    )
+    res = t.select(
+        j=pw.apply_with_type(
+            lambda _: pw.Json({"a": {"b": 5}, "l": [1, 2]}), pw.Json, t.raw
+        )
+    ).select(
+        b=pw.this.j["a"]["b"].as_int(),
+        l0=pw.this.j["l"][0].as_int(),
+        missing=pw.this.j.get("zzz"),
+    )
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["b"].values()) == [5]
+    assert list(cols["l0"].values()) == [1]
+    assert list(cols["missing"].values()) == [None]
+
+
+def test_error_poison_fill_error():
+    t = T(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, -1))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            q
+            3
+            -1
+            """
+        ),
+    )
