@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line for the tracked headline metric.
+
+Headline (BASELINE.md): KNN query p50 @ 1M x 384 vectors, end-to-end
+(host query -> device top-k -> host ids), target < 50 ms on TPU.
+vs_baseline = target_ms / measured_p50 (>1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n = 1_000_000 if on_accel else 100_000
+    dim = 384
+    k = 10
+    n_queries = 100
+
+    from pathway_tpu.ops.knn import DeviceCorpus, dense_topk_prepared
+
+    rng = np.random.default_rng(0)
+    corpus = DeviceCorpus(dim, capacity=n)
+    # bulk-load host mirror directly (bench path; connector path feeds
+    # incrementally through the same DeviceCorpus)
+    corpus.host[:n] = rng.normal(size=(n, dim)).astype(np.float32)
+    corpus.valid_host[:n] = True
+    for i in range(n):
+        corpus.slot_of[i] = i
+        corpus.key_of[i] = i
+    corpus.free = list(range(corpus.capacity - 1, n - 1, -1))
+    corpus._dirty = True
+
+    prep, c2, valid = corpus.prepared_arrays("cosine")
+    queries = rng.normal(size=(n_queries, 1, dim)).astype(np.float32)
+
+    # warmup / compile
+    s, ix = dense_topk_prepared(queries[0], prep, c2, valid, k, metric="cosine")
+    np.asarray(s)
+
+    lat = []
+    for i in range(n_queries):
+        t0 = time.perf_counter()
+        s, ix = dense_topk_prepared(
+            queries[i], prep, c2, valid, k, metric="cosine"
+        )
+        ids = np.asarray(ix)  # block until the result is on host
+        lat.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(lat, 50))
+
+    target_ms = 50.0
+    print(
+        json.dumps(
+            {
+                "metric": f"knn_query_p50_ms_{n}x{dim}",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(target_ms / p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -124,7 +124,12 @@ def table_from_markdown(
             ids = None
     else:
         header = lines[0].split()
-        data = [l.split() for l in lines[1:]]
+        if len(header) == 1:
+            # single unnamed column: whole line is the value (strings with
+            # spaces need no pipes)
+            data = [[l.strip()] for l in lines[1:]]
+        else:
+            data = [l.split() for l in lines[1:]]
         has_id_col = header[0] == "id"
         if has_id_col:
             header = header[1:]

@@ -364,6 +364,46 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
         return _tighten(_elementwise(fn, *arrays))
     if isinstance(e, (expr.AsyncApplyExpression,)):
         return _eval_async_apply(e, ctx)
+    if isinstance(e, expr.BatchApplyExpression):
+        from pathway_tpu.internals.errors import record_error
+
+        arrays = [eval_expr(a, ctx) for a in e._args]
+        kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+        out = np.empty(n, dtype=object)
+        # rows with None (propagate_none) or ERROR inputs bypass the fn,
+        # matching the scalar/async apply semantics
+        ok_idx = []
+        for i in range(n):
+            row = [a[i] for a in arrays] + [v[i] for v in kw_arrays.values()]
+            if any(isinstance(v, Error) for v in row):
+                out[i] = ERROR
+            elif e._propagate_none and any(v is None for v in row):
+                out[i] = None
+            else:
+                ok_idx.append(i)
+        max_bs = e._max_batch_size or max(len(ok_idx), 1)
+        pos = 0
+        while pos < len(ok_idx):
+            chunk = ok_idx[pos : pos + max_bs]
+            args = [[a[i] for i in chunk] for a in arrays]
+            kwargs = {
+                k: [v[i] for i in chunk] for k, v in kw_arrays.items()
+            }
+            try:
+                results = e._fn(*args, **kwargs)
+                if len(results) != len(chunk):
+                    raise ValueError(
+                        f"batched UDF returned {len(results)} results for "
+                        f"{len(chunk)} inputs"
+                    )
+                for i, r in zip(chunk, results):
+                    out[i] = r
+            except Exception as exc:
+                record_error(exc)
+                for i in chunk:
+                    out[i] = ERROR
+            pos += max_bs
+        return _coerce_to_dtype(out, e._return_type)
     if isinstance(e, expr.ApplyExpression):
         arrays = [eval_expr(a, ctx) for a in e._args]
         kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}

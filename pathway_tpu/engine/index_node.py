@@ -1,0 +1,145 @@
+"""External index node: streams data-side updates into an index object and
+answers query-side rows with top-k matches.
+
+Reference: use_external_index_as_of_now (src/engine/dataflow.rs:2694) +
+operators/external_index.rs — there, queries broadcast to all workers and each
+worker searches its shard. Here the index lives on-device (one jitted top-k
+over the whole corpus, sharded over the mesh when configured), so the
+broadcast/merge happens inside XLA over ICI instead of timely channels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import Node, NodeExec, _concat_inputs
+from pathway_tpu.internals.api import Pointer
+from pathway_tpu.internals.errors import record_error
+
+
+class IndexImpl(Protocol):
+    """Host-side index protocol (device work happens inside search)."""
+
+    def upsert(self, key: int, data: Any, metadata: Any) -> None: ...
+
+    def remove(self, key: int) -> None: ...
+
+    def search(
+        self, queries: Sequence[tuple[Any, int, Any]]
+    ) -> list[tuple[tuple[int, float], ...]]:
+        """queries: (data, k, filter) triples → per query a tuple of
+        (row_key, score) sorted best-first."""
+        ...
+
+
+class ExternalIndexNode(Node):
+    """inputs: [data_node(cols: _data, _meta), query_node(cols: _q, _k, _filter)]
+    output: query universe, column _pw_index_reply (tuple of (ptr, score))."""
+
+    REPLY = "_pw_index_reply"
+
+    def __init__(
+        self,
+        data_node: Node,
+        query_node: Node,
+        index_factory: Any,
+        as_of_now: bool = True,
+    ):
+        super().__init__([data_node, query_node], [self.REPLY])
+        self.index_factory = index_factory
+        self.as_of_now = as_of_now
+
+    def make_exec(self):
+        return ExternalIndexExec(self)
+
+
+class ExternalIndexExec(NodeExec):
+    def __init__(self, node: ExternalIndexNode):
+        super().__init__(node)
+        self.index: IndexImpl = node.index_factory()
+        dcols = node.inputs[0].column_names
+        qcols = node.inputs[1].column_names
+        self.d_data = dcols.index("_data")
+        self.d_meta = dcols.index("_meta") if "_meta" in dcols else None
+        self.q_data = qcols.index("_q")
+        self.q_k = qcols.index("_k") if "_k" in qcols else None
+        self.q_filter = qcols.index("_filter") if "_filter" in qcols else None
+        # live queries (for full `query` mode re-answers) / emitted replies
+        self.live_queries: dict[int, tuple] = {}
+        self.emitted: dict[int, tuple] = {}
+
+    def _answer(self, items: list[tuple[int, tuple]]) -> dict[int, tuple]:
+        """items: (query_key, qvals) → reply tuples."""
+        triples = []
+        for _k, vals in items:
+            q = vals[self.q_data]
+            k = int(vals[self.q_k]) if self.q_k is not None else 3
+            flt = vals[self.q_filter] if self.q_filter is not None else None
+            triples.append((q, k, flt))
+        try:
+            results = self.index.search(triples)
+        except Exception as exc:
+            record_error(exc, str(self.node))
+            results = [() for _ in triples]
+        out = {}
+        for (qk, _vals), matches in zip(items, results):
+            out[qk] = tuple(
+                (Pointer(mk), float(score)) for mk, score in matches
+            )
+        return out
+
+    def process(self, t, inputs):
+        node = self.node
+        data_changed = False
+        for b in inputs[0]:
+            for k, d, vals in b.iter_rows():
+                data_changed = True
+                if d > 0:
+                    meta = (
+                        vals[self.d_meta] if self.d_meta is not None else None
+                    )
+                    try:
+                        self.index.upsert(k, vals[self.d_data], meta)
+                    except Exception as exc:
+                        record_error(exc, str(node))
+                else:
+                    self.index.remove(k)
+        to_answer: list[tuple[int, tuple]] = []
+        retracted: list[int] = []
+        for b in inputs[1]:
+            for k, d, vals in b.iter_rows():
+                if d > 0:
+                    if not node.as_of_now:
+                        self.live_queries[k] = vals
+                    to_answer.append((k, vals))
+                else:
+                    self.live_queries.pop(k, None)
+                    retracted.append(k)
+        if not node.as_of_now and data_changed:
+            # re-answer every live query against the new index state
+            answered_keys = {k for k, _ in to_answer}
+            for k, vals in self.live_queries.items():
+                if k not in answered_keys:
+                    to_answer.append((k, vals))
+        out_rows: list[tuple[int, int, tuple]] = []
+        for k in retracted:
+            old = self.emitted.pop(k, None)
+            if old is not None:
+                out_rows.append((k, -1, old))
+        if to_answer:
+            replies = self._answer(to_answer)
+            for k, reply in replies.items():
+                new = (reply,)
+                old = self.emitted.get(k)
+                if old == new:
+                    continue
+                if old is not None:
+                    out_rows.append((k, -1, old))
+                out_rows.append((k, 1, new))
+                self.emitted[k] = new
+        if not out_rows:
+            return []
+        return [DiffBatch.from_rows(out_rows, self.node.column_names)]

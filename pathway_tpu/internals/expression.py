@@ -367,7 +367,7 @@ class ApplyExpression(ColumnExpression):
 
     def _rebuild(self, children):
         n = len(self._args)
-        return ApplyExpression(
+        return type(self)(
             self._fn,
             self._return_type,
             self._propagate_none,
@@ -379,6 +379,12 @@ class ApplyExpression(ColumnExpression):
 
     def __repr__(self):
         return f"pathway.apply({getattr(self._fn, '__name__', self._fn)!r}, ...)"
+
+
+class BatchApplyExpression(ApplyExpression):
+    """Columnar UDF: fn receives whole argument LISTS for the batch and
+    returns a list of results — the path device-backed UDFs (embedders,
+    rerankers) use so one jitted forward serves the whole tick."""
 
 
 class AsyncApplyExpression(ApplyExpression):

@@ -210,7 +210,11 @@ class Table(Joinable):
         return f"<pw.Table#{self._node.id}({cols})>"
 
     def __getattr__(self, name: str) -> ColumnReference:
-        if name.startswith("_"):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        if name.startswith("_") and name not in self.__dict__.get(
+            "_schema", schema_mod.Schema
+        ).__columns__:
             raise AttributeError(name)
         if name not in self._schema.__columns__:
             raise AttributeError(

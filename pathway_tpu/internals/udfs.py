@@ -186,14 +186,22 @@ class UDF:
     def func(self) -> Callable:
         return self._fn_raw
 
+    _batched = False
+
     def __call__(self, *args: Any, **kwargs: Any) -> expr_mod.ColumnExpression:
         if not hasattr(self, "_fn"):
             self._prepare(self.__wrapped__)  # type: ignore[attr-defined]
-        cls = (
-            expr_mod.AsyncApplyExpression
-            if self._is_async
-            else expr_mod.ApplyExpression
-        )
+        if self._batched and self._is_async:
+            raise TypeError(
+                "batched UDFs must be synchronous (the batch already "
+                "amortizes latency); drop async or _batched"
+            )
+        if self._batched:
+            cls: Any = expr_mod.BatchApplyExpression
+        elif self._is_async:
+            cls = expr_mod.AsyncApplyExpression
+        else:
+            cls = expr_mod.ApplyExpression
         return cls(
             self._fn,
             self._return_type,

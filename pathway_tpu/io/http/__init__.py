@@ -1,0 +1,20 @@
+"""pw.io.http — REST ingress/egress
+(reference: python/pathway/io/http — rest_connector:624, PathwayWebserver:329,
+RestServerSubject:490; aiohttp-based)."""
+
+from pathway_tpu.io.http._server import (
+    EndpointDocumentation,
+    EndpointExamples,
+    PathwayWebserver,
+    rest_connector,
+)
+from pathway_tpu.io.http._client import read, write
+
+__all__ = [
+    "PathwayWebserver",
+    "rest_connector",
+    "read",
+    "write",
+    "EndpointDocumentation",
+    "EndpointExamples",
+]

@@ -1,0 +1,310 @@
+"""REST server connector: HTTP requests become table rows; responses are
+delivered when the result row for the request id arrives
+(reference: python/pathway/io/http/_server.py — PathwayWebserver:329 with
+OpenAPI docgen:126, RestServerSubject:490, rest_connector:624)."""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+import uuid
+from typing import Any, Mapping, Sequence
+
+from aiohttp import web
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import OutputNode
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import parse_graph
+from pathway_tpu.internals.api import Pointer, ref_scalar
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.schema import SchemaMetaclass, schema_from_types
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+class EndpointDocumentation:
+    def __init__(
+        self,
+        summary: str | None = None,
+        description: str | None = None,
+        tags: Sequence[str] | None = None,
+        method_status: Any = None,
+        **kwargs,
+    ):
+        self.summary = summary
+        self.description = description
+        self.tags = list(tags or [])
+
+
+class EndpointExamples:
+    def __init__(self):
+        self.examples: list = []
+
+    def add_example(self, *args, **kwargs):
+        return self
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by all rest_connector routes."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        with_schema_endpoint: bool = True,
+        with_cors: bool = False,
+    ):
+        self.host = host
+        self.port = port
+        self._app = web.Application()
+        self._routes: dict[str, Any] = {}
+        self._openapi: dict[str, Any] = {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway-TPU API", "version": "1.0"},
+            "paths": {},
+        }
+        self._started = False
+        self._lock = threading.Lock()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        if with_schema_endpoint:
+            self._app.router.add_get("/_schema", self._schema_handler)
+
+    async def _schema_handler(self, request: web.Request) -> web.Response:
+        return web.json_response(self._openapi)
+
+    def _register_endpoint(
+        self, route: str, handler, methods: Sequence[str], schema, documentation
+    ) -> None:
+        with self._lock:
+            resource = self._routes.get(route)
+            if resource is None:
+                resource = self._app.router.add_resource(route)
+                self._routes[route] = resource
+            for method in methods:
+                resource.add_route(method, handler)
+            doc: dict[str, Any] = {}
+            for method in methods:
+                entry: dict[str, Any] = {
+                    "responses": {"200": {"description": "OK"}}
+                }
+                if documentation is not None:
+                    if documentation.summary:
+                        entry["summary"] = documentation.summary
+                    if documentation.description:
+                        entry["description"] = documentation.description
+                    if documentation.tags:
+                        entry["tags"] = documentation.tags
+                if schema is not None:
+                    props = {
+                        name: {"type": _openapi_type(c.dtype)}
+                        for name, c in schema.columns().items()
+                    }
+                    entry["requestBody"] = {
+                        "content": {
+                            "application/json": {
+                                "schema": {
+                                    "type": "object",
+                                    "properties": props,
+                                }
+                            }
+                        }
+                    }
+                doc[method.lower()] = entry
+            self._openapi["paths"][route] = doc
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(self._app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True)
+        self._thread.start()
+
+
+def _openapi_type(d: dt.DType) -> str:
+    sd = d.strip_optional()
+    if sd == dt.INT:
+        return "integer"
+    if sd == dt.FLOAT:
+        return "number"
+    if sd == dt.BOOL:
+        return "boolean"
+    if sd == dt.JSON:
+        return "object"
+    return "string"
+
+
+class RestServerSubject(ConnectorSubject):
+    """Feeds HTTP requests into the graph; resolves response futures when the
+    response writer delivers results (reference: _server.py:490)."""
+
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        schema: SchemaMetaclass,
+        methods: Sequence[str],
+        delete_completed_queries: bool,
+        format: str = "raw",
+        documentation: EndpointDocumentation | None = None,
+    ):
+        self._webserver = webserver
+        self._route = route
+        self._format = format
+        self._request_schema = schema
+        self._delete_completed = delete_completed_queries
+        self._futures: dict[int, asyncio.Future] = {}
+        self._futures_lock = threading.Lock()
+        webserver._register_endpoint(
+            route, self._handle, methods, schema, documentation
+        )
+        self._ready = threading.Event()
+
+    def run(self) -> None:
+        self._webserver.start()
+        self._ready.set()
+        # stay alive for the lifetime of the graph
+        threading.Event().wait()
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        rid = uuid.uuid4().hex
+        key = int(ref_scalar(rid))
+        if self._format == "raw":
+            body = await request.text()
+            values: dict[str, Any] = {"query": body}
+        else:
+            try:
+                payload = await request.json()
+            except ValueError:
+                payload = {}
+            if request.rel_url.query:
+                payload = {**dict(request.rel_url.query), **payload}
+            values = {}
+            for name, col in self._request_schema.columns().items():
+                if name in payload:
+                    values[name] = payload[name]
+                elif col.has_default_value:
+                    values[name] = col.default_value
+                else:
+                    return web.json_response(
+                        {"error": f"missing field {name!r}"}, status=400
+                    )
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        with self._futures_lock:
+            self._futures[key] = future
+        coerced = self._coerce_values(values)
+        vals = self._vals(coerced)
+        assert self._session is not None
+        self._session.insert(key, vals)
+        result = await future
+        if self._delete_completed:
+            self._session.remove(key, vals)
+        return web.json_response(result)
+
+    def _deliver(self, key: int, payload: Any) -> None:
+        with self._futures_lock:
+            future = self._futures.pop(key, None)
+        if future is None:
+            return
+        loop = future.get_loop()
+        loop.call_soon_threadsafe(
+            lambda: future.done() or future.set_result(payload)
+        )
+
+    def _key_for(self, values):  # keys are assigned in _handle
+        raise NotImplementedError
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: SchemaMetaclass | None = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool | None = None,
+    request_validator: Any = None,
+    documentation: EndpointDocumentation | None = None,
+) -> tuple[Table, Any]:
+    """Returns (queries_table, response_writer). Call
+    ``response_writer(result_table)`` where result_table has columns
+    ``query_id`` (Pointer) and ``result`` (reference: _server.py:624)."""
+    if delete_completed_queries is None:
+        delete_completed_queries = not bool(keep_queries)
+    if webserver is None:
+        assert host is not None and port is not None
+        webserver = PathwayWebserver(host, port)
+    if schema is None:
+        schema = schema_from_types(query=str)
+        fmt = "raw"
+    else:
+        fmt = "custom"
+    subject = RestServerSubject(
+        webserver,
+        route,
+        schema,
+        methods,
+        delete_completed_queries,
+        format=fmt,
+        documentation=documentation,
+    )
+    queries = python_read(subject, schema=schema)
+
+    def response_writer(response_table: Table) -> None:
+        col_names = response_table.column_names()
+        assert "query_id" in col_names and "result" in col_names, (
+            "response table must have query_id and result columns"
+        )
+        qi = col_names.index("query_id")
+        ri = col_names.index("result")
+
+        def on_batch(t: int, batch: DiffBatch) -> None:
+            for k, d, vals in batch.iter_rows():
+                if d <= 0:
+                    continue
+                qid = vals[qi]
+                result = vals[ri]
+                if isinstance(result, Json):
+                    result = result.value
+                subject._deliver(int(qid), _jsonable(result))
+
+        node = OutputNode(response_table._node, on_batch)
+        parse_graph.G.add_output(node)
+
+    return queries, response_writer
+
+
+def _jsonable(v: Any):
+    import numpy as np
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    return v

@@ -1,0 +1,36 @@
+"""LSH random-projection hashing on device
+(reference: stdlib/ml/classifiers/_lsh.py — bucketed ANN in pure dataflow;
+here the projections run as one jitted matmul)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_projections(
+    dim: int, n_or: int, n_and: int, bucket_length: float, seed: int = 0
+):
+    rng = np.random.default_rng(seed)
+    planes = rng.normal(size=(n_or, n_and, dim)).astype(np.float32)
+    offsets = rng.uniform(0, bucket_length, size=(n_or, n_and)).astype(
+        np.float32
+    )
+    return jnp.asarray(planes), jnp.asarray(offsets)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def lsh_buckets(vectors, planes, offsets, bucket_length):
+    """vectors [N,D] -> bucket ids [N, n_or] (int32) via E2LSH:
+    floor((v·a + b) / w) combined over the AND dimension."""
+    proj = jnp.einsum("nd,oad->noa", vectors, planes)
+    cells = jnp.floor((proj + offsets[None]) / bucket_length).astype(jnp.int32)
+    # combine AND-hashes into one bucket id
+    mix = cells.astype(jnp.uint32)
+    h = jnp.zeros(mix.shape[:2], dtype=jnp.uint32)
+    for i in range(mix.shape[2]):
+        h = h * jnp.uint32(1000003) + mix[:, :, i]
+    return h.astype(jnp.int32)

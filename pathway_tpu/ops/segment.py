@@ -1,0 +1,43 @@
+"""Segment reductions on device — vectorized groupby kernels
+(TPU-native counterpart of the reference's differential `reduce_abelian`
+inner loops, src/engine/dataflow.rs:3113-3400, for the dense-numeric case)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_sum(values: jax.Array, segment_ids: jax.Array, num_segments: int):
+    return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_count(segment_ids: jax.Array, num_segments: int):
+    return jax.ops.segment_sum(
+        jnp.ones_like(segment_ids, dtype=jnp.int32),
+        segment_ids,
+        num_segments=num_segments,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_mean(values: jax.Array, segment_ids: jax.Array, num_segments: int):
+    s = jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+    c = jax.ops.segment_sum(
+        jnp.ones_like(values), segment_ids, num_segments=num_segments
+    )
+    return s / jnp.maximum(c, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_max(values: jax.Array, segment_ids: jax.Array, num_segments: int):
+    return jax.ops.segment_max(values, segment_ids, num_segments=num_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_min(values: jax.Array, segment_ids: jax.Array, num_segments: int):
+    return jax.ops.segment_min(values, segment_ids, num_segments=num_segments)

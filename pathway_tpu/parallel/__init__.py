@@ -1,0 +1,19 @@
+from pathway_tpu.parallel.mesh import (
+    get_mesh,
+    make_mesh,
+    set_default_mesh,
+)
+from pathway_tpu.parallel.collectives import (
+    exchange_by_shard,
+    replicated,
+    sharded_rows,
+)
+
+__all__ = [
+    "make_mesh",
+    "get_mesh",
+    "set_default_mesh",
+    "exchange_by_shard",
+    "sharded_rows",
+    "replicated",
+]

@@ -1,0 +1,78 @@
+"""Collective patterns over the mesh — the ICI-native replacements for the
+reference's timely channel pacts (reference: §2.2 of SURVEY —
+timely `Exchange` pact → all_to_all; `Broadcast` → all_gather;
+progress frontier exchange → psum; vendored
+external/timely-dataflow/communication replaced by XLA collectives)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def sharded_rows(mesh: Any, axis: str = "data") -> NamedSharding:
+    """Sharding for [N, ...] row-major tables: rows split over `axis`."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Any) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _exchange_impl(values, dest_shard, mesh, axis):
+    """Route rows to the mesh shard given per-row in `dest_shard`
+    (the Exchange pact: key.shard() % n_workers,
+    reference src/engine/dataflow/operators.rs:128). Dense formulation:
+    every device masks + all-gathers, then keeps its rows — exact semantics
+    of a ragged all-to-all with static shapes (XLA optimizes the gather
+    over ICI)."""
+    from jax import shard_map
+
+    n_shards = mesh.shape[axis]
+
+    def local(vals, dest):
+        # vals: [n_local, d]; dest: [n_local]
+        me = jax.lax.axis_index(axis)
+        all_vals = jax.lax.all_gather(vals, axis, axis=0, tiled=True)
+        all_dest = jax.lax.all_gather(dest, axis, axis=0, tiled=True)
+        keep = all_dest == me
+        # static shape: every device holds the full set, masked rows zeroed
+        out = jnp.where(keep[:, None], all_vals, 0)
+        return out, keep
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(values, dest_shard)
+
+
+def exchange_by_shard(values, dest_shard, mesh, axis: str = "data"):
+    """All-to-all exchange of rows by destination shard id. Returns
+    (gathered_values, keep_mask) replicated per device — each shard's rows
+    are the masked subset."""
+    return _exchange_impl(values, dest_shard, mesh, axis)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def frontier_allreduce(local_time, mesh, axis: str = "data"):
+    """Global frontier = min over shards' local clocks — the tiny all-reduce
+    per tick replacing timely's progress-update broadcast
+    (reference: timely progress tracking, SURVEY §5.8)."""
+    from jax import shard_map
+
+    def local(t):
+        return jax.lax.pmin(t, axis)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
+        check_vma=False,
+    )(local_time)

@@ -1,0 +1,69 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's worker/process topology
+(reference: src/engine/dataflow/config.rs:63-121 — PATHWAY_THREADS ×
+PATHWAY_PROCESSES workers over TCP): scaling out means adding mesh devices,
+not OS processes. The 'data' axis carries the key-shard dimension (the analog
+of the reference's 16-bit key shards, src/engine/value.rs:38)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Sequence
+
+import numpy as np
+
+
+_default_mesh: Any = None
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axis_names: Sequence[str] = ("data",),
+    *,
+    backend: str | None = None,
+):
+    """Build a Mesh over available devices. Falls back to the virtual CPU
+    device pool (xla_force_host_platform_device_count) when the accelerator
+    has fewer devices than requested — how unit tests and the driver's
+    dryrun exercise multi-chip code paths on one host."""
+    import jax
+    from jax.sharding import Mesh
+
+    if backend is not None:
+        devices = jax.devices(backend)
+    else:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            try:
+                cpu = jax.devices("cpu")
+                if len(cpu) >= n_devices:
+                    devices = cpu
+            except RuntimeError:
+                pass
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    shape = _factor_shape(len(devices), len(axis_names))
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def _factor_shape(n: int, n_axes: int) -> tuple[int, ...]:
+    if n_axes == 1:
+        return (n,)
+    # put everything on the first axis by default; callers wanting tp×dp
+    # meshes pass explicit shapes via Mesh directly
+    return (n,) + (1,) * (n_axes - 1)
+
+
+def set_default_mesh(mesh: Any) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_mesh() -> Any:
+    return _default_mesh

@@ -1,0 +1,215 @@
+"""Metadata filtering — a JMESPath-subset evaluator
+(reference: src/external_integration/mod.rs:252 JMESPath + glob filtering;
+the jmespath crate is replaced by a small expression evaluator covering the
+boolean queries the xpack emits: comparisons, &&/||/!, contains(),
+globmatch(), dotted paths)."""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable
+
+from pathway_tpu.internals.json import Json
+
+_TOKEN = re.compile(
+    r"""\s*(
+        (?P<str>'[^']*'|`[^`]*`|"[^"]*") |
+        (?P<num>-?\d+(\.\d+)?) |
+        (?P<op>&&|\|\||==|!=|<=|>=|<|>|!|\(|\)|,) |
+        (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(s: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"cannot tokenize filter at: {s[pos:]!r}")
+        pos = m.end()
+        for kind in ("str", "num", "op", "ident"):
+            v = m.group(kind)
+            if v is not None:
+                out.append((kind, v))
+                break
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def eat(self, kind=None, val=None):
+        k, v = self.toks[self.i]
+        if kind and k != kind or (val is not None and v != val):
+            raise ValueError(f"unexpected token {v!r}")
+        self.i += 1
+        return v
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() == ("op", "||"):
+            self.eat()
+            right = self.parse_and()
+            l, r = left, right
+            left = lambda md, l=l, r=r: bool(l(md)) or bool(r(md))
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.peek() == ("op", "&&"):
+            self.eat()
+            right = self.parse_not()
+            l, r = left, right
+            left = lambda md, l=l, r=r: bool(l(md)) and bool(r(md))
+        return left
+
+    def parse_not(self):
+        if self.peek() == ("op", "!"):
+            self.eat()
+            inner = self.parse_not()
+            return lambda md, i=inner: not bool(i(md))
+        return self.parse_cmp()
+
+    def parse_cmp(self):
+        left = self.parse_atom()
+        k, v = self.peek()
+        if k == "op" and v in ("==", "!=", "<", "<=", ">", ">="):
+            self.eat()
+            right = self.parse_atom()
+
+            def cmp(md, l=left, r=right, op=v):
+                a, b = l(md), r(md)
+                try:
+                    if op == "==":
+                        return a == b
+                    if op == "!=":
+                        return a != b
+                    if a is None or b is None:
+                        return False
+                    if op == "<":
+                        return a < b
+                    if op == "<=":
+                        return a <= b
+                    if op == ">":
+                        return a > b
+                    if op == ">=":
+                        return a >= b
+                except TypeError:
+                    return False
+
+            return cmp
+        return left
+
+    def parse_atom(self):
+        k, v = self.peek()
+        if k == "op" and v == "(":
+            self.eat()
+            inner = self.parse_or()
+            self.eat("op", ")")
+            return inner
+        if k == "str":
+            self.eat()
+            s = v[1:-1]
+            return lambda md, s=s: s
+        if k == "num":
+            self.eat()
+            n = float(v) if "." in v else int(v)
+            return lambda md, n=n: n
+        if k == "ident":
+            self.eat()
+            if v in ("true", "True"):
+                return lambda md: True
+            if v in ("false", "False"):
+                return lambda md: False
+            if v in ("null", "None"):
+                return lambda md: None
+            if self.peek() == ("op", "("):
+                # function call
+                self.eat()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.parse_or())
+                    while self.peek() == ("op", ","):
+                        self.eat()
+                        args.append(self.parse_or())
+                self.eat("op", ")")
+                return _make_fn(v, args)
+            path = v.split(".")
+            return lambda md, p=path: _lookup(md, p)
+        raise ValueError(f"unexpected token {v!r} in filter")
+
+
+def _lookup(md: Any, path: list[str]) -> Any:
+    cur = md
+    for part in path:
+        if isinstance(cur, Json):
+            cur = cur.value
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+    if isinstance(cur, Json):
+        cur = cur.value
+    return cur
+
+
+def _make_fn(name: str, args: list[Callable]) -> Callable:
+    if name == "contains":
+
+        def contains(md):
+            hay, needle = args[0](md), args[1](md)
+            if hay is None:
+                return False
+            return needle in hay
+
+        return contains
+    if name == "globmatch":
+
+        def globmatch(md):
+            pattern, value = args[0](md), args[1](md)
+            if value is None:
+                return False
+            return fnmatch.fnmatch(str(value), str(pattern))
+
+        return globmatch
+    if name == "starts_with":
+        return lambda md: str(args[1](md) or "").startswith(str(args[0](md)))
+    raise ValueError(f"unknown filter function {name!r}")
+
+
+def compile_filter(expr: str) -> Callable[[Any], bool]:
+    """Compile a boolean metadata filter; returns a predicate over the
+    metadata value (dict / Json / None)."""
+    parser = _Parser(_tokenize(expr))
+    fn = parser.parse_or()
+    if parser.peek()[0] != "end":
+        raise ValueError(f"trailing tokens in filter {expr!r}")
+
+    def pred(md: Any) -> bool:
+        if isinstance(md, Json):
+            md = md.value
+        if isinstance(md, str):
+            import json as _json
+
+            try:
+                md = _json.loads(md)
+            except ValueError:
+                pass
+        try:
+            return bool(fn(md))
+        except Exception:
+            return False
+
+    return pred

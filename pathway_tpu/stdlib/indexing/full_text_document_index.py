@@ -1,0 +1,19 @@
+"""Default full-text document index
+(reference: stdlib/indexing/full_text_document_index.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+
+def default_full_text_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    inner = TantivyBM25(data_column, metadata_column)
+    return DataIndex(data_table, inner)

@@ -1,0 +1,38 @@
+"""Retriever factory ABCs (reference: stdlib/indexing/retrievers.py:7-17)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+
+
+class AbstractRetrieverFactory(ABC):
+    @abstractmethod
+    def build_index(
+        self,
+        data_column: ColumnReference,
+        data_table: Table,
+        metadata_column: ColumnExpression | None = None,
+    ) -> DataIndex: ...
+
+
+class InnerIndexFactory(AbstractRetrieverFactory):
+    @abstractmethod
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> InnerIndex: ...
+
+    def build_index(
+        self,
+        data_column: ColumnReference,
+        data_table: Table,
+        metadata_column: ColumnExpression | None = None,
+    ) -> DataIndex:
+        inner = self.build_inner_index(data_column, metadata_column)
+        return DataIndex(data_table, inner)

@@ -1,0 +1,88 @@
+"""Default vector document index factories
+(reference: stdlib/indexing/vector_document_index.py:12-157)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    LshKnn,
+    TpuKnn,
+    USearchKnn,
+    USearchMetricKind,
+)
+
+
+def default_vector_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    return default_usearch_knn_document_index(
+        data_column,
+        data_table,
+        embedder=embedder,
+        dimensions=dimensions,
+        metadata_column=metadata_column,
+    )
+
+
+def default_usearch_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    inner = USearchKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        reserved_space=1024,
+        metric=USearchMetricKind.COS,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_brute_force_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    inner = BruteForceKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        reserved_space=1024,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_lsh_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    inner = LshKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)

@@ -1,0 +1,61 @@
+"""KNN classifiers (reference: stdlib/ml/classifiers/_knn_lsh.py —
+LSH-bucketed KNN vote; here the candidate search runs on TPU)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any
+
+import pathway_tpu.reducers as reducers
+from pathway_tpu.internals.common import apply_with_type
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+
+
+class DistanceTypes(Enum):
+    EUCLIDEAN = "euclidean"
+    COSINE = "cosine"
+
+
+def knn_lsh_classifier_train(
+    data: Table,
+    L: int = 20,
+    type: str = "euclidean",
+    **kwargs: Any,
+):
+    """Train a KNN 'classifier' — returns a function that labels query
+    points by majority vote over the k nearest training rows.
+
+    ``data`` needs columns ``data`` (vector) and ``label``."""
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    dim = kwargs.get("d") or kwargs.get("dimensions")
+    index = KNNIndex(
+        data.data, data, n_dimensions=dim, distance_type=str(type)
+    )
+
+    def label_query(queries: Table, k: int = 3) -> Table:
+        matches = index.get_nearest_items(queries.data, k=k)
+
+        def majority(labels) -> Any:
+            if not labels:
+                return None
+            counts: dict = {}
+            for l in labels:
+                counts[l] = counts.get(l, 0) + 1
+            return max(counts.items(), key=lambda kv: (kv[1], str(kv[0])))[0]
+
+        return matches.select(
+            predicted_label=apply_with_type(majority, Any, matches.label)
+        )
+
+    return label_query
+
+
+def knn_lsh_train(*args, **kwargs):
+    return knn_lsh_classifier_train(*args, **kwargs)
+
+
+def knn_lsh_generic_classifier_train(*args, **kwargs):
+    return knn_lsh_classifier_train(*args, **kwargs)

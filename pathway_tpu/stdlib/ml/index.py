@@ -1,0 +1,122 @@
+"""KNNIndex (reference: stdlib/ml/index.py:9 — there a pure-dataflow LSH ANN;
+here exact dense KNN on the MXU, which dominates LSH at reference scales.
+`distance_type` picks the metric; distances are returned in the reference's
+units (euclidean distance / cosine distance)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import pathway_tpu.reducers as reducers
+from pathway_tpu.internals.common import apply_with_type
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+from pathway_tpu.stdlib.indexing.colnames import _MATCHED_ID, _SCORE
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import TpuKnn
+
+
+class KNNIndex:
+    def __init__(
+        self,
+        data_embedding: ColumnReference,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: str = "euclidean",
+        metadata: ColumnExpression | None = None,
+    ):
+        self.distance_type = distance_type
+        metric = "cosine" if distance_type == "cosine" else "l2sq"
+        self.inner = TpuKnn(
+            data_embedding,
+            metadata,
+            dimensions=n_dimensions,
+            metric=metric,
+        )
+        self.index = DataIndex(data, self.inner)
+        self.data = data
+
+    def _with_dist(self, result: Table) -> Table:
+        dt_kind = self.distance_type
+
+        def to_dists(scores) -> tuple:
+            if scores is None:
+                return ()
+            out = []
+            for s in scores:
+                if dt_kind == "cosine":
+                    out.append(1.0 - float(s))
+                else:
+                    out.append(math.sqrt(max(0.0, -float(s))))
+            return tuple(out)
+
+        return result.with_columns(
+            dist=apply_with_type(to_dists, tuple, result[_SCORE])
+        )
+
+    def _query(
+        self,
+        query_embedding: ColumnReference,
+        k: int,
+        collapse_rows: bool,
+        with_distances: bool,
+        metadata_filter: ColumnExpression | None,
+        as_of_now: bool,
+    ):
+        from pathway_tpu.internals.thisclass import right
+
+        method = (
+            self.index.query_as_of_now if as_of_now else self.index.query
+        )
+        jr = method(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )
+        sel = jr.select(
+            *[right[c] for c in self.data.column_names()],
+            **{_SCORE: right[_SCORE]},
+        )
+        if with_distances:
+            sel = self._with_dist(sel)
+        return sel.without(_SCORE)
+
+    def get_nearest_items(
+        self,
+        query_embedding: ColumnReference,
+        k: int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: ColumnExpression | None = None,
+    ):
+        return self._query(
+            query_embedding,
+            k,
+            collapse_rows,
+            with_distances,
+            metadata_filter,
+            as_of_now=False,
+        )
+
+    def get_nearest_items_asof_now(
+        self,
+        query_embedding: ColumnReference,
+        k: int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: ColumnExpression | None = None,
+    ):
+        return self._query(
+            query_embedding,
+            k,
+            collapse_rows,
+            with_distances,
+            metadata_filter,
+            as_of_now=True,
+        )

@@ -1,0 +1,48 @@
+"""pathway_tpu.xpacks.llm — the RAG product layer
+(reference: python/pathway/xpacks/llm/, ~8.3k LoC)."""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    RAGClient,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.servers import (
+    BaseRestServer,
+    DocumentStoreServer,
+    QARestServer,
+    QASummaryRestServer,
+)
+from pathway_tpu.xpacks.llm.vector_store import (
+    VectorStoreClient,
+    VectorStoreServer,
+)
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+    "DocumentStore",
+    "VectorStoreServer",
+    "VectorStoreClient",
+    "BaseRAGQuestionAnswerer",
+    "AdaptiveRAGQuestionAnswerer",
+    "RAGClient",
+    "answer_with_geometric_rag_strategy",
+    "BaseRestServer",
+    "DocumentStoreServer",
+    "QARestServer",
+    "QASummaryRestServer",
+]

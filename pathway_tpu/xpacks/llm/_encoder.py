@@ -1,0 +1,141 @@
+"""Flax sentence-encoder running on TPU — the local-embedder engine behind
+SentenceTransformerEmbedder / CrossEncoderReranker
+(reference: xpacks/llm/embedders.py:270, rerankers.py:159 — there, torch
+sentence-transformers on CPU/GPU; here a bf16 flax transformer jitted per
+pad-bucket, batch-sharded over the mesh 'data' axis for multi-chip DP).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class TransformerEncoder(nn.Module):
+    vocab_size: int = 30522
+    dim: int = 384
+    depth: int = 6
+    heads: int = 6
+    mlp_ratio: int = 4
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype)(ids)
+        pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype)(
+            jnp.arange(ids.shape[1])[None, :]
+        )
+        x = x + pos
+        attn_mask = mask[:, None, None, :] * mask[:, None, :, None]
+        for _ in range(self.depth):
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads,
+                dtype=self.dtype,
+                deterministic=True,
+            )(h, h, mask=attn_mask.astype(bool))
+            x = x + h
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.dim, dtype=self.dtype)(h)
+            x = x + h
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # masked mean pool + L2 normalize (sentence-transformers convention)
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        pooled = (x * mask[:, :, None]).sum(axis=1) / denom
+        pooled = pooled.astype(jnp.float32)
+        return pooled / (jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-12)
+
+
+class CrossEncoderHead(nn.Module):
+    """Encoder + scalar relevance head (query/doc pair scoring)."""
+
+    encoder: TransformerEncoder
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        emb = self.encoder(ids, mask)
+        return nn.Dense(1, dtype=jnp.float32)(emb)[:, 0]
+
+
+def _bucket_batch(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+class EncoderRuntime:
+    """Owns params + jitted forwards; pads batches to power-of-two buckets so
+    each (batch, seq) bucket compiles once. Optional mesh → batch-dim DP
+    sharding (multi-chip embedding throughput)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        dim: int = 384,
+        depth: int = 6,
+        heads: int = 6,
+        max_len: int = 512,
+        seed: int = 0,
+        mesh: Any = None,
+        axis: str = "data",
+        cross_encoder: bool = False,
+    ):
+        self.max_len = max_len
+        enc = TransformerEncoder(
+            vocab_size=vocab_size,
+            dim=dim,
+            depth=depth,
+            heads=heads,
+            max_len=max_len,
+        )
+        self.model: Any = CrossEncoderHead(enc) if cross_encoder else enc
+        self.dim = dim
+        rng = jax.random.PRNGKey(seed)
+        ids0 = jnp.zeros((1, 16), jnp.int32)
+        mask0 = jnp.ones((1, 16), jnp.float32)
+        self.params = self.model.init(rng, ids0, mask0)
+        self.mesh = mesh
+        self.axis = axis
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # replicate params; shard activations on batch
+            self.params = jax.device_put(
+                self.params, NamedSharding(mesh, P())
+            )
+            self._in_shard = NamedSharding(mesh, P(axis, None))
+        else:
+            self._in_shard = None
+
+        @jax.jit
+        def fwd(params, ids, mask):
+            return self.model.apply(params, ids, mask)
+
+        self._fwd = fwd
+
+    def forward_ids(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        n = ids.shape[0]
+        bucket = _bucket_batch(n)
+        if self.mesh is not None:
+            n_dev = self.mesh.shape[self.axis]
+            bucket = max(bucket, n_dev)
+            bucket = ((bucket + n_dev - 1) // n_dev) * n_dev
+        if bucket != n:
+            ids = np.pad(ids, ((0, bucket - n), (0, 0)))
+            mask = np.pad(mask, ((0, bucket - n), (0, 0)))
+        ids_j = jnp.asarray(ids)
+        mask_j = jnp.asarray(mask)
+        if self._in_shard is not None:
+            ids_j = jax.device_put(ids_j, self._in_shard)
+            mask_j = jax.device_put(mask_j, self._in_shard)
+        out = self._fwd(self.params, ids_j, mask_j)
+        return np.asarray(out)[:n]

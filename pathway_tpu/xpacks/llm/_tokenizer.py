@@ -1,0 +1,99 @@
+"""Deterministic hashing tokenizer for the local TPU encoder.
+
+No vocabulary files / no network: tokens are hashed into a fixed id space
+(feature-hashing). If a HuggingFace tokenizer is locally cached, it can be
+plugged in instead (`HFTokenizerAdapter`)."""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[a-zA-Z]+|\d+|[^\sa-zA-Z\d]", re.UNICODE)
+
+PAD_ID = 0
+CLS_ID = 1
+_RESERVED = 2
+
+
+class HashingTokenizer:
+    def __init__(self, vocab_size: int = 30522, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+
+    def _hash(self, token: str) -> int:
+        h = struct.unpack(
+            "<Q", hashlib.blake2b(token.encode(), digest_size=8).digest()
+        )[0]
+        return _RESERVED + (h % (self.vocab_size - _RESERVED))
+
+    def tokenize(self, text: str) -> list[str]:
+        if self.lowercase:
+            text = text.lower()
+        return _TOKEN_RE.findall(text)
+
+    def encode(self, text: str, max_len: int) -> list[int]:
+        ids = [CLS_ID] + [self._hash(t) for t in self.tokenize(text)]
+        return ids[:max_len]
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (ids [B, L], mask [B, L]) padded to the smallest
+        power-of-two-ish bucket ≥ longest sequence (static shapes for jit)."""
+        encoded = [self.encode(t, max_len) for t in texts]
+        longest = max((len(e) for e in encoded), default=1)
+        bucket = _bucket_len(longest, max_len)
+        ids = np.full((len(texts), bucket), PAD_ID, dtype=np.int32)
+        mask = np.zeros((len(texts), bucket), dtype=np.float32)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = 1.0
+        return ids, mask
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tokenize(text))
+
+
+def _bucket_len(n: int, max_len: int) -> int:
+    # pad to {16, 32, 64, 128, ...} so jit compiles O(log max_len) variants
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, max_len)
+
+
+class HFTokenizerAdapter:
+    """Wraps a locally-cached HuggingFace tokenizer (no downloads)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(
+            name_or_path, local_files_only=True
+        )
+        self.vocab_size = self.tok.vocab_size
+
+    def encode_batch(self, texts, max_len):
+        out = self.tok(
+            list(texts),
+            truncation=True,
+            max_length=max_len,
+            padding=True,
+            return_tensors="np",
+        )
+        ids = out["input_ids"].astype(np.int32)
+        mask = out["attention_mask"].astype(np.float32)
+        bucket = _bucket_len(ids.shape[1], max_len)
+        if ids.shape[1] < bucket:
+            pad = bucket - ids.shape[1]
+            ids = np.pad(ids, ((0, 0), (0, pad)))
+            mask = np.pad(mask, ((0, 0), (0, pad)))
+        return ids, mask
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.tok.encode(text))

@@ -1,0 +1,184 @@
+"""DocumentStore — VectorStoreServer generalized over any retriever factory
+(reference: xpacks/llm/document_store.py:32)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Sequence
+
+import pathway_tpu as pw
+from pathway_tpu.internals.common import apply_with_type
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import right, this
+from pathway_tpu.stdlib.indexing.colnames import _SCORE
+from pathway_tpu.stdlib.indexing.retrievers import AbstractRetrieverFactory
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer, _coerce_doc_tuple
+
+
+class DocumentStore(VectorStoreServer):
+    """Indexing pipeline + queries over an arbitrary retriever factory."""
+
+    def __init__(
+        self,
+        docs: Table | Iterable[Table],
+        retriever_factory: AbstractRetrieverFactory,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: Sequence[Callable] | None = None,
+    ):
+        self.retriever_factory = retriever_factory
+        if isinstance(docs, Table):
+            docs = [docs]
+        # VectorStoreServer.__init__ builds the graph; embedder lives inside
+        # the retriever factory for DocumentStore
+        self.docs = list(docs)
+        self.parser = parser
+        self.splitter = splitter
+        self.doc_post_processors = list(doc_post_processors or [])
+        self.embedding_dimension = None
+        self._index_params = {}
+        self._graph = self._build_graph()
+
+    def _build_graph(self) -> dict:
+        import pathway_tpu.xpacks.llm.vector_store as vs
+
+        # reuse the parse/post-proc/split pipeline, then index raw text via
+        # the retriever factory (which applies its own embedder if any)
+        graph = {}
+        self_embedder_saved = getattr(self, "embedder", None)
+
+        docs_tables = self._clean_tables(self.docs)
+        docs = docs_tables[0]
+        if len(docs_tables) > 1:
+            docs = docs.concat_reindex(*docs_tables[1:])
+
+        parser = self.parser
+        if parser is None:
+            from pathway_tpu.xpacks.llm.parsers import Utf8Parser
+
+            parser = Utf8Parser()
+
+        def parse_doc(data: Any, metadata: Any) -> list:
+            raw = parser.func(data) if hasattr(parser, "func") else parser(data)
+            base_meta = (
+                dict(metadata.value or {})
+                if isinstance(metadata, Json)
+                else dict(metadata or {})
+            )
+            return [
+                Json({"text": t, "metadata": {**base_meta, **m}})
+                for t, m in (_coerce_doc_tuple(e) for e in raw)
+            ]
+
+        parsed = docs.select(
+            docs_list=apply_with_type(parse_doc, list, docs.data, docs._metadata)
+        ).flatten(this.docs_list)
+        parsed = parsed.select(data_json=this.docs_list)
+
+        for processor in self.doc_post_processors:
+
+            def post_proc(data_json: Json, _proc=processor) -> Json:
+                d = data_json.value
+                text, meta = _proc(d["text"], d["metadata"])
+                return Json({"text": text, "metadata": meta})
+
+            parsed = parsed.select(
+                data_json=apply_with_type(post_proc, Json, this.data_json)
+            )
+
+        splitter = self.splitter
+        if splitter is None:
+            from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+            splitter = NullSplitter()
+
+        def split_doc(data_json: Json) -> list:
+            d = data_json.value
+            fn = splitter.func if hasattr(splitter, "func") else splitter
+            return [
+                Json({"text": t, "metadata": {**d["metadata"], **m}})
+                for t, m in (_coerce_doc_tuple(e) for e in fn(d["text"]))
+            ]
+
+        chunked = parsed.select(
+            chunks=apply_with_type(split_doc, list, this.data_json)
+        ).flatten(this.chunks)
+        chunked_docs = chunked.select(
+            text=apply_with_type(lambda j: j.value["text"], str, this.chunks),
+            metadata=apply_with_type(
+                lambda j: Json(j.value["metadata"]), Json, this.chunks
+            ),
+        )
+        chunked_docs = chunked_docs.filter(chunked_docs.text.str.len() > 0)
+
+        index = self.retriever_factory.build_index(
+            chunked_docs.text,
+            chunked_docs,
+            metadata_column=chunked_docs.metadata,
+        )
+        return {
+            "docs": docs,
+            "chunked_docs": chunked_docs,
+            "embedded": chunked_docs,
+            "index": index,
+        }
+
+    def retrieve_query(self, retrieval_queries: Table) -> Table:
+        queries = self.merge_filters(retrieval_queries)
+        jr = self.index.query_as_of_now(
+            queries.query,
+            number_of_matches=queries.k,
+            metadata_filter=queries.metadata_filter,
+        )
+        raw = jr.select(
+            texts=right["text"],
+            metas=right["metadata"],
+            scores=right[_SCORE],
+        )
+
+        def fmt(texts, metas, scores) -> Json:
+            out = []
+            if texts is not None:
+                for t, m, s in zip(texts, metas, scores):
+                    out.append(
+                        {
+                            "text": t,
+                            "metadata": m.value if isinstance(m, Json) else m,
+                            "dist": -float(s),
+                            "score": float(s),
+                        }
+                    )
+            return Json(out)
+
+        return raw.select(
+            result=apply_with_type(fmt, Json, raw.texts, raw.metas, raw.scores)
+        )
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        parsed = self._graph["chunked_docs"]
+        import pathway_tpu.reducers as reducers
+
+        collected = parsed.reduce(
+            texts=reducers.tuple(parsed.text),
+            metas=reducers.tuple(parsed.metadata),
+        )
+        from pathway_tpu.internals.common import if_else
+
+        joined = parse_docs_queries.join_left(
+            collected.with_columns(_one=1),
+            if_else(parse_docs_queries.id == parse_docs_queries.id, 1, 1)
+            == right["_one"],
+            id=parse_docs_queries.id,
+        )
+
+        def fmt(texts, metas) -> Json:
+            out = []
+            for t, m in zip(texts or (), metas or ()):
+                out.append(
+                    {"text": t, "metadata": m.value if isinstance(m, Json) else m}
+                )
+            return Json(out)
+
+        return joined.select(
+            result=apply_with_type(fmt, Json, right["texts"], right["metas"])
+        )

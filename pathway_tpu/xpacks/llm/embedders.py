@@ -1,0 +1,183 @@
+"""Embedders (reference: xpacks/llm/embedders.py — BaseEmbedder:64,
+OpenAIEmbedder:85, LiteLLMEmbedder:180, SentenceTransformerEmbedder:270,
+GeminiEmbedder:330).
+
+The local embedder is TPU-native: a flax encoder jitted per pad-bucket
+(`pathway_tpu/xpacks/llm/_encoder.py`), fed whole ticks at once through the
+engine's batched-UDF path — this is the BASELINE.md "embed docs/sec/chip"
+configuration. API embedders (OpenAI/LiteLLM/Gemini) keep the reference
+surface and degrade with a clear error when the client lib / network is
+unavailable."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.udfs import UDF
+
+
+class BaseEmbedder(UDF):
+    """UDF str -> np.ndarray; also callable on expressions."""
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        out = self.func("pathway", **kwargs)  # type: ignore[misc]
+        if asyncio.iscoroutine(out):
+            out = asyncio.run(out)
+        return len(out)
+
+    def __call__(self, input: Any, **kwargs: Any) -> expr_mod.ColumnExpression:
+        return super().__call__(input, **kwargs)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """Local embedder on TPU
+    (reference name: xpacks/llm/embedders.py:270 — there torch
+    sentence-transformers; here the flax encoder; pass a model name of a
+    locally-cached HF tokenizer to reuse its vocab, otherwise a hashing
+    tokenizer is used)."""
+
+    def __init__(
+        self,
+        model: str = "pathway-tpu/minilm-384",
+        call_kwargs: dict = {},
+        device: str = "tpu",
+        *,
+        dim: int = 384,
+        depth: int = 6,
+        heads: int = 6,
+        max_len: int = 512,
+        mesh: Any = None,
+        batch_size: int = 1024,
+        **init_kwargs,
+    ):
+        from pathway_tpu.xpacks.llm._encoder import EncoderRuntime
+        from pathway_tpu.xpacks.llm._tokenizer import (
+            HashingTokenizer,
+            HFTokenizerAdapter,
+        )
+
+        try:
+            self.tokenizer: Any = HFTokenizerAdapter(model)
+            vocab_size = self.tokenizer.vocab_size
+        except Exception:
+            self.tokenizer = HashingTokenizer()
+            vocab_size = self.tokenizer.vocab_size
+        self.runtime = EncoderRuntime(
+            vocab_size=vocab_size,
+            dim=dim,
+            depth=depth,
+            heads=heads,
+            max_len=max_len,
+            mesh=mesh,
+        )
+        self.model = model
+        self.kwargs = call_kwargs
+
+        def embed_batch(texts: Sequence[str]) -> list[np.ndarray]:
+            ids, mask = self.tokenizer.encode_batch(
+                [str(t) for t in texts], max_len
+            )
+            out = self.runtime.forward_ids(ids, mask)
+            return [out[i] for i in range(len(texts))]
+
+        self._embed_batch = embed_batch
+        super().__init__(
+            return_type=np.ndarray, max_batch_size=batch_size, deterministic=True
+        )
+        self._prepare(self._single)
+        self._batched = True
+        # batched path: fn receives a list of texts
+        self._fn = embed_batch
+
+    def _single(self, text: str) -> np.ndarray:
+        return self._embed_batch([text])[0]
+
+    @property
+    def func(self):
+        return self._single
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self.runtime.dim
+
+
+class _ApiEmbedder(BaseEmbedder):
+    """Shared plumbing for API-backed embedders."""
+
+    def __init__(self, capacity=None, retry_strategy=None, cache_strategy=None, **kwargs):
+        self._api_kwargs = kwargs
+        super().__init__(
+            return_type=np.ndarray,
+            cache_strategy=cache_strategy,
+            retry_strategy=retry_strategy,
+        )
+        self._prepare(self._embed)
+
+    async def _embed(self, input: str, **kwargs) -> np.ndarray:
+        raise NotImplementedError
+
+
+class OpenAIEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:85) — requires the `openai` package +
+    network access."""
+
+    def __init__(self, model: str = "text-embedding-3-small", **kwargs):
+        self.model = model
+        super().__init__(**kwargs)
+
+    async def _embed(self, input: str, **kwargs) -> np.ndarray:
+        try:
+            import openai  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError(
+                "OpenAIEmbedder requires the `openai` package; use "
+                "SentenceTransformerEmbedder for on-TPU embedding"
+            ) from exc
+        client = openai.AsyncOpenAI(**self._api_kwargs)
+        ret = await client.embeddings.create(
+            input=[input or "."], model=kwargs.get("model", self.model)
+        )
+        return np.array(ret.data[0].embedding)
+
+
+class LiteLLMEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:180)"""
+
+    def __init__(self, model: str = "", **kwargs):
+        self.model = model
+        super().__init__(**kwargs)
+
+    async def _embed(self, input: str, **kwargs) -> np.ndarray:
+        try:
+            import litellm  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("LiteLLMEmbedder requires `litellm`") from exc
+        ret = await litellm.aembedding(
+            input=[input or "."], model=kwargs.get("model", self.model)
+        )
+        return np.array(ret.data[0]["embedding"])
+
+
+class GeminiEmbedder(_ApiEmbedder):
+    """(reference: embedders.py:330)"""
+
+    def __init__(self, model: str = "models/embedding-001", **kwargs):
+        self.model = model
+        super().__init__(**kwargs)
+
+    async def _embed(self, input: str, **kwargs) -> np.ndarray:
+        try:
+            import google.generativeai as genai  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("GeminiEmbedder requires `google-generativeai`") from exc
+        ret = genai.embed_content(
+            model=kwargs.get("model", self.model), content=input or "."
+        )
+        return np.array(ret["embedding"])
+
+
+class OpenAIEmbedderWithDimensions(OpenAIEmbedder):
+    pass

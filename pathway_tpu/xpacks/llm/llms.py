@@ -1,0 +1,171 @@
+"""Chat/LLM wrappers (reference: xpacks/llm/llms.py — BaseChat:40,
+OpenAIChat:97, LiteLLMChat:320, HFPipelineChat:445, CohereChat:547).
+
+API chats keep the reference surface (gated on their client libs);
+`HFPipelineChat` runs a locally-cached transformers pipeline. `EchoChat` is
+the deterministic offline model used in tests."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.udfs import UDF
+
+
+def prompt_chat_single_qa(question: str) -> tuple:
+    return ({"role": "system", "content": question},)
+
+
+class BaseChat(UDF):
+    def __init__(self, **kwargs):
+        super().__init__(return_type=str, **kwargs)
+        self._prepare(self._accept)
+
+    def _accept(self, messages, **kwargs) -> str:
+        raise NotImplementedError
+
+    def __call__(self, messages: Any, **kwargs) -> expr_mod.ColumnExpression:
+        return super().__call__(messages, **kwargs)
+
+
+def _messages_to_prompt(messages: Any) -> str:
+    if isinstance(messages, str):
+        return messages
+    parts = []
+    for m in messages:
+        if isinstance(m, dict):
+            parts.append(str(m.get("content", "")))
+        else:
+            parts.append(str(m))
+    return "\n".join(parts)
+
+
+class EchoChat(BaseChat):
+    """Deterministic offline 'LLM': echoes the tail of the prompt. Useful for
+    tests and wiring checks (the reference tests use similar fakes,
+    python/pathway/xpacks/llm/tests/mocks.py)."""
+
+    def __init__(self, prefix: str = "", **kwargs):
+        self.prefix = prefix
+        super().__init__(**kwargs)
+
+    def _accept(self, messages, **kwargs) -> str:
+        return self.prefix + _messages_to_prompt(messages)
+
+
+class OpenAIChat(BaseChat):
+    """(reference: llms.py:97)"""
+
+    def __init__(self, model: str | None = "gpt-3.5-turbo", **kwargs):
+        self.model = model
+        self._api_kwargs = {
+            k: v
+            for k, v in kwargs.items()
+            if k in ("api_key", "base_url", "organization")
+        }
+        super().__init__(
+            cache_strategy=kwargs.get("cache_strategy"),
+            retry_strategy=kwargs.get("retry_strategy"),
+        )
+
+    async def _accept(self, messages, **kwargs) -> str:
+        try:
+            import openai  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError(
+                "OpenAIChat requires the `openai` package"
+            ) from exc
+        client = openai.AsyncOpenAI(**self._api_kwargs)
+        msgs = (
+            [{"role": "user", "content": messages}]
+            if isinstance(messages, str)
+            else list(messages)
+        )
+        ret = await client.chat.completions.create(
+            messages=msgs, model=kwargs.get("model", self.model)
+        )
+        return ret.choices[0].message.content
+
+
+class LiteLLMChat(BaseChat):
+    """(reference: llms.py:320)"""
+
+    def __init__(self, model: str | None = None, **kwargs):
+        self.model = model
+        super().__init__()
+
+    async def _accept(self, messages, **kwargs) -> str:
+        try:
+            import litellm  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("LiteLLMChat requires `litellm`") from exc
+        msgs = (
+            [{"role": "user", "content": messages}]
+            if isinstance(messages, str)
+            else list(messages)
+        )
+        ret = await litellm.acompletion(
+            model=kwargs.get("model", self.model), messages=msgs
+        )
+        return ret["choices"][0]["message"]["content"]
+
+
+class HFPipelineChat(BaseChat):
+    """Local transformers pipeline (reference: llms.py:445). Works offline
+    when the model is in the local HF cache."""
+
+    def __init__(
+        self,
+        model: str | None = None,
+        call_kwargs: dict = {},
+        device: str = "cpu",
+        **pipeline_kwargs,
+    ):
+        self.model = model
+        self.call_kwargs = call_kwargs
+        self._pipeline = None
+        self._pipeline_kwargs = pipeline_kwargs
+        super().__init__()
+
+    def _get_pipeline(self):
+        if self._pipeline is None:
+            from transformers import pipeline
+
+            self._pipeline = pipeline(
+                "text-generation", model=self.model, **self._pipeline_kwargs
+            )
+        return self._pipeline
+
+    def _accept(self, messages, **kwargs) -> str:
+        pipe = self._get_pipeline()
+        prompt = _messages_to_prompt(messages)
+        out = pipe(prompt, **self.call_kwargs)
+        text = out[0]["generated_text"]
+        if isinstance(text, list):
+            text = text[-1].get("content", "")
+        return str(text)
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        words = str(input_string).split()
+        return " ".join(words[:max_prompt_length])
+
+
+class CohereChat(BaseChat):
+    """(reference: llms.py:547)"""
+
+    def __init__(self, model: str | None = "command", **kwargs):
+        self.model = model
+        super().__init__()
+
+    async def _accept(self, messages, **kwargs) -> str:
+        try:
+            import cohere  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("CohereChat requires `cohere`") from exc
+        client = cohere.AsyncClient()
+        ret = await client.chat(
+            message=_messages_to_prompt(messages),
+            model=kwargs.get("model", self.model),
+        )
+        return ret.text

@@ -1,0 +1,121 @@
+"""Document parsers (reference: xpacks/llm/parsers.py — Utf8:46,
+Unstructured:82, Docling:329, ImageParser:456, SlideParser:598, Pypdf:775).
+
+Parsers are UDFs bytes -> list[tuple[str, dict]] (text, metadata)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.udfs import UDF
+
+
+class Utf8Parser(UDF):
+    """Decode bytes as UTF-8 (reference: parsers.py:46 ParseUtf8)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(return_type=list, **kwargs)
+        self._prepare(self.parse)
+
+    def parse(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        if isinstance(contents, str):
+            return [(contents, {})]
+        return [(contents.decode("utf-8", errors="replace"), {})]
+
+    @property
+    def func(self):
+        return self.parse
+
+
+ParseUtf8 = Utf8Parser
+
+
+class PypdfParser(UDF):
+    """PDF text extraction via pypdf (reference: parsers.py:775)."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        self.apply_text_cleanup = apply_text_cleanup
+        super().__init__(return_type=list, **kwargs)
+        self._prepare(self.parse)
+
+    def parse(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        try:
+            from pypdf import PdfReader  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("PypdfParser requires `pypdf`") from exc
+        import io
+
+        reader = PdfReader(io.BytesIO(contents))
+        out = []
+        for i, page in enumerate(reader.pages):
+            text = page.extract_text() or ""
+            if self.apply_text_cleanup:
+                text = " ".join(text.split())
+            out.append((text, {"page": i}))
+        return out
+
+    @property
+    def func(self):
+        return self.parse
+
+
+class UnstructuredParser(UDF):
+    """(reference: parsers.py:82) — requires `unstructured`."""
+
+    def __init__(self, mode: str = "single", **kwargs):
+        self.mode = mode
+        super().__init__(return_type=list)
+        self._prepare(self.parse)
+
+    def parse(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        try:
+            from unstructured.partition.auto import partition  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError(
+                "UnstructuredParser requires `unstructured`; "
+                "Utf8Parser and PypdfParser work without extra deps"
+            ) from exc
+        import io
+
+        elements = partition(file=io.BytesIO(contents))
+        if self.mode == "single":
+            return [("\n\n".join(str(e) for e in elements), {})]
+        return [(str(e), {"category": e.category}) for e in elements]
+
+    @property
+    def func(self):
+        return self.parse
+
+
+class DoclingParser(UnstructuredParser):
+    """(reference: parsers.py:329) — gated on `docling`."""
+
+    def parse(self, contents: bytes, **kwargs):
+        try:
+            from docling.document_converter import DocumentConverter  # type: ignore[import-not-found]
+        except ImportError as exc:
+            raise ImportError("DoclingParser requires `docling`") from exc
+        raise NotImplementedError
+
+
+class ImageParser(UDF):
+    """Vision-LLM image description (reference: parsers.py:456)."""
+
+    def __init__(self, llm: Any = None, prompt: str = "Describe the image.", **kwargs):
+        self.llm = llm
+        self.prompt = prompt
+        super().__init__(return_type=list)
+        self._prepare(self.parse)
+
+    def parse(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        raise NotImplementedError(
+            "ImageParser requires a vision LLM endpoint; configure `llm`"
+        )
+
+    @property
+    def func(self):
+        return self.parse
+
+
+class SlideParser(ImageParser):
+    """(reference: parsers.py:598)"""

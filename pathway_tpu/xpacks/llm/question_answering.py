@@ -1,0 +1,369 @@
+"""RAG question answering (reference: xpacks/llm/question_answering.py —
+answer_with_geometric_rag_strategy:97, BaseRAGQuestionAnswerer:314,
+AdaptiveRAGQuestionAnswerer:638, DeckRetriever:761, RAGClient:879)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import pathway_tpu as pw
+from pathway_tpu.internals.common import apply_with_type
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.schema import column_definition
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import right, this
+from pathway_tpu.xpacks.llm import prompts as prompt_lib
+
+
+def answer_with_geometric_rag_strategy(
+    questions: Sequence[str] | Any,
+    documents: Sequence[Any],
+    llm_chat_model: Any,
+    n_starting_documents: int,
+    factor: int,
+    max_iterations: int,
+    strict_prompt: bool = False,
+) -> str | None:
+    """Adaptive document-count loop: ask with n docs; if the model answers
+    'no information', retry with n*factor docs
+    (reference: question_answering.py:97-162)."""
+    question = questions if isinstance(questions, str) else questions[0]
+    n = n_starting_documents
+    for _ in range(max_iterations):
+        docs = list(documents)[:n]
+        prompt = prompt_lib.prompt_qa_geometric_rag(question, docs)
+        answer = llm_chat_model.func(prompt)
+        if answer and "no information" not in str(answer).lower():
+            return str(answer)
+        if n >= len(documents):
+            break
+        n *= factor
+    return None
+
+
+def answer_with_geometric_rag_strategy_from_index(
+    questions: Any,
+    index: Any,
+    documents_column: str,
+    llm_chat_model: Any,
+    n_starting_documents: int,
+    factor: int,
+    max_iterations: int,
+    **kwargs,
+):
+    raise NotImplementedError(
+        "use AdaptiveRAGQuestionAnswerer for the table-level flow"
+    )
+
+
+class BaseQuestionAnswerer:
+    AnswerQuerySchema: Any
+    RetrieveQuerySchema: Any
+    StatisticsQuerySchema: Any
+    InputsQuerySchema: Any
+
+
+class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
+    """retrieve → build prompt → LLM → answer
+    (reference: question_answering.py:314)."""
+
+    def __init__(
+        self,
+        llm: Any,
+        indexer: Any,  # VectorStoreServer | DocumentStore
+        *,
+        default_llm_name: str | None = None,
+        prompt_template: Callable[[str, Sequence[Any]], str] | None = None,
+        summarize_template: Callable | None = None,
+        search_topk: int = 6,
+    ):
+        self.llm = llm
+        self.indexer = indexer
+        self.search_topk = search_topk
+        self.prompt_template = prompt_template or prompt_lib.prompt_qa
+        self.summarize_template = summarize_template or prompt_lib.prompt_summarize
+        self.server: Any = None
+        self._pending_endpoints: list = []
+
+        class AnswerQuerySchema(pw.Schema):
+            prompt: str
+            filters: str | None = column_definition(default_value=None, dtype=str)
+            model: str | None = column_definition(default_value=None, dtype=str)
+            return_context_docs: bool = column_definition(
+                default_value=False, dtype=bool
+            )
+
+        class SummarizeQuerySchema(pw.Schema):
+            text_list: Json
+
+        self.AnswerQuerySchema = AnswerQuerySchema
+        self.SummarizeQuerySchema = SummarizeQuerySchema
+        self.RetrieveQuerySchema = indexer.RetrieveQuerySchema
+        self.StatisticsQuerySchema = indexer.StatisticsQuerySchema
+        self.InputsQuerySchema = indexer.InputsQuerySchema
+
+    # --- table-level flows ----------------------------------------------------
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        """reference: BaseRAGQuestionAnswerer.answer_query"""
+        retrieve_queries = pw_ai_queries.select(
+            query=this.prompt,
+            k=self.search_topk,
+            metadata_filter=this.filters,
+            filepath_globpattern=None,
+        )
+        retrieved = self.indexer.retrieve_query(retrieve_queries)
+        combined = pw_ai_queries.with_columns(
+            docs=retrieved.with_universe_of(pw_ai_queries).result
+        )
+        prompt_template = self.prompt_template
+        llm = self.llm
+
+        def build_prompt(prompt: str, docs: Json) -> str:
+            doc_list = docs.value if isinstance(docs, Json) else list(docs or [])
+            return prompt_template(prompt, doc_list or [])
+
+        with_prompt = combined.with_columns(
+            _full_prompt=apply_with_type(
+                build_prompt, str, this.prompt, this.docs
+            )
+        )
+        answered = with_prompt.with_columns(
+            response=llm(this._full_prompt)
+        )
+
+        def fmt(response, docs, return_context_docs) -> Json:
+            out: dict[str, Any] = {"response": response}
+            if return_context_docs:
+                out["context_docs"] = (
+                    docs.value if isinstance(docs, Json) else docs
+                )
+            return Json(out)
+
+        return answered.select(
+            result=apply_with_type(
+                fmt, Json, this.response, this.docs, this.return_context_docs
+            )
+        )
+
+    # alias used by reference servers
+    pw_ai_query = answer_query
+
+    def summarize_query(self, summarize_queries: Table) -> Table:
+        template = self.summarize_template
+        llm = self.llm
+
+        def build(text_list: Json) -> str:
+            tl = text_list.value if isinstance(text_list, Json) else text_list
+            return template(tl or [])
+
+        with_prompt = summarize_queries.with_columns(
+            _prompt=apply_with_type(build, str, this.text_list)
+        )
+        answered = with_prompt.with_columns(response=llm(this._prompt))
+        return answered.select(
+            result=apply_with_type(lambda r: Json({"response": r}), Json, this.response)
+        )
+
+    def retrieve(self, queries: Table) -> Table:
+        return self.indexer.retrieve_query(queries)
+
+    def statistics(self, queries: Table) -> Table:
+        return self.indexer.statistics_query(queries)
+
+    def list_documents(self, queries: Table) -> Table:
+        return self.indexer.inputs_query(queries)
+
+    # --- serving -------------------------------------------------------------
+
+    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+        """Register the RAG REST endpoints
+        (reference: question_answering.py build_server)."""
+        from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+        webserver = PathwayWebserver(host=host, port=port)
+        self.server = webserver
+
+        def serve(route, schema, handler):
+            queries, writer = rest_connector(
+                webserver=webserver,
+                route=route,
+                schema=schema,
+                methods=("POST",),
+                delete_completed_queries=True,
+            )
+            result = handler(queries)
+            writer(result.select(query_id=result.id, result=result.result))
+
+        serve("/v1/pw_ai_answer", self.AnswerQuerySchema, self.answer_query)
+        serve(
+            "/v1/pw_ai_summary", self.SummarizeQuerySchema, self.summarize_query
+        )
+        serve("/v2/answer", self.AnswerQuerySchema, self.answer_query)
+        serve("/v2/summarize", self.SummarizeQuerySchema, self.summarize_query)
+
+        def wrap_result(handler):
+            def inner(queries):
+                out = handler(queries)
+                return out
+
+            return inner
+
+        from pathway_tpu.internals.common import apply_with_type as awt
+
+        def retrieve_handler(queries):
+            return self.indexer.retrieve_query(queries)
+
+        def statistics_handler(queries):
+            return self.indexer.statistics_query(queries)
+
+        def inputs_handler(queries):
+            return self.indexer.inputs_query(queries)
+
+        serve("/v1/retrieve", self.RetrieveQuerySchema, retrieve_handler)
+        serve("/v2/list_documents", self.InputsQuerySchema, inputs_handler)
+        serve("/v1/statistics", self.StatisticsQuerySchema, statistics_handler)
+        serve("/v1/pw_list_documents", self.InputsQuerySchema, inputs_handler)
+
+    def run_server(
+        self,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        terminate_on_error: bool = True,
+        threaded: bool = False,
+        **kwargs,
+    ):
+        def run():
+            pw.run(terminate_on_error=terminate_on_error)
+
+        if threaded:
+            t = threading.Thread(target=run, daemon=True, name="RAGServer")
+            t.start()
+            return t
+        run()
+
+
+class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """Geometrically grow the retrieved-docs count until the LLM finds an
+    answer (reference: question_answering.py:638)."""
+
+    def __init__(
+        self,
+        llm: Any,
+        indexer: Any,
+        *,
+        n_starting_documents: int = 2,
+        factor: int = 2,
+        max_iterations: int = 4,
+        strict_prompt: bool = False,
+        **kwargs,
+    ):
+        super().__init__(llm, indexer, **kwargs)
+        self.n_starting_documents = n_starting_documents
+        self.factor = factor
+        self.max_iterations = max_iterations
+        self.strict_prompt = strict_prompt
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        max_docs = self.n_starting_documents * (
+            self.factor ** (self.max_iterations - 1)
+        )
+        retrieve_queries = pw_ai_queries.select(
+            query=this.prompt,
+            k=max_docs,
+            metadata_filter=this.filters,
+            filepath_globpattern=None,
+        )
+        retrieved = self.indexer.retrieve_query(retrieve_queries)
+        combined = pw_ai_queries.with_columns(
+            docs=retrieved.with_universe_of(pw_ai_queries).result
+        )
+        llm = self.llm
+        n0, factor, iters = (
+            self.n_starting_documents,
+            self.factor,
+            self.max_iterations,
+        )
+
+        def adaptive(prompt: str, docs: Json) -> Json:
+            doc_list = docs.value if isinstance(docs, Json) else list(docs or [])
+            answer = answer_with_geometric_rag_strategy(
+                prompt, doc_list or [], llm, n0, factor, iters
+            )
+            return Json({"response": answer})
+
+        return combined.select(
+            result=apply_with_type(adaptive, Json, this.prompt, this.docs)
+        )
+
+
+class DeckRetriever(BaseRAGQuestionAnswerer):
+    """Slide-deck search app (reference: question_answering.py:761)."""
+
+
+class RAGClient:
+    """HTTP client for the RAG REST API (reference: question_answering.py:879)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int = 90,
+        additional_headers: dict | None = None,
+    ):
+        if url is None:
+            url = f"http://{host}:{port}"
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.headers = additional_headers or {}
+
+    def _post(self, route: str, payload: dict):
+        import requests
+
+        resp = requests.post(
+            f"{self.url}{route}",
+            json=payload,
+            headers=self.headers,
+            timeout=self.timeout,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def answer(self, prompt: str, filters: str | None = None, **kwargs):
+        return self._post(
+            "/v2/answer", {"prompt": prompt, "filters": filters, **kwargs}
+        )
+
+    pw_ai_answer = answer
+
+    def summarize(self, text_list: list[str], **kwargs):
+        return self._post("/v2/summarize", {"text_list": text_list, **kwargs})
+
+    pw_ai_summary = summarize
+
+    def retrieve(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ):
+        return self._post(
+            "/v1/retrieve",
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )
+
+    def statistics(self):
+        return self._post("/v1/statistics", {})
+
+    def list_documents(self, filters: str | None = None, keys: list | None = None):
+        return self._post("/v2/list_documents", {"metadata_filter": filters})
+
+    pw_list_documents = list_documents

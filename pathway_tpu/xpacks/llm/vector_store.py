@@ -1,0 +1,446 @@
+"""VectorStoreServer — live document index + REST retrieval
+(reference: xpacks/llm/vector_store.py:39 — _build_graph:227-309,
+retrieve/statistics/inputs queries:311-500, VectorStoreClient:651).
+
+The document side (parse → post-process → split → embed → index) runs on TPU
+through the batched embedder; retrieval is the on-chip dense top-k."""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+import pathway_tpu as pw
+import pathway_tpu.reducers as reducers
+from pathway_tpu.internals import dtype as dtp
+from pathway_tpu.internals.common import apply_with_type, coalesce, if_else
+from pathway_tpu.internals.expression import ColumnExpression
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.schema import column_definition
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+from pathway_tpu.stdlib.indexing.colnames import _MATCHED_ID, _SCORE
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    USearchKnn,
+    USearchMetricKind,
+)
+
+
+def _coerce_doc_tuple(value: Any) -> tuple:
+    """Normalize splitter/parser output entries to (text, metadata-dict)."""
+    if isinstance(value, (tuple, list)):
+        text = value[0]
+        meta = value[1] if len(value) > 1 else {}
+    else:
+        text, meta = value, {}
+    if isinstance(meta, Json):
+        meta = meta.value
+    return str(text), dict(meta or {})
+
+
+class VectorStoreServer:
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Any,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: Sequence[Callable] | None = None,
+        index_params: dict | None = None,
+    ):
+        self.docs = list(docs)
+        self.embedder = embedder
+        self.parser = parser
+        self.splitter = splitter
+        self.doc_post_processors = list(doc_post_processors or [])
+        try:
+            self.embedding_dimension = embedder.get_embedding_dimension()
+        except Exception:
+            self.embedding_dimension = None
+        self._index_params = index_params or {}
+        self._graph = self._build_graph()
+
+    # --- document pipeline ---------------------------------------------------
+
+    def _clean_tables(self, docs: Iterable[Table]) -> list[Table]:
+        out = []
+        for doc in docs:
+            cols = doc.column_names()
+            exprs: dict[str, Any] = {"data": doc[cols[0]] if "data" not in cols else doc.data}
+            if "_metadata" in cols:
+                exprs["_metadata"] = doc["_metadata"]
+            else:
+                exprs["_metadata"] = apply_with_type(
+                    lambda *_: Json({}), Json, doc[cols[0]]
+                )
+            out.append(doc.select(**exprs))
+        return out
+
+    def _build_graph(self) -> dict:
+        docs_tables = self._clean_tables(self.docs)
+        if not docs_tables:
+            raise ValueError("provide at least one document table")
+        docs = docs_tables[0]
+        if len(docs_tables) > 1:
+            docs = docs.concat_reindex(*docs_tables[1:])
+
+        parser = self.parser
+        if parser is None:
+            from pathway_tpu.xpacks.llm.parsers import Utf8Parser
+
+            parser = Utf8Parser()
+
+        def parse_doc(data: Any, metadata: Any) -> list:
+            raw = parser.func(data) if hasattr(parser, "func") else parser(data)
+            if isinstance(metadata, Json):
+                base_meta = dict(metadata.value or {})
+            else:
+                base_meta = dict(metadata or {})
+            out = []
+            for entry in raw:
+                text, meta = _coerce_doc_tuple(entry)
+                out.append(Json({"text": text, "metadata": {**base_meta, **meta}}))
+            return out
+
+        parsed = docs.select(
+            docs_list=apply_with_type(parse_doc, list, docs.data, docs._metadata)
+        ).flatten(this.docs_list)
+        parsed = parsed.select(data_json=this.docs_list)
+
+        for processor in self.doc_post_processors:
+
+            def post_proc(data_json: Json, _proc=processor) -> Json:
+                d = data_json.value
+                text, meta = _proc(d["text"], d["metadata"])
+                return Json({"text": text, "metadata": meta})
+
+            parsed = parsed.select(
+                data_json=apply_with_type(post_proc, Json, this.data_json)
+            )
+
+        splitter = self.splitter
+        if splitter is None:
+            from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+            splitter = NullSplitter()
+
+        def split_doc(data_json: Json) -> list:
+            d = data_json.value
+            fn = splitter.func if hasattr(splitter, "func") else splitter
+            chunks = fn(d["text"])
+            out = []
+            for entry in chunks:
+                text, meta = _coerce_doc_tuple(entry)
+                out.append(
+                    Json({"text": text, "metadata": {**d["metadata"], **meta}})
+                )
+            return out
+
+        chunked = parsed.select(
+            chunks=apply_with_type(split_doc, list, this.data_json)
+        ).flatten(this.chunks)
+        chunked_docs = chunked.select(
+            text=apply_with_type(lambda j: j.value["text"], str, this.chunks),
+            metadata=apply_with_type(
+                lambda j: Json(j.value["metadata"]), Json, this.chunks
+            ),
+        )
+        chunked_docs = chunked_docs.filter(chunked_docs.text.str.len() > 0)
+
+        embedded = chunked_docs.with_columns(
+            embedding=self.embedder(chunked_docs.text)
+        )
+
+        inner = USearchKnn(
+            embedded.embedding,
+            embedded.metadata,
+            dimensions=self.embedding_dimension,
+            metric=USearchMetricKind.COS,
+            **self._index_params,
+        )
+        index = DataIndex(embedded, inner)
+        return {
+            "docs": docs,
+            "chunked_docs": chunked_docs,
+            "embedded": embedded,
+            "index": index,
+        }
+
+    @property
+    def index(self) -> DataIndex:
+        return self._graph["index"]
+
+    # --- query schemas (reference: vector_store.py:311-437) ------------------
+
+    class StatisticsQuerySchema(pw.Schema):
+        pass
+
+    class QueryResultSchema(pw.Schema):
+        result: Json
+
+    class InputResultSchema(pw.Schema):
+        result: Json
+
+    class FilterSchema(pw.Schema):
+        metadata_filter: str | None = column_definition(
+            default_value=None, dtype=str
+        )
+        filepath_globpattern: str | None = column_definition(
+            default_value=None, dtype=str
+        )
+
+    InputsQuerySchema = FilterSchema
+
+    class RetrieveQuerySchema(pw.Schema):
+        query: str
+        k: int = column_definition(default_value=3, dtype=int)
+        metadata_filter: str | None = column_definition(
+            default_value=None, dtype=str
+        )
+        filepath_globpattern: str | None = column_definition(
+            default_value=None, dtype=str
+        )
+
+    # --- queries -------------------------------------------------------------
+
+    @staticmethod
+    def merge_filters(queries: Table) -> Table:
+        """Combine metadata_filter + filepath_globpattern into one filter
+        expression (reference: vector_store.py:359)."""
+
+        def combine(metadata_filter, globpattern) -> str | None:
+            parts = []
+            if metadata_filter:
+                parts.append(f"({metadata_filter})")
+            if globpattern:
+                parts.append(f"globmatch('{globpattern}', path)")
+            return " && ".join(parts) if parts else None
+
+        queries = queries.with_columns(
+            metadata_filter=apply_with_type(
+                combine,
+                dtp.Optional_(dtp.STR),
+                this.metadata_filter,
+                this.filepath_globpattern,
+            )
+        )
+        return queries.without("filepath_globpattern")
+
+    def retrieve_query(self, retrieval_queries: Table) -> Table:
+        queries = self.merge_filters(retrieval_queries)
+        emb = self.embedder(queries.query)
+        queries = queries.with_columns(_q_emb=emb)
+        jr = self.index.query_as_of_now(
+            queries._q_emb,
+            number_of_matches=queries.k,
+            metadata_filter=queries.metadata_filter,
+        )
+        from pathway_tpu.internals.thisclass import right
+
+        raw = jr.select(
+            texts=right["text"],
+            metas=right["metadata"],
+            scores=right[_SCORE],
+        )
+
+        def fmt(texts, metas, scores) -> Json:
+            out = []
+            if texts is not None:
+                for t, m, s in zip(texts, metas, scores):
+                    out.append(
+                        {
+                            "text": t,
+                            "metadata": m.value if isinstance(m, Json) else m,
+                            "dist": 1.0 - float(s),
+                        }
+                    )
+            return Json(out)
+
+        return raw.select(
+            result=apply_with_type(
+                fmt, Json, raw.texts, raw.metas, raw.scores
+            )
+        )
+
+    def statistics_query(self, info_queries: Table) -> Table:
+        stats = self._graph["chunked_docs"].reduce(
+            count=reducers.count(),
+        )
+
+        def fmt(count) -> Json:
+            return Json(
+                {
+                    "file_count": int(count) if count is not None else 0,
+                    "last_modified": None,
+                    "last_indexed": None,
+                }
+            )
+
+        # every query joins the single global-stats row (constant join key)
+        from pathway_tpu.internals.thisclass import right
+
+        joined = info_queries.join_left(
+            stats.with_columns(_one=1),
+            if_else(info_queries.id == info_queries.id, 1, 1)
+            == right["_one"],
+            id=info_queries.id,
+        )
+        return joined.select(
+            result=apply_with_type(fmt, Json, right["count"])
+        )
+
+    def inputs_query(self, input_queries: Table) -> Table:
+        metas = self._graph["chunked_docs"].reduce(
+            metas=reducers.tuple(this.metadata)
+        )
+        queries = self.merge_filters(input_queries)
+        from pathway_tpu.internals.thisclass import right
+        from pathway_tpu.stdlib.indexing._filters import compile_filter
+
+        joined = queries.join_left(
+            metas.with_columns(_one=1),
+            if_else(queries.id == queries.id, 1, 1) == right["_one"],
+            id=queries.id,
+        )
+
+        def fmt(metas_v, flt) -> Json:
+            pred = compile_filter(flt) if flt else None
+            seen = []
+            out = []
+            for m in metas_v or ():
+                mv = m.value if isinstance(m, Json) else m
+                if pred is not None and not pred(mv):
+                    continue
+                key = mv.get("path") if isinstance(mv, dict) else str(mv)
+                if key in seen:
+                    continue
+                seen.append(key)
+                out.append(mv)
+            return Json(out)
+
+        return joined.select(
+            result=apply_with_type(
+                fmt, Json, right["metas"], queries.metadata_filter
+            )
+        )
+
+    # --- REST serving (reference: vector_store.py:478) ------------------------
+
+    def run_server(
+        self,
+        host: str,
+        port: int,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        terminate_on_error: bool = True,
+        **kwargs,
+    ):
+        from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+        webserver = PathwayWebserver(host=host, port=port)
+
+        def serve(route, schema, handler):
+            queries, writer = rest_connector(
+                webserver=webserver,
+                route=route,
+                schema=schema,
+                methods=("GET", "POST"),
+                delete_completed_queries=True,
+            )
+            result = handler(queries)
+            writer(result.select(query_id=result.id, result=result.result))
+
+        serve("/v1/retrieve", self.RetrieveQuerySchema, self.retrieve_query)
+        serve("/v1/statistics", self.StatisticsQuerySchema, self.statistics_query)
+        serve("/v1/inputs", self.InputsQuerySchema, self.inputs_query)
+
+        def run():
+            pw.run(terminate_on_error=terminate_on_error)
+
+        if threaded:
+            t = threading.Thread(target=run, daemon=True, name="VectorStoreServer")
+            t.start()
+            return t
+        run()
+
+    def __repr__(self):
+        return f"VectorStoreServer({self.embedder!r})"
+
+
+class VectorStoreClient:
+    """HTTP client for VectorStoreServer (reference: vector_store.py:651)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int = 15,
+        additional_headers: dict | None = None,
+    ):
+        if url is None:
+            url = f"http://{host}:{port}"
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.headers = additional_headers or {}
+
+    def query(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        import requests
+
+        resp = requests.post(
+            f"{self.url}/v1/retrieve",
+            json={
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+            headers=self.headers,
+            timeout=self.timeout,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        import requests
+
+        resp = requests.post(
+            f"{self.url}/v1/statistics",
+            json={},
+            headers=self.headers,
+            timeout=self.timeout,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def get_input_files(
+        self,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list:
+        import requests
+
+        resp = requests.post(
+            f"{self.url}/v1/inputs",
+            json={
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+            headers=self.headers,
+            timeout=self.timeout,
+        )
+        resp.raise_for_status()
+        return resp.json()

@@ -1,13 +1,19 @@
 import os
 
-# Force CPU with a virtual 8-device mesh so multi-chip sharding paths are
+# Force a virtual 8-device CPU mesh so multi-chip sharding paths are
 # exercised without TPU hardware (the driver's dryrun does the same).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be set before the CPU backend initializes.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (single tunneled TPU chip);
+# unit tests must not depend on the tunnel — switch to host CPU.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

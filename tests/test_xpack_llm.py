@@ -1,0 +1,216 @@
+"""LLM xpack tests with fake embedders — no network
+(modeled on reference python/pathway/xpacks/llm/tests/test_vector_store.py)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_to_dicts
+from pathway_tpu.internals.json import Json
+
+
+@pw.udf
+def fake_embedder(text: str) -> np.ndarray:
+    """Deterministic 8-dim embedding: bag-of-chars buckets."""
+    v = np.zeros(8, dtype=np.float32)
+    for ch in str(text).lower():
+        v[ord(ch) % 8] += 1.0
+    n = np.linalg.norm(v)
+    return v / n if n > 0 else v
+
+
+def _docs_table():
+    return T(
+        """
+        data
+        aaaa aaaa
+        bbbb bbbb
+        cccc dddd
+        """
+    )
+
+
+def test_vector_store_retrieve():
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    server = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    queries = T(
+        """
+        query | k | metadata_filter | filepath_globpattern
+        aaaa  | 2 | None            | None
+        """
+    )
+    result = server.retrieve_query(queries)
+    _keys, cols = table_to_dicts(result)
+    docs = list(cols["result"].values())[0].value
+    assert len(docs) == 2
+    assert docs[0]["text"] == "aaaa aaaa"
+    assert docs[0]["dist"] <= docs[1]["dist"]
+
+
+def test_vector_store_statistics_and_inputs():
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    server = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    stats_q = T(
+        """
+        _dummy
+        1
+        """
+    ).select()
+    result = server.statistics_query(stats_q)
+    _keys, cols = table_to_dicts(result)
+    stats = list(cols["result"].values())[0].value
+    assert stats["file_count"] == 3
+
+    inputs_q = T(
+        """
+        metadata_filter | filepath_globpattern
+        None            | None
+        """
+    )
+    result2 = server.inputs_query(inputs_q)
+    _keys2, cols2 = table_to_dicts(result2)
+    assert isinstance(list(cols2["result"].values())[0].value, list)
+
+
+def test_vector_store_with_splitter():
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    docs = T(
+        """
+        data
+        one two three. four five six. seven eight nine.
+        """
+    )
+    server = VectorStoreServer(
+        docs,
+        embedder=fake_embedder,
+        splitter=TokenCountSplitter(min_tokens=2, max_tokens=3),
+    )
+    chunked = server._graph["chunked_docs"]
+    _keys, cols = table_to_dicts(chunked)
+    assert len(cols["text"]) == 3
+
+
+def test_document_store_with_bm25():
+    from pathway_tpu.stdlib.indexing import TantivyBM25Factory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    store = DocumentStore(
+        _docs_table(), retriever_factory=TantivyBM25Factory()
+    )
+    queries = T(
+        """
+        query | k | metadata_filter | filepath_globpattern
+        bbbb  | 1 | None            | None
+        """
+    )
+    result = store.retrieve_query(queries)
+    _keys, cols = table_to_dicts(result)
+    docs = list(cols["result"].values())[0].value
+    assert docs[0]["text"] == "bbbb bbbb"
+
+
+def test_rag_question_answerer():
+    from pathway_tpu.xpacks.llm.llms import EchoChat
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+    )
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    indexer = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    rag = BaseRAGQuestionAnswerer(
+        llm=EchoChat(prefix="ANSWER: "), indexer=indexer, search_topk=2
+    )
+    queries = T(
+        """
+        prompt | filters | model | return_context_docs
+        aaaa   | None    | None  | True
+        """
+    )
+    result = rag.answer_query(queries)
+    _keys, cols = table_to_dicts(result)
+    out = list(cols["result"].values())[0].value
+    assert out["response"].startswith("ANSWER: ")
+    assert "aaaa aaaa" in out["response"]
+    assert len(out["context_docs"]) == 2
+
+
+def test_adaptive_rag():
+    from pathway_tpu.xpacks.llm.llms import EchoChat
+    from pathway_tpu.xpacks.llm.question_answering import (
+        AdaptiveRAGQuestionAnswerer,
+    )
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    from pathway_tpu.xpacks.llm.llms import BaseChat
+
+    class ConstChat(BaseChat):
+        def _accept(self, messages, **kwargs) -> str:
+            return "42"
+
+    indexer = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    rag = AdaptiveRAGQuestionAnswerer(
+        llm=ConstChat(),
+        indexer=indexer,
+        n_starting_documents=1,
+        factor=2,
+        max_iterations=2,
+    )
+    queries = T(
+        """
+        prompt | filters | model | return_context_docs
+        aaaa   | None    | None  | False
+        """
+    )
+    result = rag.answer_query(queries)
+    _keys, cols = table_to_dicts(result)
+    out = list(cols["result"].values())[0].value
+    assert out["response"] is not None
+
+
+def test_rerank_topk_filter():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    t = T(
+        """
+        marker
+        x
+        """
+    ).select(
+        docs=pw.apply_with_type(
+            lambda _: ("d1", "d2", "d3"), tuple, pw.this.marker
+        ),
+        scores=pw.apply_with_type(
+            lambda _: (1.0, 3.0, 2.0), tuple, pw.this.marker
+        ),
+    )
+    res = t.select(best=rerank_topk_filter(t.docs, t.scores, 2))
+    _keys, cols = table_to_dicts(res)
+    docs, scores = list(cols["best"].values())[0]
+    assert docs == ("d2", "d3")
+
+
+def test_splitters():
+    from pathway_tpu.xpacks.llm.splitters import (
+        RecursiveSplitter,
+        TokenCountSplitter,
+    )
+
+    s = TokenCountSplitter(min_tokens=2, max_tokens=4)
+    chunks = s.split("a b c d e f g h")
+    assert all(len(c[0].split()) <= 4 for c in chunks)
+    r = RecursiveSplitter(chunk_size=3)
+    chunks2 = r.split("one two three\n\nfour five six seven")
+    assert len(chunks2) >= 2
+
+
+def test_hashing_tokenizer_deterministic():
+    from pathway_tpu.xpacks.llm._tokenizer import HashingTokenizer
+
+    tok = HashingTokenizer()
+    a1, m1 = tok.encode_batch(["hello world"], 64)
+    a2, m2 = tok.encode_batch(["hello world"], 64)
+    assert (a1 == a2).all()
