@@ -136,7 +136,7 @@ class JoinResult:
 
         def add_side(table, prefix):
             for n in table.column_names():
-                if n.startswith("_on"):
+                if n.startswith("_on") or n.startswith("_pw_"):
                     continue
                 exprs[n] = ColumnReference(joined, prefix + n)
 

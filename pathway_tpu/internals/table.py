@@ -701,6 +701,143 @@ class Table(Joinable):
         self._universe = universe
         return self
 
+    # --- temporal ops (stdlib.temporal, reference: Table methods added by
+    # python/pathway/stdlib/temporal/) --------------------------------------
+
+    def windowby(
+        self, time_expr, *, window, behavior=None, instance=None, shard=None
+    ):
+        from pathway_tpu.stdlib.temporal._window import windowby as _impl
+
+        return _impl(
+            self, time_expr, window=window, behavior=behavior,
+            instance=instance, shard=shard,
+        )
+
+    def interval_join(
+        self, other, self_time, other_time, interval, *on, behavior=None,
+        how=None,
+    ):
+        from pathway_tpu.internals.joins import JoinMode
+        from pathway_tpu.stdlib.temporal._interval_join import (
+            interval_join as _impl,
+        )
+
+        return _impl(
+            self, other, self_time, other_time, interval, *on,
+            behavior=behavior, how=how if how is not None else JoinMode.INNER,
+        )
+
+    def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal._interval_join import (
+            interval_join_inner as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_left(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal._interval_join import (
+            interval_join_left as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_right(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal._interval_join import (
+            interval_join_right as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_outer(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal._interval_join import (
+            interval_join_outer as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, interval, *on, **kw)
+
+    def window_join(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal._window_join import (
+            window_join as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_inner(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal._window_join import (
+            window_join_inner as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_left(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal._window_join import (
+            window_join_left as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_right(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal._window_join import (
+            window_join_right as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_outer(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal._window_join import (
+            window_join_outer as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, window, *on, **kw)
+
+    def asof_join(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_join import asof_join as _impl
+
+        return _impl(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_left(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_join import (
+            asof_join_left as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_right(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_join import (
+            asof_join_right as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_outer(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_join import (
+            asof_join_outer as _impl,
+        )
+
+        return _impl(self, other, self_time, other_time, *on, **kw)
+
+    def asof_now_join(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_now_join import (
+            asof_now_join as _impl,
+        )
+
+        return _impl(self, other, *on, **kw)
+
+    def asof_now_join_inner(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_now_join import (
+            asof_now_join_inner as _impl,
+        )
+
+        return _impl(self, other, *on, **kw)
+
+    def asof_now_join_left(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal._asof_now_join import (
+            asof_now_join_left as _impl,
+        )
+
+        return _impl(self, other, *on, **kw)
+
     # --- output helpers -------------------------------------------------------
 
     def _subscribe_node(self, on_batch, on_end=None) -> nodes.OutputNode:

@@ -31,6 +31,9 @@ __all__ = [
     "ExactlyOnceBehavior",
     "Direction",
     "utils",
+    "utc_now",
+    "inactivity_detection",
+    "TimestampSchema",
 ]
 
 _locations = {
@@ -67,6 +70,17 @@ _locations = {
 
 
 def __getattr__(name: str):
+    if name in ("utils", "time_utils"):
+        mod = importlib.import_module(f"pathway_tpu.stdlib.temporal.{name}")
+        globals()[name] = mod
+        return mod
+    if name in ("utc_now", "inactivity_detection", "TimestampSchema"):
+        mod = importlib.import_module(
+            "pathway_tpu.stdlib.temporal.time_utils"
+        )
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
     if name in _locations:
         mod = importlib.import_module(
             f"pathway_tpu.stdlib.temporal.{_locations[name]}"

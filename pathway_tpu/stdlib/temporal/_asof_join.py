@@ -1,0 +1,164 @@
+"""As-of joins (reference: python/pathway/stdlib/temporal/_asof_join.py —
+there built on sorted prev/next pointer groups; here a dedicated incremental
+AsofJoinNode that restates touched equality-groups per tick)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+from pathway_tpu.engine.temporal_nodes import AsofJoinNode
+from pathway_tpu.internals.expression import (
+    CoalesceExpression,
+    ColumnReference,
+)
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.table import desugar
+from pathway_tpu.internals.thisclass import (
+    left as left_ph,
+    right as right_ph,
+    this as this_ph,
+)
+from pathway_tpu.stdlib.temporal.temporal_behavior import (
+    Behavior,
+    apply_behavior_to_side,
+)
+
+
+class Direction(enum.Enum):
+    BACKWARD = "backward"
+    FORWARD = "forward"
+    NEAREST = "nearest"
+
+
+class AsofJoinResult(JoinResult):
+    """Lazy asof join result; select() like a regular join. `defaults` maps a
+    source column reference to the value used when the row has no match."""
+
+    def __init__(
+        self,
+        left,
+        right,
+        left_time,
+        right_time,
+        on,
+        mode: JoinMode,
+        defaults: dict[ColumnReference, Any],
+        direction: Direction,
+        behavior: Behavior | None = None,
+    ):
+        super().__init__(left, right, on, mode)
+        self._left_time = desugar(left_time, {left_ph: left, this_ph: left})
+        self._right_time = desugar(
+            right_time, {right_ph: right, this_ph: right}
+        )
+        self._defaults = {
+            (ref.table, ref.name): v for ref, v in (defaults or {}).items()
+        }
+        self._direction = direction
+        self._behavior = behavior
+
+    def _build(self):
+        lnames = [f"_on{i}" for i in range(len(self._left_on))]
+        left_cols = {n: self._left[n] for n in self._left.column_names()}
+        left_prep = self._left._build_rowwise(
+            {
+                **left_cols,
+                **dict(zip(lnames, self._left_on)),
+                "_pw_t": self._left_time,
+            }
+        )
+        right_cols = {n: self._right[n] for n in self._right.column_names()}
+        right_prep = self._right._build_rowwise(
+            {
+                **right_cols,
+                **dict(zip(lnames, self._right_on)),
+                "_pw_t": self._right_time,
+            }
+        )
+        left_prep = apply_behavior_to_side(left_prep, "_pw_t", self._behavior)
+        right_prep = apply_behavior_to_side(
+            right_prep, "_pw_t", self._behavior
+        )
+        node = AsofJoinNode(
+            left_prep._node,
+            right_prep._node,
+            lnames,
+            lnames,
+            "_pw_t",
+            "_pw_t",
+            self._direction.value,
+            self._mode.value,
+        )
+        return node, left_prep, right_prep
+
+    def _make_sub(self, joined):
+        base = super()._make_sub(joined)
+        defaults = self._defaults
+
+        def sub(ref: ColumnReference):
+            out = base(ref)
+            tbl = ref.table
+            if tbl is left_ph:
+                tbl = self._left
+            elif tbl is right_ph:
+                tbl = self._right
+            key = (tbl, ref.name)
+            if key in defaults and out is not None:
+                return CoalesceExpression(out, defaults[key])
+            return out
+
+        return sub
+
+
+def asof_join(
+    self,
+    other,
+    self_time,
+    other_time,
+    *on,
+    how: JoinMode = JoinMode.LEFT,
+    defaults: dict[ColumnReference, Any] | None = None,
+    direction: Direction = Direction.BACKWARD,
+    behavior: Behavior | None = None,
+) -> AsofJoinResult:
+    """For every row, find the single best matching row of the other side by
+    time (per `direction`), within groups given by `on` equalities."""
+    if how not in (JoinMode.LEFT, JoinMode.RIGHT, JoinMode.OUTER):
+        raise ValueError(
+            "asof_join supports only LEFT, RIGHT and OUTER modes"
+        )
+    return AsofJoinResult(
+        self, other, self_time, other_time, on, how, defaults or {},
+        direction, behavior,
+    )
+
+
+def asof_join_left(
+    self, other, self_time, other_time, *on,
+    defaults=None, direction=Direction.BACKWARD, behavior=None,
+):
+    return asof_join(
+        self, other, self_time, other_time, *on, how=JoinMode.LEFT,
+        defaults=defaults, direction=direction, behavior=behavior,
+    )
+
+
+def asof_join_right(
+    self, other, self_time, other_time, *on,
+    defaults=None, direction=Direction.BACKWARD, behavior=None,
+):
+    return asof_join(
+        self, other, self_time, other_time, *on, how=JoinMode.RIGHT,
+        defaults=defaults, direction=direction, behavior=behavior,
+    )
+
+
+def asof_join_outer(
+    self, other, self_time, other_time, *on,
+    defaults=None, direction=Direction.BACKWARD, behavior=None,
+):
+    return asof_join(
+        self, other, self_time, other_time, *on, how=JoinMode.OUTER,
+        defaults=defaults, direction=direction, behavior=behavior,
+    )

@@ -1,0 +1,48 @@
+"""asof_now joins: query-stream joins against the current state of the other
+side, never revised by later updates (reference:
+python/pathway/stdlib/temporal/_asof_now_join.py; the same as-of-now contract
+as the external index query path, src/engine/dataflow.rs:2694)."""
+
+from __future__ import annotations
+
+from pathway_tpu.engine.temporal_nodes import AsofNowJoinNode
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+
+class AsofNowJoinResult(JoinResult):
+    def _build(self):
+        lnames = [f"_on{i}" for i in range(len(self._left_on))]
+        left_cols = {n: self._left[n] for n in self._left.column_names()}
+        left_prep = self._left._build_rowwise(
+            {**left_cols, **dict(zip(lnames, self._left_on))}
+        )
+        right_cols = {n: self._right[n] for n in self._right.column_names()}
+        right_prep = self._right._build_rowwise(
+            {**right_cols, **dict(zip(lnames, self._right_on))}
+        )
+        node = AsofNowJoinNode(
+            left_prep._node,
+            right_prep._node,
+            lnames,
+            lnames,
+            self._mode.value,
+        )
+        return node, left_prep, right_prep
+
+
+def asof_now_join(
+    self, other, *on, how: JoinMode = JoinMode.INNER, id=None
+) -> AsofNowJoinResult:
+    """Join each (append-only) row of `self` against the state of `other` at
+    the moment the row arrives; results are not updated when `other` changes."""
+    if how not in (JoinMode.INNER, JoinMode.LEFT):
+        raise ValueError("asof_now_join supports only INNER and LEFT modes")
+    return AsofNowJoinResult(self, other, on, how, id)
+
+
+def asof_now_join_inner(self, other, *on, id=None):
+    return asof_now_join(self, other, *on, how=JoinMode.INNER, id=id)
+
+
+def asof_now_join_left(self, other, *on, id=None):
+    return asof_now_join(self, other, *on, how=JoinMode.LEFT, id=id)

@@ -1,0 +1,160 @@
+"""Interval joins (reference: python/pathway/stdlib/temporal/_interval_join.py
+— there desugared into bucketed equijoins over differential collections; here
+a dedicated incremental IntervalJoinNode on the microbatch engine)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.engine.temporal_nodes import IntervalJoinNode
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.table import desugar
+from pathway_tpu.internals.thisclass import (
+    left as left_ph,
+    right as right_ph,
+    this as this_ph,
+)
+from pathway_tpu.stdlib.temporal.temporal_behavior import (
+    Behavior,
+    apply_behavior_to_side,
+)
+
+
+@dataclass
+class Interval:
+    lower_bound: Any
+    upper_bound: Any
+
+
+def interval(lower_bound, upper_bound) -> Interval:
+    """The allowed difference `other_time - self_time` of matching rows."""
+    from pathway_tpu.stdlib.temporal.utils import _kind
+
+    kl, ku = _kind(lower_bound), _kind(upper_bound)
+    numeric = {"int", "float"}
+    if not (
+        (kl in numeric and ku in numeric)
+        or (kl == "duration" and ku == "duration")
+    ):
+        raise TypeError(
+            "interval bounds must both be numbers or both be durations, got "
+            f"{type(lower_bound).__name__} and {type(upper_bound).__name__}"
+        )
+    if lower_bound > upper_bound:
+        raise ValueError(
+            "interval lower_bound has to be less than or equal to upper_bound"
+        )
+    return Interval(lower_bound, upper_bound)
+
+
+class IntervalJoinResult(JoinResult):
+    """Lazy interval join; `.select(...)` with pw.left / pw.right / pw.this
+    materializes, like a regular join."""
+
+    def __init__(
+        self,
+        left,
+        right,
+        left_time,
+        right_time,
+        interval: Interval,
+        on,
+        mode: JoinMode,
+        behavior: Behavior | None = None,
+    ):
+        super().__init__(left, right, on, mode)
+        self._left_time = desugar(left_time, {left_ph: left, this_ph: left})
+        self._right_time = desugar(
+            right_time, {right_ph: right, this_ph: right}
+        )
+        self._interval = interval
+        self._behavior = behavior
+
+    def _build(self):
+        lnames = [f"_on{i}" for i in range(len(self._left_on))]
+        left_cols = {n: self._left[n] for n in self._left.column_names()}
+        left_prep = self._left._build_rowwise(
+            {
+                **left_cols,
+                **dict(zip(lnames, self._left_on)),
+                "_pw_t": self._left_time,
+            }
+        )
+        right_cols = {n: self._right[n] for n in self._right.column_names()}
+        right_prep = self._right._build_rowwise(
+            {
+                **right_cols,
+                **dict(zip(lnames, self._right_on)),
+                "_pw_t": self._right_time,
+            }
+        )
+        left_prep = apply_behavior_to_side(left_prep, "_pw_t", self._behavior)
+        right_prep = apply_behavior_to_side(
+            right_prep, "_pw_t", self._behavior
+        )
+        node = IntervalJoinNode(
+            left_prep._node,
+            right_prep._node,
+            lnames,
+            lnames,
+            "_pw_t",
+            "_pw_t",
+            self._interval.lower_bound,
+            self._interval.upper_bound,
+            self._mode.value,
+        )
+        return node, left_prep, right_prep
+
+
+def interval_join(
+    self,
+    other,
+    self_time,
+    other_time,
+    interval: Interval,
+    *on,
+    behavior: Behavior | None = None,
+    how: JoinMode = JoinMode.INNER,
+) -> IntervalJoinResult:
+    """Join rows whose time difference `other_time - self_time` lies within
+    `interval`, subject to equality conditions `on`."""
+    return IntervalJoinResult(
+        self, other, self_time, other_time, interval, on, how, behavior
+    )
+
+
+def interval_join_inner(
+    self, other, self_time, other_time, interval, *on, behavior=None
+):
+    return IntervalJoinResult(
+        self, other, self_time, other_time, interval, on, JoinMode.INNER,
+        behavior,
+    )
+
+
+def interval_join_left(
+    self, other, self_time, other_time, interval, *on, behavior=None
+):
+    return IntervalJoinResult(
+        self, other, self_time, other_time, interval, on, JoinMode.LEFT,
+        behavior,
+    )
+
+
+def interval_join_right(
+    self, other, self_time, other_time, interval, *on, behavior=None
+):
+    return IntervalJoinResult(
+        self, other, self_time, other_time, interval, on, JoinMode.RIGHT,
+        behavior,
+    )
+
+
+def interval_join_outer(
+    self, other, self_time, other_time, interval, *on, behavior=None
+):
+    return IntervalJoinResult(
+        self, other, self_time, other_time, interval, on, JoinMode.OUTER,
+        behavior,
+    )

@@ -1,0 +1,125 @@
+"""Wall-clock helper streams: utc_now + inactivity detection
+(reference: python/pathway/stdlib/temporal/time_utils.py)."""
+
+from __future__ import annotations
+
+import datetime
+import time
+from functools import cache
+
+from pathway_tpu import io
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.datetime_types import DateTimeUtc
+
+
+class TimestampSchema(schema_mod.Schema):
+    timestamp_utc: DateTimeUtc
+
+
+class TimestampSubject(io.python.ConnectorSubject):
+    def __init__(self, refresh_rate: datetime.timedelta) -> None:
+        super().__init__()
+        self._refresh_rate = refresh_rate
+
+    def run(self) -> None:
+        while not getattr(self, "_stop_requested", False):
+            now_utc = DateTimeUtc.from_datetime(
+                datetime.datetime.now(datetime.timezone.utc)
+            )
+            self.next(timestamp_utc=now_utc)
+            self.commit()
+            time.sleep(self._refresh_rate.total_seconds())
+
+
+@cache
+def utc_now(refresh_rate: datetime.timedelta = datetime.timedelta(seconds=60)):
+    """A live table with a single stream of current-UTC-timestamp rows,
+    refreshed every `refresh_rate`."""
+    return io.python.read(
+        TimestampSubject(refresh_rate=refresh_rate),
+        schema=TimestampSchema,
+    )
+
+
+def inactivity_detection(
+    event_time_column,
+    allowed_inactivity_period: datetime.timedelta,
+    refresh_rate: datetime.timedelta = datetime.timedelta(seconds=1),
+    instance=None,
+):
+    """Detect periods with no events: returns `(inactivities,
+    resumed_activities)`. A row lands in `inactivities` when no event arrived
+    for `allowed_inactivity_period` (per `instance` if given); a row lands in
+    `resumed_activities` at the first event after each inactivity period."""
+    import pathway_tpu as pw
+
+    events = event_time_column.table
+    now = utc_now(refresh_rate=refresh_rate)
+
+    has_instance = instance is not None
+    if has_instance:
+        last_event = events.groupby(instance).reduce(
+            instance=instance, latest=pw.reducers.max(event_time_column)
+        )
+    else:
+        last_event = events.reduce(
+            latest=pw.reducers.max(event_time_column)
+        )
+    latest_now = now.reduce(now=pw.reducers.max(now.timestamp_utc))
+
+    le = last_event.with_columns(_c=0)
+    ln = latest_now.with_columns(_c=0)
+    sel = {"latest": pw.left.latest, "now": pw.right.now}
+    if has_instance:
+        sel["instance"] = pw.left.instance
+    combined = le.join(ln, pw.left._c == pw.right._c).select(**sel)
+    inactive_sel = {"inactive_since": pw.this.latest}
+    if has_instance:
+        inactive_sel["instance"] = pw.this.instance
+    inactivities = (
+        combined.filter(
+            pw.apply_with_type(
+                lambda latest, now: (
+                    latest is not None
+                    and now is not None
+                    and (now - latest) > allowed_inactivity_period
+                ),
+                bool,
+                combined.latest,
+                combined.now,
+            )
+        )
+        .select(**inactive_sel)
+        .deduplicate(
+            value=pw.this.inactive_since,
+            instance=pw.this.instance if has_instance else None,
+        )
+    )
+
+    ev_sel = {"_pw_t": event_time_column}
+    if has_instance:
+        ev_sel["_pw_inst"] = instance
+    ev = events.select(**ev_sel)
+    join_on = (
+        (ev._pw_inst == inactivities.instance,) if has_instance else ()
+    )
+    res_sel = {"_pw_t": ev._pw_t, "_pw_since": inactivities.inactive_since}
+    if has_instance:
+        res_sel["instance"] = inactivities.instance
+    out_sel = {
+        "resumed_at": pw.this._pw_t,
+        "inactive_since": pw.this._pw_since,
+    }
+    if has_instance:
+        out_sel["instance"] = pw.this.instance
+    resumed = (
+        ev.asof_now_join(inactivities, *join_on)
+        .select(**res_sel)
+        .filter(pw.this._pw_t > pw.this._pw_since)
+        .deduplicate(
+            value=pw.this._pw_since,
+            instance=pw.this.instance if has_instance else None,
+        )
+        .select(**out_sel)
+    )
+    return inactivities, resumed

@@ -1,0 +1,76 @@
+"""Time-type utilities for temporal operators
+(reference: python/pathway/stdlib/temporal/utils.py)."""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Union
+
+from pathway_tpu.internals.datetime_types import (
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+)
+
+TimeEventType = Union[int, float, datetime.datetime]
+IntervalType = Union[int, float, datetime.timedelta]
+
+_TIME_KINDS = {
+    int: "int",
+    float: "float",
+}
+
+
+def _kind(value: Any) -> str:
+    if isinstance(value, bool):
+        return "other"
+    if isinstance(value, int):
+        return "int"
+    if isinstance(value, float):
+        return "float"
+    if isinstance(value, datetime.datetime):
+        return "utc" if value.tzinfo is not None else "naive"
+    if isinstance(value, datetime.timedelta):
+        return "duration"
+    return "other"
+
+
+def check_joint_types(parameters: dict[str, tuple[Any, str]]) -> None:
+    """Validate that time/interval values are of compatible kinds, e.g. a
+    datetime time column with timedelta bounds, or int with int."""
+    allowed = [
+        {"time": "int", "interval": "int"},
+        {"time": "float", "interval": "int"},
+        {"time": "float", "interval": "float"},
+        {"time": "int", "interval": "float"},
+        {"time": "naive", "interval": "duration"},
+        {"time": "utc", "interval": "duration"},
+    ]
+    kinds = {name: (_kind(v), role) for name, (v, role) in parameters.items()}
+    for combo in allowed:
+        if all(combo.get(role) == k for _n, (k, role) in kinds.items()):
+            return
+    raise TypeError(
+        "incompatible time/interval types in temporal operator: "
+        + ", ".join(f"{n}={k}" for n, (k, _r) in kinds.items())
+    )
+
+
+def zero_length_interval(time_value: Any):
+    """An additive zero matching the type of `time_value`."""
+    if isinstance(time_value, datetime.datetime):
+        return Duration()
+    if isinstance(time_value, float):
+        return 0.0
+    return 0
+
+
+__all__ = [
+    "TimeEventType",
+    "IntervalType",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "check_joint_types",
+    "zero_length_interval",
+]

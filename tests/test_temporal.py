@@ -1,0 +1,509 @@
+"""Temporal stdlib tests: windows, interval/window/asof/asof_now joins,
+behaviors — modeled on the reference test strategy (markdown fixtures +
+__time__/__diff__ simulated streams, reference
+python/pathway/tests/temporal/)."""
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.debug import T, assert_table_equality_wo_index
+
+
+def rows_of(table):
+    keys, cols = dbg.table_to_dicts(table)
+    return [{n: cols[n][k] for n in cols} for k in keys]
+
+
+def test_tumbling_window():
+    t = T(
+        """
+        instance | t
+        0        | 12
+        0        | 13
+        0        | 14
+        0        | 15
+        0        | 16
+        0        | 17
+        1        | 12
+        1        | 13
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.instance
+    ).reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_t=pw.reducers.max(pw.this.t),
+        count=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        _pw_instance | _pw_window_start | _pw_window_end | min_t | max_t | count
+        0            | 10               | 15             | 12    | 14    | 3
+        0            | 15               | 20             | 15    | 17    | 3
+        1            | 10               | 15             | 12    | 13    | 2
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_sliding_window():
+    t = T(
+        """
+        t
+        12
+        13
+        17
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=5, duration=10)
+    ).reduce(
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        count=pw.reducers.count(),
+    )
+    # t=12,13 in [5,15) and [10,20); t=17 in [10,20) and [15,25)
+    expected = T(
+        """
+        _pw_window_start | _pw_window_end | count
+        5                | 15             | 2
+        10               | 20             | 3
+        15               | 25             | 1
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_session_window_predicate():
+    t = T(
+        """
+        instance |  t |  v
+        0        |  1 |  10
+        0        |  2 |  1
+        0        |  4 |  3
+        0        |  8 |  2
+        0        |  9 |  4
+        0        |  10|  8
+        1        |  1 |  9
+        1        |  2 |  16
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 1),
+        instance=t.instance,
+    ).reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_v=pw.reducers.max(pw.this.v),
+        count=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        _pw_instance | _pw_window_start | _pw_window_end | min_t | max_v | count
+        0            | 1                | 2              | 1     | 10    | 2
+        0            | 4                | 4              | 4     | 3     | 1
+        0            | 8                | 10             | 8     | 8     | 3
+        1            | 1                | 2              | 1     | 16    | 2
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_session_window_max_gap_streaming_merge():
+    # two separate sessions merge into one when a bridging row arrives later
+    t = T(
+        """
+        t  | __time__
+        1  | 2
+        5  | 2
+        3  | 4
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=3)
+    ).reduce(
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        count=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        _pw_window_start | _pw_window_end | count
+        1                | 5              | 3
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_intervals_over():
+    t = T(
+        """
+        t |  v
+        1 |  10
+        2 |  1
+        4 |  3
+        8 |  2
+        9 |  4
+        10|  8
+        1 |  9
+        2 |  16
+        """
+    )
+    probes = T(
+        """
+        t
+        2
+        4
+        6
+        8
+        10
+        """
+    )
+    result = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.t, lower_bound=-2, upper_bound=1
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        v=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    rows = sorted(
+        (r["_pw_window_location"], tuple(r["v"]) if r["v"] else None)
+        for r in rows_of(result)
+    )
+    assert rows == [
+        (2, (1, 9, 10, 16)),
+        (4, (1, 3, 16)),
+        (6, (3,)),
+        (8, (2, 4)),
+        (10, (2, 4, 8)),
+    ]
+
+
+def test_intervals_over_outer_empty_window():
+    t = T(
+        """
+        t | v
+        1 | 5
+        """
+    )
+    probes = T(
+        """
+        p
+        1
+        9
+        """
+    )
+    result = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.p, lower_bound=-1, upper_bound=1, is_outer=True
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    rows = sorted(
+        (r["_pw_window_location"], r["s"]) for r in rows_of(result)
+    )
+    assert rows == [(1, 5), (9, None)]
+
+
+def test_interval_join_inner():
+    t1 = T(
+        """
+        t | a
+        3 | 1
+        7 | 2
+        13| 3
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | 10
+        5 | 20
+        6 | 30
+        10| 40
+        """
+    )
+    res = t1.interval_join(
+        t2, t1.t, t2.t, pw.temporal.interval(-2, 1)
+    ).select(a=t1.a, b=t2.b, lt=t1.t, rt=t2.t)
+    expected = T(
+        """
+        a | b  | lt | rt
+        1 | 10 | 3  | 2
+        2 | 20 | 7  | 5
+        2 | 30 | 7  | 6
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_outer_with_on():
+    t1 = T(
+        """
+        t | k | a
+        1 | x | 1
+        9 | x | 2
+        1 | y | 3
+        """
+    )
+    t2 = T(
+        """
+        t | k | b
+        2 | x | 10
+        2 | z | 30
+        """
+    )
+    res = t1.interval_join_outer(
+        t2, t1.t, t2.t, pw.temporal.interval(-1, 1), t1.k == t2.k
+    ).select(a=t1.a, b=t2.b)
+    expected = T(
+        """
+        a    | b
+        1    | 10
+        2    | None
+        3    | None
+        None | 30
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_window_join_tumbling():
+    t1 = T(
+        """
+        t | a
+        1 | 1
+        2 | 2
+        6 | 3
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | 10
+        7 | 20
+        11| 30
+        """
+    )
+    res = t1.window_join(
+        t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        2 | 10
+        3 | 20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_window_join_session():
+    t1 = T(
+        """
+        t | a
+        1 | 1
+        5 | 2
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | 10
+        20| 20
+        """
+    )
+    res = t1.window_join(
+        t2, t1.t, t2.t, pw.temporal.session(max_gap=3)
+    ).select(a=pw.left.a, b=pw.right.b)
+    # merged times 1,2,5 form one session (gaps 1,3<? 3<3 false) ->
+    # sessions over union: {1,2} (gap 1), {5}, {20}; pairs in shared window:
+    # (a=1,b=10)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_backward():
+    trades = T(
+        """
+        t  | sym | price
+        3  | A   | 100
+        7  | A   | 101
+        5  | B   | 50
+        """
+    )
+    quotes = T(
+        """
+        t  | sym | bid
+        1  | A   | 99
+        6  | A   | 100
+        9  | B   | 49
+        """
+    )
+    res = trades.asof_join(
+        quotes, trades.t, quotes.t, trades.sym == quotes.sym
+    ).select(sym=trades.sym, price=trades.price, bid=quotes.bid)
+    expected = T(
+        """
+        sym | price | bid
+        A   | 100   | 99
+        A   | 101   | 100
+        B   | 50    | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_defaults_and_direction():
+    t1 = T(
+        """
+        t | a
+        5 | 1
+        """
+    )
+    t2 = T(
+        """
+        t | val
+        7 | 42
+        """
+    )
+    res = t1.asof_join(
+        t2,
+        t1.t,
+        t2.t,
+        defaults={t2.val: -1},
+    ).select(a=t1.a, val=t2.val)
+    expected = T(
+        """
+        a | val
+        1 | -1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+    res_fwd = t1.asof_join(
+        t2, t1.t, t2.t, direction=pw.temporal.Direction.FORWARD
+    ).select(a=t1.a, val=t2.val)
+    expected_fwd = T(
+        """
+        a | val
+        1 | 42
+        """
+    )
+    assert_table_equality_wo_index(res_fwd, expected_fwd)
+
+
+def test_asof_now_join_no_revision():
+    # queries at time 2 see only right rows present at time <= 2;
+    # later right updates must NOT revise earlier results
+    queries = T(
+        """
+        q | __time__
+        1 | 2
+        2 | 6
+        """
+    )
+    state = T(
+        """
+        v | __time__
+        10| 2
+        20| 4
+        """
+    )
+    res = queries.asof_now_join(state).select(q=queries.q, v=state.v)
+    rows = sorted((r["q"], r["v"]) for r in rows_of(res))
+    # q=1 joined with v=10 only (as of t=2); q=2 with both 10 and 20
+    assert rows == [(1, 10), (2, 10), (2, 20)]
+
+
+def test_windowby_exactly_once_behavior():
+    t = T(
+        """
+        t | __time__
+        1 | 2
+        2 | 2
+        11| 4
+        3 | 6
+        21| 8
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        pw.this._pw_window_start,
+        count=pw.reducers.count(),
+    )
+    # window [0,10) closes when t=11 arrives; the late row t=3 (arriving
+    # at logical time 6) is dropped; window [10,20) closes at t=21
+    rows = sorted(
+        (r["_pw_window_start"], r["count"]) for r in rows_of(result)
+    )
+    # window [20,30) flushes at end-of-stream (time -> +inf), like the
+    # reference's batch-mode close
+    assert rows == [(0, 2), (10, 1), (20, 1)]
+
+
+def test_windowby_common_behavior_cutoff_drops_late():
+    t = T(
+        """
+        t  | __time__
+        1  | 2
+        12 | 4
+        2  | 6
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=0),
+    ).reduce(
+        pw.this._pw_window_start,
+        count=pw.reducers.count(),
+    )
+    rows = sorted(
+        (r["_pw_window_start"], r["count"]) for r in rows_of(result)
+    )
+    # the late row t=2 arrives after max_t=12 passed window [0,10) end
+    assert rows == [(0, 1), (10, 1)]
+
+
+def test_interval_join_streaming_retraction():
+    t1 = T(
+        """
+          | t | a | __time__ | __diff__
+        1 | 3 | 1 | 2        | 1
+        1 | 3 | 1 | 6        | -1
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        3 | 7
+        """
+    )
+    res = t1.interval_join(t2, t1.t, t2.t, pw.temporal.interval(0, 0)).select(
+        a=t1.a, b=t2.b
+    )
+    assert rows_of(res) == []
