@@ -266,7 +266,11 @@ def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
         cap = _Capture(tbl)
         captures.append(cap)
         outputs.append(OutputNode(tbl._node, cap.on_batch))
-    Runtime(outputs).run()
+    rt = Runtime(outputs)
+    from pathway_tpu.internals import parse_graph
+
+    parse_graph.G.last_runtime = rt
+    rt.run()
     return captures
 
 

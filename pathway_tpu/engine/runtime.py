@@ -53,6 +53,14 @@ class InputSession:
         self._last_upserted: dict[int, tuple] = {}
         self.finished = False
         self._wake: Callable[[], None] | None = None
+        # offset marker protocol: a source may enqueue its offset snapshot
+        # atomically WITH the rows it covers (insert_batch); drain() then
+        # surfaces the marker only once those rows have left the session, so
+        # persisted offsets can never run ahead of the logged input
+        # (reference: offsets recorded under the same frontier as the input
+        # snapshot, src/persistence/state.rs + src/connectors/offset.rs)
+        self._pending_offsets: Any = None
+        self.last_offsets: Any = None
 
     def insert(self, key: int, values: tuple) -> None:
         with self._lock:
@@ -68,6 +76,17 @@ class InputSession:
         """None value = delete (reference: UpsertSession)."""
         with self._lock:
             self._upserts[key] = values
+        self._notify()
+
+    def insert_batch(
+        self, rows: Iterable[tuple[int, int, tuple]], offsets: Any = None
+    ) -> None:
+        """Atomically enqueue a group of rows plus the offset snapshot that
+        covers them — one drain observes both or neither."""
+        with self._lock:
+            self._rows.extend(rows)
+            if offsets is not None:
+                self._pending_offsets = offsets
         self._notify()
 
     def close(self) -> None:
@@ -89,6 +108,9 @@ class InputSession:
             self._rows = []
             upserts = self._upserts
             self._upserts = {}
+            if self._pending_offsets is not None:
+                self.last_offsets = self._pending_offsets
+                self._pending_offsets = None
         for k, vals in upserts.items():
             old = self._last_upserted.get(k)
             if old is not None:
@@ -127,6 +149,32 @@ class StreamingSource:
         pass
 
 
+class RuntimeStats:
+    """Prober-style counters (reference: ProberStats src/engine/graph.rs:533,
+    connector monitors src/connectors/monitoring.rs) — fed to the
+    Prometheus endpoint and the TUI monitor."""
+
+    def __init__(self):
+        self.ticks = 0
+        self.current_time = 0
+        self.rows_in: dict[int, int] = {}  # input node id -> rows ingested
+        self.rows_out: dict[int, int] = {}  # output node id -> rows emitted
+        self.node_rows: dict[int, int] = {}  # node id -> rows produced
+        self.node_ns: dict[int, int] = {}  # node id -> cumulative process ns
+        self.last_tick_ns = 0
+        self.started_at = _time.time()
+
+    def snapshot(self) -> dict:
+        return {
+            "ticks": self.ticks,
+            "current_time": self.current_time,
+            "rows_in_total": sum(self.rows_in.values()),
+            "rows_out_total": sum(self.rows_out.values()),
+            "last_tick_ns": self.last_tick_ns,
+            "uptime_s": _time.time() - self.started_at,
+        }
+
+
 class Runtime:
     def __init__(
         self,
@@ -145,6 +193,9 @@ class Runtime:
         self._stop = threading.Event()
         self.current_time = 0
         self._tick_count = 0
+        self.stats = RuntimeStats()
+        has_consumer = {inp.id for node in self.order for inp in node.inputs}
+        self._sinks = [n for n in self.order if n.id not in has_consumer]
 
     # --- core tick ------------------------------------------------------------
 
@@ -154,19 +205,38 @@ class Runtime:
         self.current_time = t
         produced: dict[int, list[DiffBatch]] = {}
         final = t >= END_OF_TIME
+        stats = self.stats
+        tick_start = _time.perf_counter_ns()
         for node in self.order:
             ex = self.execs[node.id]
             if isinstance(ex, InputExec) and injected and node.id in injected:
                 for b in injected[node.id]:
                     ex.inject(b)
             inputs = [produced.get(inp.id, []) for inp in node.inputs]
-            try:
-                out = ex.process(t, inputs)
-            except Exception:
-                raise
+            t0 = _time.perf_counter_ns()
+            out = ex.process(t, inputs)
             if final:
                 out = list(out) + list(ex.on_end())
             produced[node.id] = out
+            nrows = sum(len(b) for b in out)
+            if nrows:
+                stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
+            stats.node_ns[node.id] = (
+                stats.node_ns.get(node.id, 0) + _time.perf_counter_ns() - t0
+            )
+            if isinstance(ex, InputExec) and nrows:
+                stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
+        for node in self._sinks:
+            consumed = sum(
+                len(b) for inp in node.inputs for b in produced.get(inp.id, [])
+            )
+            if consumed:
+                stats.rows_out[node.id] = (
+                    stats.rows_out.get(node.id, 0) + consumed
+                )
+        stats.ticks += 1
+        stats.current_time = t if not final else stats.current_time
+        stats.last_tick_ns = _time.perf_counter_ns() - tick_start
         self._tick_count += 1
         if self.on_tick is not None:
             self.on_tick(t)

@@ -1,0 +1,80 @@
+"""Prometheus/OpenMetrics monitoring endpoint.
+
+TPU-native equivalent of the reference's per-process metrics server
+(reference: src/engine/http_server.rs:21-90 — OpenMetrics endpoint at port
+20000 + process_id with input/output latency gauges). Serves the Runtime's
+prober counters (RuntimeStats) in Prometheus text exposition format at
+`/metrics` (and `/status` as JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+BASE_PORT = 20000
+
+
+def _render_metrics(runtime) -> str:
+    s = runtime.stats
+    lines = [
+        "# TYPE pathway_ticks_total counter",
+        f"pathway_ticks_total {s.ticks}",
+        "# TYPE pathway_logical_time gauge",
+        f"pathway_logical_time {s.current_time}",
+        "# TYPE pathway_last_tick_seconds gauge",
+        f"pathway_last_tick_seconds {s.last_tick_ns / 1e9}",
+        "# TYPE pathway_input_rows_total counter",
+        "# TYPE pathway_output_rows_total counter",
+        "# TYPE pathway_operator_rows_total counter",
+        "# TYPE pathway_operator_seconds_total counter",
+    ]
+    names = {n.id: f"{n.name}_{n.id}" for n in runtime.order}
+    for nid, v in sorted(s.rows_in.items()):
+        lines.append(f'pathway_input_rows_total{{node="{names.get(nid, nid)}"}} {v}')
+    for nid, v in sorted(s.rows_out.items()):
+        lines.append(f'pathway_output_rows_total{{node="{names.get(nid, nid)}"}} {v}')
+    for nid, v in sorted(s.node_rows.items()):
+        lines.append(
+            f'pathway_operator_rows_total{{node="{names.get(nid, nid)}"}} {v}'
+        )
+    for nid, v in sorted(s.node_ns.items()):
+        lines.append(
+            f'pathway_operator_seconds_total{{node="{names.get(nid, nid)}"}} {v / 1e9}'
+        )
+    return "\n".join(lines) + "\n"
+
+
+def start_http_server(runtime, port: int | None = None) -> ThreadingHTTPServer:
+    """Start the metrics endpoint in a daemon thread; returns the server."""
+    if port is None:
+        process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
+        port = BASE_PORT + process_id
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") in ("", "/metrics"):
+                body = _render_metrics(runtime).encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path.rstrip("/") == "/status":
+                body = json.dumps(runtime.stats.snapshot()).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

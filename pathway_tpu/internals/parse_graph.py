@@ -16,6 +16,7 @@ class ParseGraph:
         self.streaming_sources: list[Any] = []
         self.post_run_hooks: list[Callable[[], None]] = []
         self.runtime: Any = None  # set while a run is active
+        self.last_runtime: Any = None  # kept after the run for stats probing
 
     def add_output(self, node: Node) -> None:
         self.outputs.append(node)
@@ -25,6 +26,7 @@ class ParseGraph:
         self.streaming_sources.clear()
         self.post_run_hooks.clear()
         self.runtime = None
+        self.last_runtime = None
 
 
 G = ParseGraph()

@@ -37,6 +37,20 @@ def run(
         return
     runtime = Runtime(G.outputs, autocommit_ms=autocommit_duration_ms)
     G.runtime = runtime
+    G.last_runtime = runtime
+    if persistence_config is None:
+        # record/replay debugging via env (reference: PATHWAY_REPLAY_STORAGE,
+        # internals/config.py:64-97 + `pathway spawn --record`)
+        from pathway_tpu.internals.config import get_pathway_config
+
+        pw_cfg = get_pathway_config()
+        if pw_cfg.replay_storage:
+            from pathway_tpu import persistence as _p
+
+            persistence_config = _p.Config(
+                backend=_p.Backend.filesystem(pw_cfg.replay_storage),
+                snapshot_access=pw_cfg.snapshot_access or "record",
+            )
     if persistence_config is not None:
         from pathway_tpu.persistence._runtime_glue import attach_persistence
 
@@ -51,9 +65,26 @@ def run(
             start_http_server(runtime)
         except Exception:
             pass
+    monitor = None
+    import sys as _sys
+
+    want_tui = monitoring_level in (MonitoringLevel.ALL, MonitoringLevel.IN_OUT) or (
+        monitoring_level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL)
+        and _sys.stdout.isatty()
+    )
+    if want_tui:
+        try:
+            from pathway_tpu.internals.monitoring import StatsMonitor
+
+            monitor = StatsMonitor(runtime)
+            monitor.start()
+        except Exception:
+            monitor = None
     try:
         runtime.run()
     finally:
+        if monitor is not None:
+            monitor.stop()
         G.runtime = None
         for hook in G.post_run_hooks:
             try:

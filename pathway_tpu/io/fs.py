@@ -178,6 +178,17 @@ class _FsStreamingSource(StreamingSource):
         self._stop = threading.Event()
         self._seen: dict[str, tuple[float, int]] = {}  # path -> (mtime, size)
         self._emitted: dict[str, list] = {}  # path -> [(key, vals)]
+        self.persistent_id: str | None = None
+
+    # --- persistence hooks (reference: Reader::seek + OffsetValue,
+    # src/connectors/data_storage.rs:402, src/connectors/offset.rs) -----------
+
+    def offset_state(self) -> dict:
+        return {"seen": dict(self._seen), "emitted": dict(self._emitted)}
+
+    def seek(self, state: dict) -> None:
+        self._seen = dict(state.get("seen", {}))
+        self._emitted = dict(state.get("emitted", {}))
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -197,10 +208,14 @@ class _FsStreamingSource(StreamingSource):
             sig = (st.st_mtime, st.st_size)
             if self._seen.get(fpath) == sig:
                 continue
-            self._seen[fpath] = sig
-            # retract previous version of this file
-            for key, vals in self._emitted.get(fpath, []):
-                self.session.remove(key, vals)
+            # build the whole file's diff (retraction of the previous
+            # version + new rows), then enqueue it atomically together with
+            # the offset snapshot that covers it — a persistence commit can
+            # then never record this file as seen without its rows being in
+            # the drained (and thus logged) stream
+            rows: list[tuple[int, int, tuple]] = [
+                (key, -1, vals) for key, vals in self._emitted.get(fpath, [])
+            ]
             emitted = []
             try:
                 for pk, vals in _parse_file(
@@ -217,11 +232,13 @@ class _FsStreamingSource(StreamingSource):
                         )
                     else:
                         key = int(ref_scalar(*pk))
-                    self.session.insert(key, vals)
+                    rows.append((key, 1, vals))
                     emitted.append((key, vals))
             except OSError:
                 continue
+            self._seen[fpath] = sig
             self._emitted[fpath] = emitted
+            self.session.insert_batch(rows, self.offset_state())
 
     def _loop(self):
         while not self._stop.is_set():
@@ -240,6 +257,7 @@ def read(
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     if format in ("plaintext", "plaintext_by_file"):
@@ -264,6 +282,7 @@ def read(
         source = _FsStreamingSource(
             path, format, schema_, column_names, csv_settings, pk_cols
         )
+    source.persistent_id = persistent_id or name
     node = InputNode(source, column_names)
     return Table._from_node(node, dtypes, Universe())
 

@@ -2,7 +2,7 @@
 (reference: python/pathway/persistence/__init__.py + src/persistence/).
 
 The engine glue (input event logs + state snapshots + resume) lives in
-pathway_tpu/persistence/engine_glue.py."""
+pathway_tpu/persistence/_runtime_glue.py; blob stores in backends.py."""
 
 from __future__ import annotations
 
@@ -27,8 +27,12 @@ class Backend:
         return S3Backend(root_path, account)
 
     @classmethod
-    def mock(cls, events: Any = None) -> "MockBackend":
-        return MockBackend()
+    def mock(cls, events: Any = None, name: str = "default") -> "MockBackend":
+        return MockBackend(name=name)
+
+    @classmethod
+    def memory(cls, name: str = "default") -> "MockBackend":
+        return MockBackend(name=name)
 
 
 @dataclass
@@ -48,6 +52,26 @@ class S3Backend(Backend):
 class MockBackend(Backend):
     kind: str = "mock"
     store: dict = field(default_factory=dict)
+    name: str = "default"
+
+
+class PersistenceMode:
+    """(reference: src/connectors/mod.rs:108 PersistenceMode)"""
+
+    BATCH = "batch"
+    SPEEDRUN = "speedrun"
+    PERSISTING = "persisting"
+    OPERATOR_PERSISTING = "operator_persisting"
+
+
+class SnapshotAccess:
+    """(reference: src/connectors/mod.rs:154 SnapshotAccess) — `record`
+    writes the input log without replaying (record/replay debugging),
+    `replay` reads it without recording, `full` does both."""
+
+    RECORD = "record"
+    REPLAY = "replay"
+    FULL = "full"
 
 
 @dataclass

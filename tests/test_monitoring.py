@@ -1,0 +1,68 @@
+"""Monitoring endpoint + runtime stats (reference: src/engine/http_server.rs
+OpenMetrics endpoint; ProberStats src/engine/graph.rs:533)."""
+
+import json
+import socket
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_to_pandas
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_runtime_stats_counters():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    res = t.groupby().reduce(total=pw.reducers.sum(t.v))
+    table_to_pandas(res)
+    rt = pw.internals.parse_graph.G.last_runtime
+    assert rt is not None
+    s = rt.stats
+    assert s.ticks >= 1
+    assert sum(s.rows_in.values()) >= 3
+    snap = s.snapshot()
+    assert snap["rows_in_total"] >= 3
+    assert snap["ticks"] >= 1
+
+
+def test_metrics_http_endpoint():
+    from pathway_tpu.engine.nodes import InputNode
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+    from pathway_tpu.internals.monitoring_server import start_http_server
+
+    class _Empty(StaticSource):
+        def events(self):
+            return iter(())
+
+    node = InputNode(_Empty(["a"]), ["a"])
+    rt = Runtime([node])
+    rt.run_static()
+    port = _free_port()
+    server = start_http_server(rt, port=port)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "pathway_ticks_total" in body
+        assert "pathway_logical_time" in body
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=5
+        ) as resp:
+            status = json.loads(resp.read().decode())
+        assert status["ticks"] >= 1
+    finally:
+        server.shutdown()

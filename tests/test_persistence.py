@@ -1,0 +1,166 @@
+"""Persistence: input-log record, replay, offset seek, kill/restart.
+
+Modeled on the reference's recovery tests
+(reference: integration_tests/wordcount/test_recovery.py — run a streaming
+wordcount, kill mid-stream, restart from the persisted snapshot, assert the
+final counts are exact) and the persistence unit tests
+(tests/integration/test_seek.rs: write -> restart -> rewind cycles).
+"""
+
+import json
+import threading
+import time
+
+import pathway_tpu as pw
+
+
+def _write_words(path, words):
+    with open(path, "w") as f:
+        for w in words:
+            f.write(json.dumps({"word": w}) + "\n")
+
+
+class WordSchema(pw.Schema):
+    word: str
+
+
+def _build_wordcount(input_dir, out_path, mode="streaming"):
+    words = pw.io.fs.read(
+        str(input_dir), format="json", schema=WordSchema, mode=mode
+    )
+    counts = words.groupby(words.word).reduce(
+        words.word, count=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(counts, str(out_path))
+
+
+def _final_counts(out_path):
+    """Consolidate the output diff stream into final state."""
+    state: dict[str, int] = {}
+    with open(out_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            obj = json.loads(line)
+            if obj["diff"] > 0:
+                state[obj["word"]] = obj["count"]
+            else:
+                if state.get(obj["word"]) == obj["count"]:
+                    del state[obj["word"]]
+    return state
+
+
+def _run_until(predicate, timeout=15.0):
+    """pw.run in a thread; stop once predicate() holds (or timeout)."""
+    t = threading.Thread(
+        target=lambda: pw.run(
+            persistence_config=_run_until.cfg, autocommit_duration_ms=20
+        ),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + timeout
+    ok = False
+    while time.time() < deadline:
+        if predicate():
+            ok = True
+            break
+        time.sleep(0.05)
+    rt = pw.internals.parse_graph.G.runtime
+    if rt is not None:
+        rt.stop()
+    t.join(timeout=10)
+    return ok
+
+
+def test_streaming_kill_restart_wordcount(tmp_path):
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    pdir = tmp_path / "pstorage"
+    out_a = tmp_path / "out_a.jsonl"
+    out_b = tmp_path / "out_b.jsonl"
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir))
+    )
+
+    _write_words(input_dir / "f1.jsonl", ["a", "b", "a", "c", "a"])
+
+    # --- round A: ingest f1, then "crash" (stop mid-stream) -------------------
+    _build_wordcount(input_dir, out_a)
+    _run_until.cfg = cfg
+
+    def _a_done():
+        try:
+            return _final_counts(out_a).get("a") == 3
+        except OSError:
+            return False
+
+    assert _run_until(_a_done)
+    assert _final_counts(out_a) == {"a": 3, "b": 1, "c": 1}
+
+    # --- round B: restart from snapshot, add f2 -------------------------------
+    pw.internals.parse_graph.G.clear()
+    _write_words(input_dir / "f2.jsonl", ["b", "d"])
+    _build_wordcount(input_dir, out_b)
+
+    def _b_done():
+        try:
+            got = _final_counts(out_b)
+        except OSError:
+            return False
+        return got.get("b") == 2 and got.get("d") == 1
+
+    assert _run_until(_b_done)
+    # exact counts: f1 rows came from the replay log (not re-read), f2 rows
+    # from the live scan — each ingested exactly once
+    assert _final_counts(out_b) == {"a": 3, "b": 2, "c": 1, "d": 1}
+
+
+def test_static_finished_source_not_rerun(tmp_path):
+    """A finished static source is not re-ingested on restart; the replay
+    log alone reproduces the output (reference: finished sources skipped
+    after recovery, src/connectors/mod.rs rewind path)."""
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    _write_words(input_dir / "f1.jsonl", ["x", "y", "x"])
+    pdir = tmp_path / "pstorage"
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir))
+    )
+
+    out_a = tmp_path / "out_a.jsonl"
+    _build_wordcount(input_dir, out_a, mode="static")
+    pw.run(persistence_config=cfg)
+    assert _final_counts(out_a) == {"x": 2, "y": 1}
+
+    pw.internals.parse_graph.G.clear()
+    out_b = tmp_path / "out_b.jsonl"
+    _build_wordcount(input_dir, out_b, mode="static")
+    pw.run(persistence_config=cfg)
+    assert _final_counts(out_b) == {"x": 2, "y": 1}
+
+
+def test_memory_backend_roundtrip(tmp_path):
+    """MemoryStore registry survives engine 'restarts' in-process."""
+    from pathway_tpu.persistence.backends import MemoryStore
+
+    a = MemoryStore("t1")
+    a.put("inputs/x/chunk-00000000.pkl", b"abc")
+    a.put("metadata.json", b"{}")
+    b = MemoryStore("t1")
+    assert b.get("inputs/x/chunk-00000000.pkl") == b"abc"
+    assert b.list_keys("inputs/") == ["inputs/x/chunk-00000000.pkl"]
+    b.remove("metadata.json")
+    assert MemoryStore("t1").get("metadata.json") is None
+
+
+def test_filesystem_store_atomic(tmp_path):
+    from pathway_tpu.persistence.backends import FilesystemStore
+
+    s = FilesystemStore(str(tmp_path / "blobs"))
+    s.put("a/b/c.bin", b"\x00\x01")
+    assert s.get("a/b/c.bin") == b"\x00\x01"
+    assert s.list_keys() == ["a/b/c.bin"]
+    assert s.list_keys("a/") == ["a/b/c.bin"]
+    s.remove("a/b/c.bin")
+    assert s.get("a/b/c.bin") is None
