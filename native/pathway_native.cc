@@ -1,0 +1,429 @@
+// pathway_tpu native runtime kernels (CPython extension, no pybind11).
+//
+// TPU-native counterpart of the reference engine's native hot paths
+// (reference: src/engine/value.rs Key::for_values — xxh3-128 row keys;
+// external/differential-dataflow consolidation). The XLA/Pallas path covers
+// device compute; this module covers the host-side per-row work the Python
+// interpreter is too slow for:
+//   * hash_value / hash_columns — stable 64-bit row keys via keyed blake2b
+//     over a canonical value serialization (byte-identical to the pure-Python
+//     fallback in pathway_tpu/internals/api.py, so persisted logs written by
+//     either path resume under the other)
+//   * consolidate — sum diff weights per (key, value-hash) preserving
+//     first-seen order (the differential `consolidate` analog)
+//
+// Build: native/Makefile or `python native/setup.py build_ext --inplace`.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// blake2b (RFC 7693), keyed, 8-byte digest — matches
+// hashlib.blake2b(data, digest_size=8, key=SALT).
+
+static const uint64_t BLAKE2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t BLAKE2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+struct Blake2bState {
+  uint64_t h[8];
+  uint64_t t[2];
+  uint8_t buf[128];
+  size_t buflen;
+};
+
+static void blake2b_compress(Blake2bState* S, const uint8_t* block,
+                             bool last) {
+  uint64_t m[16];
+  uint64_t v[16];
+  std::memcpy(m, block, 128);
+  for (int i = 0; i < 8; i++) v[i] = S->h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = BLAKE2B_IV[i];
+  v[12] ^= S->t[0];
+  v[13] ^= S->t[1];
+  if (last) v[14] = ~v[14];
+#define G(r, i, a, b, c, d)                        \
+  do {                                             \
+    a = a + b + m[BLAKE2B_SIGMA[r][2 * i]];        \
+    d = rotr64(d ^ a, 32);                         \
+    c = c + d;                                     \
+    b = rotr64(b ^ c, 24);                         \
+    a = a + b + m[BLAKE2B_SIGMA[r][2 * i + 1]];    \
+    d = rotr64(d ^ a, 16);                         \
+    c = c + d;                                     \
+    b = rotr64(b ^ c, 63);                         \
+  } while (0)
+  for (int r = 0; r < 12; r++) {
+    G(r, 0, v[0], v[4], v[8], v[12]);
+    G(r, 1, v[1], v[5], v[9], v[13]);
+    G(r, 2, v[2], v[6], v[10], v[14]);
+    G(r, 3, v[3], v[7], v[11], v[15]);
+    G(r, 4, v[0], v[5], v[10], v[15]);
+    G(r, 5, v[1], v[6], v[11], v[12]);
+    G(r, 6, v[2], v[7], v[8], v[13]);
+    G(r, 7, v[3], v[4], v[9], v[14]);
+  }
+#undef G
+  for (int i = 0; i < 8; i++) S->h[i] ^= v[i] ^ v[i + 8];
+}
+
+// 64-bit digest of `data` keyed with `key` (kk<=64 bytes).
+static uint64_t blake2b64_keyed(const uint8_t* key, size_t kk,
+                                const uint8_t* data, size_t len) {
+  Blake2bState S;
+  const uint64_t nn = 8;  // digest bytes
+  for (int i = 0; i < 8; i++) S.h[i] = BLAKE2B_IV[i];
+  S.h[0] ^= 0x01010000ULL ^ ((uint64_t)kk << 8) ^ nn;
+  S.t[0] = 0;
+  S.t[1] = 0;
+  S.buflen = 0;
+  uint8_t keyblock[128];
+  if (kk > 0) {
+    std::memset(keyblock, 0, 128);
+    std::memcpy(keyblock, key, kk);
+    if (len > 0) {
+      S.t[0] += 128;
+      blake2b_compress(&S, keyblock, false);
+    } else {
+      S.t[0] += 128;
+      blake2b_compress(&S, keyblock, true);
+      uint64_t out;
+      std::memcpy(&out, &S.h[0], 8);
+      return out;
+    }
+  }
+  // full blocks except the last
+  while (len > 128) {
+    S.t[0] += 128;
+    if (S.t[0] < 128) S.t[1]++;
+    blake2b_compress(&S, data, false);
+    data += 128;
+    len -= 128;
+  }
+  uint8_t lastblock[128];
+  std::memset(lastblock, 0, 128);
+  std::memcpy(lastblock, data, len);
+  S.t[0] += len;
+  if (S.t[0] < len) S.t[1]++;
+  blake2b_compress(&S, lastblock, true);
+  uint64_t out;
+  std::memcpy(&out, &S.h[0], 8);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// canonical value serialization — must stay byte-identical to
+// pathway_tpu/internals/api.py:_value_bytes
+
+struct ModuleState {
+  PyObject* pointer_type;   // pathway_tpu Pointer class
+  PyObject* fallback;       // python callable obj -> bytes, for exotic types
+  std::string salt;
+};
+
+static ModuleState g_state = {nullptr, nullptr, std::string()};
+
+static inline void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  std::memcpy(b, &v, 4);
+  out.append(b, 4);
+}
+
+static inline void put_u64(std::string& out, uint64_t v) {
+  char b[8];
+  std::memcpy(b, &v, 8);
+  out.append(b, 8);
+}
+
+static inline void put_i64(std::string& out, int64_t v) {
+  char b[8];
+  std::memcpy(b, &v, 8);
+  out.append(b, 8);
+}
+
+static inline void put_f64(std::string& out, double v) {
+  char b[8];
+  std::memcpy(b, &v, 8);
+  out.append(b, 8);
+}
+
+static bool serialize_value(PyObject* v, std::string& out);
+
+static bool serialize_seq(PyObject* v, std::string& out) {
+  PyObject* fast = PySequence_Fast(v, "expected sequence");
+  if (!fast) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  out.push_back('\x06');
+  put_u32(out, (uint32_t)n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, i);
+    std::string sub;
+    if (!serialize_value(item, sub)) {
+      Py_DECREF(fast);
+      return false;
+    }
+    put_u32(out, (uint32_t)sub.size());
+    out.append(sub);
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+static bool serialize_value(PyObject* v, std::string& out) {
+  if (v == Py_None) {
+    out.push_back('\x00');
+    return true;
+  }
+  if (g_state.pointer_type &&
+      PyObject_IsInstance(v, g_state.pointer_type) == 1) {
+    // raises OverflowError for pointers outside [0, 2^64) — the python
+    // fallback's struct.pack("<Q", ...) rejects those too
+    unsigned long long u = PyLong_AsUnsignedLongLong(v);
+    if (u == (unsigned long long)-1 && PyErr_Occurred()) return false;
+    out.push_back('\x07');
+    put_u64(out, (uint64_t)u);
+    return true;
+  }
+  if (PyBool_Check(v)) {
+    out.push_back('\x01');
+    out.push_back(v == Py_True ? '\x01' : '\x00');
+    return true;
+  }
+  if (PyLong_CheckExact(v)) {
+    int overflow = 0;
+    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow == 0 && !(x == -1 && PyErr_Occurred())) {
+      out.push_back('\x02');
+      put_i64(out, (int64_t)x);
+      return true;
+    }
+    PyErr_Clear();
+    // overflow: defer to the python fallback (which raises like struct.pack)
+  } else if (PyFloat_CheckExact(v)) {
+    double f = PyFloat_AS_DOUBLE(v);
+    double t = (f < 0) ? -std::floor(-f) : std::floor(f);
+    if (f == t && f < 9007199254740992.0 && f > -9007199254740992.0) {
+      // ints and integral floats key alike (api.py float path)
+      out.push_back('\x02');
+      put_i64(out, (int64_t)f);
+    } else {
+      out.push_back('\x03');
+      put_f64(out, f);
+    }
+    return true;
+  } else if (PyUnicode_CheckExact(v)) {
+    Py_ssize_t len = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(v, &len);
+    if (!s) return false;
+    out.push_back('\x04');
+    out.append(s, (size_t)len);
+    return true;
+  } else if (PyBytes_CheckExact(v)) {
+    out.push_back('\x05');
+    out.append(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+    return true;
+  } else if (PyTuple_CheckExact(v) || PyList_CheckExact(v)) {
+    return serialize_seq(v, out);
+  }
+  // exotic type (np scalar, ndarray, datetime, Json, dict, ...): python
+  // fallback keeps the bytes identical to api.py:_value_bytes
+  if (!g_state.fallback) {
+    PyErr_SetString(PyExc_RuntimeError, "native fallback not configured");
+    return false;
+  }
+  PyObject* res = PyObject_CallFunctionObjArgs(g_state.fallback, v, nullptr);
+  if (!res) return false;
+  if (!PyBytes_Check(res)) {
+    Py_DECREF(res);
+    PyErr_SetString(PyExc_TypeError, "fallback must return bytes");
+    return false;
+  }
+  out.append(PyBytes_AS_STRING(res), (size_t)PyBytes_GET_SIZE(res));
+  Py_DECREF(res);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// module functions
+
+static PyObject* py_configure(PyObject*, PyObject* args) {
+  PyObject* pointer_type;
+  PyObject* fallback;
+  const char* salt;
+  Py_ssize_t salt_len;
+  if (!PyArg_ParseTuple(args, "OOy#", &pointer_type, &fallback, &salt,
+                        &salt_len))
+    return nullptr;
+  Py_XDECREF(g_state.pointer_type);
+  Py_XDECREF(g_state.fallback);
+  Py_INCREF(pointer_type);
+  Py_INCREF(fallback);
+  g_state.pointer_type = pointer_type;
+  g_state.fallback = fallback;
+  g_state.salt.assign(salt, (size_t)salt_len);
+  Py_RETURN_NONE;
+}
+
+static PyObject* py_hash_value(PyObject*, PyObject* v) {
+  std::string buf;
+  buf.reserve(64);
+  if (!serialize_value(v, buf)) return nullptr;
+  uint64_t h = blake2b64_keyed(
+      (const uint8_t*)g_state.salt.data(), g_state.salt.size(),
+      (const uint8_t*)buf.data(), buf.size());
+  return PyLong_FromUnsignedLongLong(h);
+}
+
+// hash_columns(columns: tuple[sequence,...], n: int) -> bytes (n * u64 LE)
+// Row i's key = hash of the tuple (col0[i], col1[i], ...) — same bytes as
+// ref_scalar(*row).
+static PyObject* py_hash_columns(PyObject*, PyObject* args) {
+  PyObject* columns;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "On", &columns, &n)) return nullptr;
+  PyObject* fast_cols = PySequence_Fast(columns, "expected sequence of columns");
+  if (!fast_cols) return nullptr;
+  Py_ssize_t ncols = PySequence_Fast_GET_SIZE(fast_cols);
+  std::vector<PyObject*> col_objs(ncols);
+  for (Py_ssize_t c = 0; c < ncols; c++)
+    col_objs[c] = PySequence_Fast_GET_ITEM(fast_cols, c);
+  PyObject* out_bytes = PyBytes_FromStringAndSize(nullptr, n * 8);
+  if (!out_bytes) {
+    Py_DECREF(fast_cols);
+    return nullptr;
+  }
+  uint64_t* out = (uint64_t*)PyBytes_AS_STRING(out_bytes);
+  std::string buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    buf.clear();
+    buf.push_back('\x06');
+    put_u32(buf, (uint32_t)ncols);
+    bool ok = true;
+    for (Py_ssize_t c = 0; c < ncols; c++) {
+      PyObject* item = PySequence_GetItem(col_objs[c], i);
+      if (!item) {
+        ok = false;
+        break;
+      }
+      std::string sub;
+      ok = serialize_value(item, sub);
+      Py_DECREF(item);
+      if (!ok) break;
+      put_u32(buf, (uint32_t)sub.size());
+      buf.append(sub);
+    }
+    if (!ok) {
+      Py_DECREF(out_bytes);
+      Py_DECREF(fast_cols);
+      return nullptr;
+    }
+    out[i] = blake2b64_keyed(
+        (const uint8_t*)g_state.salt.data(), g_state.salt.size(),
+        (const uint8_t*)buf.data(), buf.size());
+  }
+  Py_DECREF(fast_cols);
+  return out_bytes;
+}
+
+struct PairHash {
+  size_t operator()(const std::pair<uint64_t, uint64_t>& p) const {
+    return (size_t)(p.first * 0x9e3779b97f4a7c15ULL ^ p.second);
+  }
+};
+
+// consolidate(keys: buffer u64[n], vhashes: buffer u64[n],
+//             diffs: buffer i64[n]) -> (bytes idx i64[m], bytes diff i64[m])
+// Groups rows by (key, value-hash), sums diffs, drops zero groups; output
+// keeps first-seen order. Pure uint64 work — no GIL interaction needed, but
+// buffers are tiny per tick so we keep it simple and hold the GIL.
+static PyObject* py_consolidate(PyObject*, PyObject* args) {
+  Py_buffer kb, vb, db;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &kb, &vb, &db)) return nullptr;
+  Py_ssize_t n = kb.len / 8;
+  const uint64_t* keys = (const uint64_t*)kb.buf;
+  const uint64_t* vh = (const uint64_t*)vb.buf;
+  const int64_t* diffs = (const int64_t*)db.buf;
+  std::unordered_map<std::pair<uint64_t, uint64_t>, size_t, PairHash> slot;
+  slot.reserve((size_t)n * 2);
+  std::vector<int64_t> first_idx;
+  std::vector<int64_t> sum;
+  first_idx.reserve(n);
+  sum.reserve(n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    auto key = std::make_pair(keys[i], vh[i]);
+    auto it = slot.find(key);
+    if (it == slot.end()) {
+      slot.emplace(key, first_idx.size());
+      first_idx.push_back(i);
+      sum.push_back(diffs[i]);
+    } else {
+      sum[it->second] += diffs[i];
+    }
+  }
+  std::vector<int64_t> out_idx;
+  std::vector<int64_t> out_diff;
+  for (size_t j = 0; j < first_idx.size(); j++) {
+    if (sum[j] != 0) {
+      out_idx.push_back(first_idx[j]);
+      out_diff.push_back(sum[j]);
+    }
+  }
+  PyBuffer_Release(&kb);
+  PyBuffer_Release(&vb);
+  PyBuffer_Release(&db);
+  PyObject* idx_b = PyBytes_FromStringAndSize(
+      (const char*)out_idx.data(), (Py_ssize_t)(out_idx.size() * 8));
+  PyObject* diff_b = PyBytes_FromStringAndSize(
+      (const char*)out_diff.data(), (Py_ssize_t)(out_diff.size() * 8));
+  if (!idx_b || !diff_b) {
+    Py_XDECREF(idx_b);
+    Py_XDECREF(diff_b);
+    return nullptr;
+  }
+  PyObject* res = PyTuple_Pack(2, idx_b, diff_b);
+  Py_DECREF(idx_b);
+  Py_DECREF(diff_b);
+  return res;
+}
+
+static PyMethodDef Methods[] = {
+    {"configure", py_configure, METH_VARARGS,
+     "configure(pointer_type, fallback, salt)"},
+    {"hash_value", py_hash_value, METH_O, "hash_value(obj) -> int"},
+    {"hash_columns", py_hash_columns, METH_VARARGS,
+     "hash_columns(columns, n) -> bytes"},
+    {"consolidate", py_consolidate, METH_VARARGS,
+     "consolidate(keys, vhashes, diffs) -> (idx_bytes, diff_bytes)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "pathway_tpu native runtime kernels", -1, Methods};
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&moduledef); }

@@ -1,0 +1,25 @@
+"""Build the pathway_tpu native extension in-place:
+
+    python native/setup.py build_ext --inplace
+
+(Uses only setuptools + g++; no pip installs.)"""
+
+import os
+
+from setuptools import Extension, setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+setup(
+    name="pathway-tpu-native",
+    version="0.1",
+    ext_modules=[
+        Extension(
+            "pathway_tpu._native",
+            sources=[os.path.join(HERE, "pathway_native.cc")],
+            extra_compile_args=["-O3", "-std=c++17"],
+            language="c++",
+        )
+    ],
+    script_args=["build_ext", "--inplace"],
+)

@@ -146,26 +146,45 @@ class DiffBatch:
             if len(self) == 1 and self.diffs[0] == 0:
                 return self.mask(np.zeros(1, dtype=bool))
             return self
-        acc: dict[int, list] = {}
-        order: list[int] = []
+        from pathway_tpu.internals.native import get_native
+
+        nat = get_native()
         cols = list(self.columns.values())
+        if nat is not None:
+            # native path: group by (key, 64-bit value hash) — the value
+            # hash stands in for full value equality within one batch.
+            # Numeric columns go through tolist() so the C serializer sees
+            # exact PyLong/PyFloat (np scalars would bounce back to python)
+            hash_cols = tuple(
+                c.tolist() if c.dtype != object else c for c in cols
+            )
+            vhashes = nat.hash_columns(hash_cols, len(self))
+            idx_b, diff_b = nat.consolidate(
+                np.ascontiguousarray(self.keys).tobytes(),
+                vhashes,
+                np.ascontiguousarray(self.diffs).tobytes(),
+            )
+            idx = np.frombuffer(idx_b, dtype=np.int64)
+            out = self.take(idx)
+            out.diffs = np.frombuffer(diff_b, dtype=np.int64).copy()
+            return out
+        # pure-python fallback: same grouping rule as the native kernel —
+        # (key, serialized value bytes) — so results do not depend on
+        # whether the .so built
+        from pathway_tpu.internals.api import _value_bytes
+
+        acc: dict[tuple[int, bytes], list] = {}
+        order: list[tuple[int, bytes]] = []
         for i in range(len(self.keys)):
-            k = int(self.keys[i])
-            entry = acc.get(k)
-            vals = tuple(c[i] for c in cols)
+            gk = (int(self.keys[i]), _value_bytes(tuple(c[i] for c in cols)))
+            entry = acc.get(gk)
             if entry is None:
-                acc[k] = [vals, int(self.diffs[i]), i]
-                order.append(k)
+                acc[gk] = [int(self.diffs[i]), i]
+                order.append(gk)
             else:
-                if _values_eq(entry[0], vals):
-                    entry[1] += int(self.diffs[i])
-                else:
-                    # same key, different values (update in one tick):
-                    # keep as separate physical rows
-                    acc[(k, i)] = [vals, int(self.diffs[i]), i]  # type: ignore[index]
-                    order.append((k, i))  # type: ignore[arg-type]
-        keep = [e[2] for key in order for e in [acc[key]] if e[1] != 0]
-        diffs_new = [acc[key][1] for key in order if acc[key][1] != 0]
+                entry[0] += int(self.diffs[i])
+        keep = [acc[gk][1] for gk in order if acc[gk][0] != 0]
+        diffs_new = [acc[gk][0] for gk in order if acc[gk][0] != 0]
         idx = np.asarray(keep, dtype=np.int64)
         out = self.take(idx)
         out.diffs = np.asarray(diffs_new, dtype=np.int64)

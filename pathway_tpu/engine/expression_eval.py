@@ -335,6 +335,14 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
         arrays = [eval_expr(a, ctx) for a in e._args]
         inst = eval_expr(e._instance, ctx) if e._instance is not None else None
         out = np.empty(n, dtype=object)
+        if not e._optional and inst is None and arrays:
+            # hot path: batch key derivation in the native kernel
+            from pathway_tpu.internals.api import ref_scalars_columns
+
+            hashed = ref_scalars_columns(list(arrays), n)
+            for i in range(n):
+                out[i] = Pointer(int(hashed[i]))
+            return out
         for i in range(n):
             vals = tuple(a[i] for a in arrays)
             if e._optional and any(v is None for v in vals):

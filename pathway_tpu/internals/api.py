@@ -58,10 +58,15 @@ def _value_bytes(v: Any) -> bytes:
     if isinstance(v, (bool, np.bool_)):
         return b"\x01" + (b"\x01" if v else b"\x00")
     if isinstance(v, (int, np.integer)):
-        return b"\x02" + struct.pack("<q", int(v))
+        x = int(v)
+        if -(2**63) <= x < 2**63:
+            return b"\x02" + struct.pack("<q", x)
+        return b"\x0d" + str(x).encode()
     if isinstance(v, (float, np.floating)):
         f = float(v)
-        if f == int(f) and abs(f) < 2**53:
+        import math
+
+        if math.isfinite(f) and f == int(f) and abs(f) < 2**53:
             # ints and equal floats hash alike so 1 and 1.0 key identically
             return b"\x02" + struct.pack("<q", int(f))
         return b"\x03" + struct.pack("<d", f)
@@ -97,10 +102,41 @@ def _value_bytes(v: Any) -> bytes:
     return b"\x0c" + repr(v).encode()
 
 
+_native_mod: Any = None
+_native_checked = False
+
+
+def _get_native():
+    global _native_mod, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        from pathway_tpu.internals.native import get_native
+
+        _native_mod = get_native()
+    return _native_mod
+
+
 def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     """Derive a stable Pointer from a tuple of values
-    (reference: Key::for_values, src/engine/value.rs:60)."""
+    (reference: Key::for_values, src/engine/value.rs:60). Uses the native
+    kernel (native/pathway_native.cc) when built; byte-identical fallback."""
+    nat = _get_native()
+    if nat is not None:
+        return Pointer(nat.hash_value(tuple(values)))
     return Pointer(_hash_bytes(_value_bytes(tuple(values))))
+
+
+def ref_scalars_columns(columns: list, n: int) -> np.ndarray:
+    """Batch key derivation: row i keys as ref_scalar(col0[i], col1[i], ...).
+    The native path hashes all rows without re-entering the interpreter."""
+    nat = _get_native()
+    if nat is not None:
+        raw = nat.hash_columns(tuple(columns), n)
+        return np.frombuffer(raw, dtype=np.uint64).copy()
+    out = np.empty(n, dtype=np.uint64)
+    for i in range(n):
+        out[i] = int(ref_scalar(*(col[i] for col in columns)))
+    return out
 
 
 def ref_scalar_with_instance(*values: Any, instance: Any) -> Pointer:

@@ -1,0 +1,64 @@
+"""Loader for the native runtime kernels (native/pathway_native.cc).
+
+Imports `pathway_tpu._native` if already built; otherwise builds it once
+with g++ (a few hundred ms) and caches the .so next to the package. Every
+caller has a pure-Python fallback, so a missing toolchain degrades
+performance, never correctness. Disable with PATHWAY_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_native = None
+_tried = False
+
+
+def _build() -> bool:
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(os.path.dirname(pkg_dir), "native", "pathway_native.cc")
+    if not os.path.exists(src):
+        return False
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    target = os.path.join(pkg_dir, "_native" + suffix)
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+        f"-I{include}", src, "-o", target,
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(target)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_native():
+    """The configured native module, or None."""
+    global _native, _tried
+    if _native is not None or _tried:
+        return _native
+    _tried = True
+    if os.environ.get("PATHWAY_NO_NATIVE"):
+        return None
+    try:
+        from pathway_tpu import _native as mod  # type: ignore[attr-defined]
+    except ImportError:
+        if not _build():
+            return None
+        try:
+            from pathway_tpu import _native as mod  # type: ignore[attr-defined]
+        except ImportError:
+            return None
+    from pathway_tpu.internals import api
+
+    mod.configure(api.Pointer, api._value_bytes, api._SALT)
+    # self-check: native hashing must agree with the python path, otherwise
+    # persisted snapshots written by one would not resume under the other
+    probe = (None, True, 7, 2.5, "x", b"y", (1, "z"))
+    if mod.hash_value(probe) != api._hash_bytes(api._value_bytes(probe)):
+        return None
+    _native = mod
+    return _native

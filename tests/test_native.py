@@ -1,0 +1,123 @@
+"""Native kernel parity: the C++ hashing/consolidation must be
+byte-identical to the pure-Python fallback (persisted snapshots written by
+either path must resume under the other).
+(reference native analog: src/engine/value.rs Key::for_values)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals import api
+from pathway_tpu.internals.native import get_native
+
+nat = get_native()
+pytestmark = pytest.mark.skipif(
+    nat is None, reason="native extension unavailable (no g++?)"
+)
+
+VALUES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    -1,
+    2**62,
+    -(2**62),
+    2**70,
+    -(2**100),
+    1.0,
+    -1.5,
+    3.14159,
+    float("nan"),
+    float("inf"),
+    "",
+    "hello",
+    "ünïcødé",
+    b"",
+    b"\x00\xff",
+    (),
+    (1, 2),
+    ("a", (None, 2.0)),
+    [1, "x"],
+    api.Pointer(12345),
+    {"k": 1},
+    float(2**53),
+    float(-(2**53) + 1),
+    np.int64(7),
+    np.float64(2.5),
+    np.array([1.0, 2.0]),
+]
+
+
+def test_hash_parity_all_value_shapes():
+    for v in VALUES:
+        t = (v,)
+        assert nat.hash_value(t) == api._hash_bytes(api._value_bytes(t)), v
+
+
+def test_int_float_key_equivalence():
+    assert nat.hash_value((1,)) == nat.hash_value((1.0,))
+    assert api.ref_scalar(1) == api.ref_scalar(1.0)
+
+
+def test_batch_column_hashing_matches_scalar():
+    cols = [list(range(100)), [f"s{i}" for i in range(100)]]
+    arr = api.ref_scalars_columns(cols, 100)
+    for i in (0, 37, 99):
+        assert arr[i] == int(api.ref_scalar(cols[0][i], cols[1][i]))
+
+
+def test_native_consolidate_groups_and_drops_zeros():
+    keys = np.array([1, 2, 1, 3, 2, 1], dtype=np.uint64)
+    vh = np.array([9, 8, 9, 7, 8, 5], dtype=np.uint64)
+    diffs = np.array([1, 1, -1, 1, 1, 1], dtype=np.int64)
+    idx_b, d_b = nat.consolidate(keys.tobytes(), vh.tobytes(), diffs.tobytes())
+    idx = np.frombuffer(idx_b, dtype=np.int64)
+    d = np.frombuffer(d_b, dtype=np.int64)
+    # (1,9): +1-1 dropped; (2,8): 1+1=2; (3,7): 1; (1,5): 1
+    assert idx.tolist() == [1, 3, 5]
+    assert d.tolist() == [2, 1, 1]
+
+
+def test_consolidate_fallback_matches_native():
+    """Same-key rows with values differing from the first-seen entry must
+    cancel identically in both paths (review regression)."""
+    import os
+    from pathway_tpu.engine.batch import DiffBatch
+
+    rows = [
+        (1, 1, ("a",)),
+        (1, 1, ("b",)),
+        (1, -1, ("b",)),
+        (2, 1, (float("nan"),)),
+        (2, -1, (float("nan"),)),
+    ]
+    b = DiffBatch.from_rows(rows, ["v"])
+    native_out = sorted(
+        (k, d, repr(v)) for k, d, v in b.consolidate().iter_rows()
+    )
+    os.environ["PATHWAY_NO_NATIVE"] = "1"
+    try:
+        import pathway_tpu.internals.native as nmod
+
+        saved = (nmod._native, nmod._tried)
+        nmod._native, nmod._tried = None, True
+        py_out = sorted(
+            (k, d, repr(v)) for k, d, v in b.consolidate().iter_rows()
+        )
+    finally:
+        nmod._native, nmod._tried = saved
+        del os.environ["PATHWAY_NO_NATIVE"]
+    assert native_out == py_out == [(1, 1, "('a',)")]
+
+
+def test_diffbatch_consolidate_native_path():
+    from pathway_tpu.engine.batch import DiffBatch
+
+    b = DiffBatch.from_rows(
+        [(1, 1, ("a",)), (2, 1, ("b",)), (1, -1, ("a",)), (1, 1, ("a2",))],
+        ["v"],
+    )
+    out = b.consolidate()
+    got = sorted((int(k), int(d), vals) for k, d, vals in out.iter_rows())
+    assert got == [(1, 1, ("a2",)), (2, 1, ("b",))]
