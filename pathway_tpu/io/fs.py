@@ -313,19 +313,9 @@ class _FileWriter:
 
 
 def _jsonable(v: Any) -> Any:
-    import numpy as np
+    from pathway_tpu.io._utils import jsonable
 
-    if isinstance(v, Json):
-        return v.value
-    if isinstance(v, np.ndarray):
-        return v.tolist()
-    if isinstance(v, np.generic):
-        return v.item()
-    if isinstance(v, bytes):
-        return v.decode("utf-8", errors="replace")
-    if isinstance(v, tuple):
-        return [_jsonable(x) for x in v]
-    return v
+    return jsonable(v)
 
 
 def write(table: Table, filename: str, *, format: str = "json", **kwargs) -> None:

@@ -1,0 +1,209 @@
+"""pw.io.iceberg — Iceberg-style table source/sink
+(reference: src/connectors/data_lake/iceberg.rs). The image has no
+`pyiceberg`; this speaks a compatible subset of the spec on pyarrow:
+parquet data files tracked by versioned JSON snapshots under `metadata/`
+with a `version-hint.text` pointer (the layout pyiceberg's filesystem
+catalog reads). Full-catalog deployments should install `pyiceberg`."""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import threading
+import uuid
+from typing import Any
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import InputNode
+from pathway_tpu.engine.runtime import StaticSource, StreamingSource
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._utils import add_writer, jsonable
+from pathway_tpu.io.deltalake import _rows_from_parquet
+
+
+def _meta_dir(root: str) -> str:
+    return os.path.join(root, "metadata")
+
+
+def _current_version(root: str) -> int:
+    hint = os.path.join(_meta_dir(root), "version-hint.text")
+    try:
+        with open(hint) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return -1
+
+
+def _snapshot_files(root: str, version: int) -> list[str]:
+    path = os.path.join(_meta_dir(root), f"v{version}.metadata.json")
+    try:
+        with open(path) as f:
+            meta = _json.loads(f.read())
+    except OSError:
+        return []
+    return [os.path.join(root, "data", p) for p in meta.get("files", [])]
+
+
+class _IcebergStaticSource(StaticSource):
+    def __init__(self, root, column_names, schema):
+        super().__init__(column_names)
+        self.root = root
+        self.schema = schema
+
+    def events(self):
+        import itertools
+
+        counter = itertools.count()
+        v = _current_version(self.root)
+        rows = []
+        if v >= 0:
+            for f in _snapshot_files(self.root, v):
+                rows.extend(
+                    _rows_from_parquet(f, self.column_names, self.schema, counter)
+                )
+        if rows:
+            yield 0, DiffBatch.from_rows(rows, self.column_names)
+
+
+class _IcebergStreamingSource(StreamingSource):
+    """Tail the version hint; emit only files added since the last seen
+    snapshot."""
+
+    def __init__(self, root, column_names, schema, refresh_s=0.2):
+        super().__init__(column_names)
+        self.root = root
+        self.schema = schema
+        self.refresh_s = refresh_s
+        self._stop = threading.Event()
+        self._thread = None
+        self._seen_files: set[str] = set()
+        self._version = -1
+        import itertools
+
+        self._counter = itertools.count()
+
+    def offset_state(self) -> dict:
+        return {"version": self._version, "files": sorted(self._seen_files)}
+
+    def seek(self, state: dict) -> None:
+        self._version = int(state.get("version", -1))
+        self._seen_files = set(state.get("files", []))
+
+    def _scan(self):
+        v = _current_version(self.root)
+        if v < 0 or v == self._version:
+            return
+        rows = []
+        for f in _snapshot_files(self.root, v):
+            if f in self._seen_files:
+                continue
+            rows.extend(
+                _rows_from_parquet(f, self.column_names, self.schema, self._counter)
+            )
+            self._seen_files.add(f)
+        self._version = v
+        if rows:
+            self.session.insert_batch(rows, self.offset_state())
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._scan()
+            self._stop.wait(self.refresh_s)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+def read(
+    catalog_uri: str,
+    *,
+    namespace: list[str] | None = None,
+    table_name: str | None = None,
+    schema: Any,
+    mode: str = "streaming",
+    name: str | None = None,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    root = catalog_uri
+    if namespace or table_name:
+        parts = list(namespace or []) + ([table_name] if table_name else [])
+        root = os.path.join(catalog_uri, *parts)
+    column_names = list(schema.column_names())
+    if mode == "static":
+        source: Any = _IcebergStaticSource(root, column_names, schema)
+    else:
+        source = _IcebergStreamingSource(root, column_names, schema)
+    source.persistent_id = persistent_id or name
+    node = InputNode(source, column_names)
+    return Table._from_node(node, dict(schema.dtypes()), Universe())
+
+
+class _IcebergWriter:
+    def __init__(self, root, column_names):
+        self.root = root
+        self.column_names = list(column_names)
+        os.makedirs(_meta_dir(root), exist_ok=True)
+        os.makedirs(os.path.join(root, "data"), exist_ok=True)
+        self.version = _current_version(root)
+        self.files: list[str] = (
+            [
+                os.path.relpath(f, os.path.join(root, "data"))
+                for f in _snapshot_files(root, self.version)
+            ]
+            if self.version >= 0
+            else []
+        )
+
+    def write_batch(self, t: int, batch: DiffBatch) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols: dict[str, list] = {n: [] for n in self.column_names}
+        times, diffs = [], []
+        for _k, d, vals in batch.iter_rows():
+            for n, v in zip(self.column_names, vals):
+                cols[n].append(jsonable(v))
+            times.append(t)
+            diffs.append(d)
+        cols["time"] = times
+        cols["diff"] = diffs
+        fname = f"{uuid.uuid4().hex}.parquet"
+        pq.write_table(pa.table(cols), os.path.join(self.root, "data", fname))
+        self.files.append(fname)
+        self.version += 1
+        meta_path = os.path.join(
+            _meta_dir(self.root), f"v{self.version}.metadata.json"
+        )
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_json.dumps({"files": self.files}))
+        os.replace(tmp, meta_path)
+        hint = os.path.join(_meta_dir(self.root), "version-hint.text")
+        tmp = hint + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self.version))
+        os.replace(tmp, hint)
+
+
+def write(
+    table: Table,
+    catalog_uri: str,
+    *,
+    namespace: list[str] | None = None,
+    table_name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    root = catalog_uri
+    if namespace or table_name:
+        parts = list(namespace or []) + ([table_name] if table_name else [])
+        root = os.path.join(catalog_uri, *parts)
+    writer = _IcebergWriter(root, table.column_names())
+    add_writer(table, writer.write_batch)

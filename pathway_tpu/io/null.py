@@ -1,0 +1,12 @@
+"""pw.io.null — sink that discards rows while still forcing computation
+(reference: NullWriter, src/connectors/data_storage.rs:1514)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._utils import add_writer
+
+
+def write(table, *args: Any, **kwargs: Any) -> None:
+    add_writer(table, lambda t, batch: None)

@@ -1,0 +1,6 @@
+"""pw.io.redpanda — Redpanda speaks the Kafka protocol; same connector
+(reference: python/pathway/io/redpanda wraps io/kafka)."""
+
+from pathway_tpu.io.kafka import read, write
+
+__all__ = ["read", "write"]
