@@ -90,6 +90,7 @@ def __getattr__(name: str):
         "statistical",
         "temporal",
         "utils",
+        "viz",
         "xpacks",
     ):
         module = importlib.import_module(f"pathway_tpu.stdlib.{name}") if name != "xpacks" else importlib.import_module("pathway_tpu.xpacks")
@@ -110,15 +111,15 @@ def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> No
 
 
 def enable_interactive_mode() -> None:
-    pass
+    """Compatibility no-op: pw.live / LiveTable work without prior opt-in
+    here (reference gates interactive mode, internals/interactive.py)."""
 
 
 class TableSlice:
     pass
 
 
-class LiveTable:
-    pass
+from pathway_tpu.internals.interactive import LiveTable, live  # noqa: E402
 
 
 def table_transformer(*args, **kwargs):

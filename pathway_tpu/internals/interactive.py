@@ -1,0 +1,93 @@
+"""LiveTable — live results on a separately running graph.
+
+TPU-native counterpart of the reference's interactive mode
+(reference: python/pathway/internals/interactive.py:130 — LiveTable runs a
+background GraphRunner thread and mirrors a table's current state into the
+notebook via ExportedTable.subscribe). Here the background Runtime streams
+diffs into an in-memory snapshot with a pandas/_repr_html_ view.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import OutputNode
+from pathway_tpu.engine.runtime import Runtime
+from pathway_tpu.internals import parse_graph
+
+
+class LiveTable:
+    def __init__(self, table: Any):
+        self._table = table
+        self._column_names = table.column_names()
+        self._rows: dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self._runtime: Runtime | None = None
+        self._thread: threading.Thread | None = None
+        self._start()
+
+    def _on_batch(self, t: int, batch: DiffBatch) -> None:
+        with self._lock:
+            for k, d, vals in batch.iter_rows():
+                if d > 0:
+                    self._rows[k] = vals
+                else:
+                    self._rows.pop(k, None)
+
+    def _start(self) -> None:
+        # only this table's mirror output — globally declared sinks must
+        # not run as a side effect of peeking at a table
+        node = OutputNode(self._table._node, self._on_batch)
+        G = parse_graph.G
+        self._runtime = Runtime([node], autocommit_ms=50)
+        G.last_runtime = self._runtime
+
+        def run():
+            try:
+                self._runtime.run()
+            except Exception:  # background thread: keep the notebook alive
+                pass
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    # --- views ---------------------------------------------------------------
+
+    def to_pandas(self):
+        import pandas as pd
+
+        with self._lock:
+            keys = list(self._rows.keys())
+            data = {
+                n: [self._rows[k][i] for k in keys]
+                for i, n in enumerate(self._column_names)
+            }
+        return pd.DataFrame(data, index=keys)
+
+    def snapshot(self) -> dict[int, tuple]:
+        with self._lock:
+            return dict(self._rows)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def _repr_html_(self) -> str:
+        return self.to_pandas()._repr_html_()
+
+    def __repr__(self) -> str:
+        return repr(self.to_pandas())
+
+    def stop(self) -> None:
+        if self._runtime is not None:
+            self._runtime.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def live(table: Any) -> LiveTable:
+    """Start the declared dataflow in the background and return a live view
+    of `table` (Jupyter-friendly)."""
+    return LiveTable(table)

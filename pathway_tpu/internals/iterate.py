@@ -33,13 +33,18 @@ class IterateNode(Node):
         self,
         outer_inputs: list[Node],
         placeholder_nodes: list[InputNode],
+        boundary_proxies: list[InputNode],
         result_nodes: dict[str, Node],
         iterated_names: list[str],
         out_name: str,
         iteration_limit: int | None,
     ):
+        # inputs = initial iterated tables, then boundary outer tables the
+        # body reads (their diffs stream in from the OUTER runtime; inside
+        # the body they are injected as frozen snapshots via proxies)
         super().__init__(outer_inputs, result_nodes[out_name].column_names)
         self.placeholder_nodes = placeholder_nodes
+        self.boundary_proxies = boundary_proxies
         self.result_nodes = result_nodes
         self.iterated_names = iterated_names
         self.out_name = out_name
@@ -58,7 +63,9 @@ class IterateExec(NodeExec):
         self.emitted: dict[int, tuple] = {}
 
     def _run_body(
-        self, current: dict[str, dict[int, tuple]]
+        self,
+        current: dict[str, dict[int, tuple]],
+        boundary: list[dict[int, tuple]],
     ) -> dict[str, dict[int, tuple]]:
         """One application of the iteration body over full snapshots."""
         node = self.node
@@ -83,6 +90,9 @@ class IterateExec(NodeExec):
         for ph, name in zip(node.placeholder_nodes, node.iterated_names):
             rows = [(k, 1, v) for k, v in current[name].items()]
             injected[ph.id] = [DiffBatch.from_rows(rows, ph.column_names)]
+        for proxy, snap in zip(node.boundary_proxies, boundary):
+            rows = [(k, 1, v) for k, v in snap.items()]
+            injected[proxy.id] = [DiffBatch.from_rows(rows, proxy.column_names)]
         rt.tick(0, injected)
         rt.tick(1 << 62)  # flush
         return captures
@@ -97,12 +107,17 @@ class IterateExec(NodeExec):
         if not touched:
             return []
         node = self.node
+        n_iter = len(node.iterated_names)
         current: dict[str, dict[int, tuple]] = {}
-        for name, state in zip(node.iterated_names, self.states):
+        for name, state in zip(node.iterated_names, self.states[:n_iter]):
             current[name] = {k: e[0] for k, e in state.rows.items()}
+        boundary = [
+            {k: e[0] for k, e in state.rows.items()}
+            for state in self.states[n_iter:]
+        ]
         limit = node.iteration_limit or 1000
         for _i in range(limit):
-            result = self._run_body(current)
+            result = self._run_body(current, boundary)
             new = {name: result[name] for name in node.iterated_names}
             if all(new[name] == current[name] for name in node.iterated_names):
                 current = new
@@ -164,12 +179,65 @@ def iterate(
         single = False
     result_nodes = {name: tbl._node for name, tbl in result_map.items()}
 
+    # Cut the body graph at the placeholder-downstream boundary: any node
+    # the body reads that is NOT downstream of a placeholder belongs to the
+    # outer graph (e.g. the edges table of pagerank/louvain). It must not be
+    # re-executed inside the body runtime (its execs there would be empty) —
+    # instead it becomes an extra IterateNode input whose consolidated state
+    # is injected into a proxy InputNode on every body run.
+    # (reference analog: differential `iterate` closes over outer-scope
+    # collections via `enter`, external/differential-dataflow iterate.rs)
+    ph_ids = {ph.id for ph in placeholders}
+    downstream_memo: dict[int, bool] = {}
+
+    def downstream(node: Node) -> bool:
+        if node.id in ph_ids:
+            return True
+        if node.id in downstream_memo:
+            return downstream_memo[node.id]
+        downstream_memo[node.id] = False  # DAG: safe guard
+        r = any(downstream(i) for i in node.inputs)
+        downstream_memo[node.id] = r
+        return r
+
+    topo: list[Node] = []
+    visited: set[int] = set()
+
+    def visit(n: Node):
+        if n.id in visited:
+            return
+        visited.add(n.id)
+        for i in n.inputs:
+            visit(i)
+        topo.append(n)
+
+    for rn in result_nodes.values():
+        visit(rn)
+    boundary_nodes: list[Node] = []
+    proxies: dict[int, InputNode] = {}
+    for n in topo:
+        if not downstream(n) or n.id in ph_ids:
+            continue
+        for idx, i in enumerate(n.inputs):
+            if downstream(i):
+                continue
+            proxy = proxies.get(i.id)
+            if proxy is None:
+                proxy = InputNode(
+                    _PlaceholderSource(i.column_names), i.column_names
+                )
+                proxies[i.id] = proxy
+                boundary_nodes.append(i)
+            n.inputs[idx] = proxy
+    boundary_proxies = [proxies[b.id] for b in boundary_nodes]
+
     outer_nodes = [tbl._node for tbl in kwargs.values()]
     out_tables = {}
     for out_name, rtbl in result_map.items():
         it_node = IterateNode(
-            outer_nodes,
+            outer_nodes + boundary_nodes,
             placeholders,
+            boundary_proxies,
             result_nodes,
             iterated_names,
             out_name,

@@ -80,8 +80,13 @@ def run(
             monitor.start()
         except Exception:
             monitor = None
+    from pathway_tpu.internals.telemetry import get_telemetry
+
     try:
-        runtime.run()
+        with get_telemetry().span(
+            "pathway.run", nodes=len(runtime.order)
+        ):
+            runtime.run()
     finally:
         if monitor is not None:
             monitor.stop()

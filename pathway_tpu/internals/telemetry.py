@@ -1,0 +1,70 @@
+"""Telemetry — OpenTelemetry spans around graph build and execution.
+
+TPU-native counterpart of the reference's tracing stack
+(reference: src/engine/telemetry.rs — OTLP traces/metrics;
+internals/graph_runner/telemetry.py — python build spans share one trace
+with engine spans via trace_parent). The image ships the OTel API but no
+SDK/exporter, so spans are real when an SDK is configured by the host
+application and free no-ops otherwise. Enable by passing
+``monitoring_server=...`` / setting PATHWAY_MONITORING_SERVER (the
+reference gates OTLP export the same way).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+try:
+    from opentelemetry import trace as _trace
+
+    _tracer = _trace.get_tracer("pathway_tpu")
+    _HAS_OTEL = True
+except ImportError:  # pragma: no cover
+    _tracer = None
+    _HAS_OTEL = False
+
+
+class Telemetry:
+    """Span factory + lightweight local timings (always collected)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.timings: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            if self.enabled and _HAS_OTEL:
+                with _tracer.start_as_current_span(name) as sp:
+                    for k, v in attributes.items():
+                        try:
+                            sp.set_attribute(k, v)
+                        except Exception:
+                            pass
+                    yield
+            else:
+                yield
+        finally:
+            self.timings[name] = self.timings.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def trace_parent(self) -> str | None:
+        """W3C traceparent of the current span — the reference forwards
+        this across the Python/engine boundary (python_api.rs:3343)."""
+        if not _HAS_OTEL:
+            return None
+        ctx = _trace.get_current_span().get_span_context()
+        if not ctx.is_valid:
+            return None
+        return f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-{ctx.trace_flags:02x}"
+
+
+_GLOBAL = Telemetry()
+
+
+def get_telemetry() -> Telemetry:
+    return _GLOBAL

@@ -9,6 +9,7 @@ __all__ = [
     "statistical",
     "temporal",
     "utils",
+    "viz",
 ]
 
 

@@ -1,0 +1,58 @@
+"""pw.viz — table visualization helpers.
+
+TPU-native counterpart of the reference's viz stdlib
+(reference: python/pathway/stdlib/viz/ — Bokeh live plots in plotting.py,
+DataFrame-styled table snapshots in table_viz.py). Bokeh is not in this
+image, so `plot` degrades to a clear error while `show`/`table_viz` render
+through pandas/rich, which are available.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def table_viz(table: Any, **kwargs: Any):
+    """Render the table's current static result as a styled DataFrame
+    (reference: stdlib/viz/table_viz.py)."""
+    from pathway_tpu.debug import table_to_pandas
+
+    return table_to_pandas(table, include_id=False)
+
+
+def show(table: Any, **kwargs: Any) -> None:
+    """Print the table's current result (rich table when on a tty)."""
+    try:
+        from rich.console import Console
+        from rich.table import Table as RichTable
+
+        df = table_viz(table)
+        rt = RichTable()
+        for c in df.columns:
+            rt.add_column(str(c))
+        for _idx, row in df.iterrows():
+            rt.add_row(*[str(v) for v in row])
+        Console().print(rt)
+    except ImportError:
+        from pathway_tpu.debug import compute_and_print
+
+        compute_and_print(table, include_id=False)
+
+
+def plot(table: Any, plotting_function: Callable | None = None, **kwargs: Any):
+    """Bokeh plot of a table's computed result
+    (reference: stdlib/viz/plotting.py). Requires `bokeh`, which is not
+    baked into this image."""
+    try:
+        import bokeh  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.viz.plot requires bokeh, which is not installed in this "
+            "environment; use pw.viz.show / pw.live(table).to_pandas instead"
+        ) from e
+    df = table_viz(table)
+    if plotting_function is not None:
+        return plotting_function(df)
+    from bokeh.plotting import figure
+
+    return figure()

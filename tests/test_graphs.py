@@ -1,0 +1,81 @@
+"""Graph algorithms (reference: stdlib/graphs tests — pagerank,
+bellman_ford, louvain)."""
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_to_dicts
+
+
+def test_pagerank_star():
+    edges = T(
+        """
+        u | v
+        a | hub
+        b | hub
+        c | hub
+        hub | a
+        """
+    )
+    res = pw.graphs.pagerank(edges, steps=60)
+    _keys, cols = table_to_dicts(res)
+    ranks = {cols["v"][k]: cols["rank"][k] for k in cols["v"]}
+    # closed form: hub = 0.405 + 0.85*a, a = 0.15 + 0.85*hub
+    assert abs(ranks["hub"] - 1.9189) < 1e-2
+    assert abs(ranks["a"] - 1.7811) < 1e-2
+    assert abs(ranks["b"] - 0.15) < 1e-9 and abs(ranks["c"] - 0.15) < 1e-9
+    assert ranks["hub"] == max(ranks.values())
+
+
+def test_louvain_two_cliques():
+    # two triangles joined by one weak edge -> two communities
+    edges = T(
+        """
+        u | v
+        a | b
+        b | c
+        a | c
+        x | y
+        y | z
+        x | z
+        c | x
+        """
+    )
+    vertices = T(
+        """
+        v
+        a
+        b
+        c
+        x
+        y
+        z
+        """
+    )
+    res = pw.graphs.louvain_communities(vertices, edges, iteration_limit=8)
+    _keys, cols = table_to_dicts(res)
+    comm = {cols["v"][k]: cols["c"][k] for k in cols["v"]}
+    assert comm["a"] == comm["b"] == comm["c"]
+    assert comm["x"] == comm["y"] == comm["z"]
+    assert comm["a"] != comm["x"]
+
+
+def test_modularity_of_perfect_split():
+    edges = T(
+        """
+        u | v | weight
+        a | b | 1.0
+        x | y | 1.0
+        """
+    )
+    communities = T(
+        """
+        v | c
+        a | 1
+        b | 1
+        x | 2
+        y | 2
+        """
+    )
+    res = pw.graphs.modularity(edges, communities)
+    _keys, cols = table_to_dicts(res)
+    (q,) = cols["modularity"].values()
+    assert abs(q - 0.5) < 1e-9

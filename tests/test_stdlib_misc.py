@@ -1,0 +1,93 @@
+"""HMM reducer, LiveTable, viz, telemetry (reference: stdlib/ml/hmm.py,
+internals/interactive.py, stdlib/viz, telemetry stack)."""
+
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_to_dicts
+
+
+def test_hmm_reducer_viterbi_filtering():
+    from pathway_tpu.stdlib.ml.hmm import (
+        DenseHMM,
+        create_hmm_reducer,
+        most_likely_state,
+    )
+
+    # weather HMM: observations strongly indicate the hidden state
+    hmm = DenseHMM(
+        states=["rain", "sun"],
+        initial={"rain": 0.5, "sun": 0.5},
+        transitions={
+            ("rain", "rain"): 0.7,
+            ("rain", "sun"): 0.3,
+            ("sun", "rain"): 0.3,
+            ("sun", "sun"): 0.7,
+        },
+        emission=lambda s, o: (
+            0.9 if (s == "rain") == (o == "umbrella") else 0.1
+        ),
+    )
+    reducer = create_hmm_reducer(hmm)
+    t = T(
+        """
+        g | obs      | __time__
+        1 | umbrella | 2
+        1 | umbrella | 4
+        1 | shades   | 6
+        1 | shades   | 8
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, beam=reducer(t.obs))
+    out = res.select(res.g, state=pw.apply(most_likely_state, res.beam))
+    _keys, cols = table_to_dicts(out)
+    assert list(cols["state"].values()) == ["sun"]
+
+
+def test_live_table_background_updates():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    agg = t.groupby().reduce(total=pw.reducers.sum(t.v))
+    lt = pw.live(agg)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(lt) == 0:
+        time.sleep(0.05)
+    df = lt.to_pandas()
+    assert list(df["total"]) == [6]
+    lt.stop()
+
+
+def test_viz_table_and_show(capsys):
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    df = pw.viz.table_viz(t)
+    assert list(df.columns) == ["a", "b"]
+    pw.internals.parse_graph.G.clear()
+    t2 = T(
+        """
+        a
+        7
+        """
+    )
+    pw.viz.show(t2)
+    out = capsys.readouterr().out
+    assert "7" in out
+
+
+def test_telemetry_span_timings():
+    from pathway_tpu.internals.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    with tel.span("test.block"):
+        pass
+    assert "test.block" in tel.timings
