@@ -1,0 +1,304 @@
+"""pw.sql tests (reference: python/pathway/tests/test_sql.py, 1,822 LoC —
+representative coverage of the supported subset)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_to_dicts
+
+
+def _vals(res, col):
+    _k, cols = table_to_dicts(res)
+    return sorted(cols[col].values())
+
+
+def test_select_arithmetic_and_alias():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = pw.sql("SELECT a + b AS s, b - a AS d FROM tab", tab=t)
+    assert _vals(res, "s") == [3, 7]
+    assert _vals(res, "d") == [1, 1]
+
+
+def test_select_star_and_where():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        5 | 0
+        """
+    )
+    res = pw.sql("SELECT * FROM tab WHERE a > 1 AND b <> 0", tab=t)
+    assert _vals(res, "a") == [3]
+
+
+def test_where_or_not_in_between():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        4
+        5
+        """
+    )
+    assert _vals(pw.sql("SELECT v FROM t WHERE v IN (1, 3)", t=t), "v") == [1, 3]
+    assert _vals(
+        pw.sql("SELECT v FROM t WHERE v NOT IN (1, 3)", t=t), "v"
+    ) == [2, 4, 5]
+    assert _vals(
+        pw.sql("SELECT v FROM t WHERE v BETWEEN 2 AND 4", t=t), "v"
+    ) == [2, 3, 4]
+    assert _vals(
+        pw.sql("SELECT v FROM t WHERE NOT (v = 1 OR v = 5)", t=t), "v"
+    ) == [2, 3, 4]
+
+
+def test_group_by_having():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 3
+        b | 4
+        c | 10
+        """
+    )
+    res = pw.sql(
+        "SELECT g, SUM(v) AS total, COUNT(*) AS n FROM t GROUP BY g",
+        t=t,
+    )
+    _k, cols = table_to_dicts(res)
+    got = {cols["g"][k]: (cols["total"][k], cols["n"][k]) for k in cols["g"]}
+    assert got == {"a": (3, 2), "b": (7, 2), "c": (10, 1)}
+
+    res2 = pw.sql(
+        "SELECT g, SUM(v) AS total FROM t GROUP BY g HAVING SUM(v) > 5",
+        t=t,
+    )
+    assert _vals(res2, "total") == [7, 10]
+
+
+def test_join_on_with_aliases():
+    people = T(
+        """
+        name  | city_id
+        alice | 1
+        bob   | 2
+        """
+    )
+    cities = T(
+        """
+        cid | city
+        1   | paris
+        2   | tokyo
+        """
+    )
+    res = pw.sql(
+        "SELECT p.name, c.city FROM people p JOIN cities c ON p.city_id = c.cid",
+        people=people,
+        cities=cities,
+    )
+    _k, cols = table_to_dicts(res)
+    got = {cols["name"][k]: cols["city"][k] for k in cols["name"]}
+    assert got == {"alice": "paris", "bob": "tokyo"}
+
+
+def test_left_join_null_and_is_null():
+    orders = T(
+        """
+        oid | cust
+        1   | a
+        2   | zz
+        """
+    )
+    custs = T(
+        """
+        cust | tier
+        a    | gold
+        """
+    )
+    res = pw.sql(
+        "SELECT o.oid, c.tier FROM orders o LEFT JOIN custs c ON o.cust = c.cust",
+        orders=orders,
+        custs=custs,
+    )
+    _k, cols = table_to_dicts(res)
+    got = {cols["oid"][k]: cols["tier"][k] for k in cols["oid"]}
+    assert got == {1: "gold", 2: None}
+    res2 = pw.sql(
+        "SELECT o.oid FROM orders o LEFT JOIN custs c ON o.cust = c.cust "
+        "WHERE c.tier IS NULL",
+        orders=orders,
+        custs=custs,
+    )
+    assert _vals(res2, "oid") == [2]
+
+
+def test_composite_key_join():
+    a = T(
+        """
+        k | j | x
+        1 | 1 | p
+        1 | 2 | q
+        """
+    )
+    b = T(
+        """
+        k | j | y
+        1 | 1 | P
+        1 | 2 | Q
+        """
+    )
+    res = pw.sql(
+        "SELECT a.x, b.y FROM a JOIN b ON a.k = b.k AND a.j = b.j",
+        a=a,
+        b=b,
+    )
+    _k, cols = table_to_dicts(res)
+    got = {cols["x"][k]: cols["y"][k] for k in cols["x"]}
+    assert got == {"p": "P", "q": "Q"}
+
+
+def test_three_table_join_with_colliding_column():
+    a = T(
+        """
+        k | v
+        1 | 10
+        """
+    )
+    b = T(
+        """
+        k | v
+        1 | 77
+        2 | 88
+        """
+    )
+    c = T(
+        """
+        v  | z
+        77 | hit
+        10 | wrong
+        """
+    )
+    # b.v in the second ON must bind to b's v (renamed after the first
+    # join), not a's v
+    res = pw.sql(
+        "SELECT a.k, c.z FROM a JOIN b ON a.k = b.k JOIN c ON b.v = c.v",
+        a=a,
+        b=b,
+        c=c,
+    )
+    assert _vals(res, "z") == ["hit"]
+
+
+def test_union_and_union_all():
+    t1 = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    t2 = T(
+        """
+        v
+        2
+        3
+        """
+    )
+    assert _vals(pw.sql("SELECT v FROM a UNION SELECT v FROM b", a=t1, b=t2), "v") == [1, 2, 3]
+    assert _vals(
+        pw.sql("SELECT v FROM a UNION ALL SELECT v FROM b", a=t1, b=t2), "v"
+    ) == [1, 2, 2, 3]
+
+
+def test_intersect_and_except():
+    t1 = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    t2 = T(
+        """
+        v
+        2
+        3
+        4
+        """
+    )
+    assert _vals(
+        pw.sql("SELECT v FROM a INTERSECT SELECT v FROM b", a=t1, b=t2), "v"
+    ) == [2, 3]
+    assert _vals(
+        pw.sql("SELECT v FROM a EXCEPT SELECT v FROM b", a=t1, b=t2), "v"
+    ) == [1]
+
+
+def test_distinct():
+    t = T(
+        """
+        v
+        1
+        1
+        2
+        """
+    )
+    assert _vals(pw.sql("SELECT DISTINCT v FROM t", t=t), "v") == [1, 2]
+
+
+def test_case_when():
+    t = T(
+        """
+        v
+        1
+        5
+        10
+        """
+    )
+    res = pw.sql(
+        "SELECT v, CASE WHEN v < 3 THEN 'low' WHEN v < 8 THEN 'mid' "
+        "ELSE 'high' END AS bucket FROM t",
+        t=t,
+    )
+    _k, cols = table_to_dicts(res)
+    got = {cols["v"][k]: cols["bucket"][k] for k in cols["v"]}
+    assert got == {1: "low", 5: "mid", 10: "high"}
+
+
+def test_string_literal_and_quotes():
+    t = T(
+        """
+        name
+        ana
+        bo
+        """
+    )
+    res = pw.sql("SELECT name FROM t WHERE name = 'ana'", t=t)
+    assert _vals(res, "name") == ["ana"]
+
+
+def test_errors():
+    t = T(
+        """
+        v
+        1
+        """
+    )
+    with pytest.raises(ValueError):
+        pw.sql("SELECT nope FROM t", t=t)
+    with pytest.raises(ValueError):
+        pw.sql("SELECT v FROM missing", t=t)
+    with pytest.raises(ValueError):
+        pw.sql("SELECT v FROM t HAVING v > 1", t=t)
