@@ -12,7 +12,7 @@ import threading
 import time
 from typing import Any, Iterable, Sequence
 
-from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.batch import DiffBatch, make_column
 from pathway_tpu.engine.nodes import InputNode
 from pathway_tpu.engine.runtime import StaticSource, StreamingSource
 from pathway_tpu.internals import dtype as dt
@@ -64,62 +64,114 @@ def _parse_file(
         delim = ","
         if csv_settings is not None:
             delim = getattr(csv_settings, "delimiter", ",")
+        coercers = _make_coercers(schema, col_names, _coerce_one)
         with open(fpath, newline="") as f:
             reader = _csv.DictReader(f, delimiter=delim)
             for i, row in enumerate(reader):
-                names = col_names or list(row.keys())
-                vals = tuple(
-                    _coerce(row.get(n), schema, n) for n in names
-                )
+                if coercers is not None:
+                    vals = tuple(fn(row.get(n)) for n, fn in coercers)
+                else:
+                    vals = tuple(row.values())
                 yield (fpath, i), vals
         return
     if format in ("json", "jsonlines"):
+        coercers = _make_coercers(schema, col_names, _coerce_json_one)
         with open(fpath, "r") as f:
             for i, line in enumerate(f):
                 line = line.strip()
                 if not line:
                     continue
                 obj = _json.loads(line)
-                names = col_names or list(obj.keys())
-                vals = tuple(_coerce_json(obj.get(n), schema, n) for n in names)
+                if coercers is not None:
+                    vals = tuple(fn(obj.get(n)) for n, fn in coercers)
+                else:
+                    names = col_names or list(obj.keys())
+                    vals = tuple(obj.get(n) for n in names)
                 yield (fpath, i), vals
         return
     raise ValueError(f"unknown format {format!r}")
 
 
-def _coerce(v: Any, schema, name: str) -> Any:
-    if v is None:
+def _make_coercers(schema, col_names, make_one):
+    """Per-column coercion closures resolved ONCE per file — dtype lookup
+    and comparison per row was the parse hot spot."""
+    if schema is None or col_names is None:
         return None
-    if schema is None:
-        return v
-    d = schema.dtypes().get(name, dt.ANY).strip_optional()
+    dtypes = schema.dtypes()
+    return [
+        (n, make_one(dtypes.get(n, dt.ANY).strip_optional())) for n in col_names
+    ]
+
+
+def _coerce_one(d):
+    """Column coercer for text (csv) input values."""
+    if d == dt.INT:
+        return lambda v: None if v is None else _safe(int, v)
+    if d == dt.FLOAT:
+        return lambda v: None if v is None else _safe(float, v)
+    if d == dt.BOOL:
+        return lambda v: (
+            None
+            if v is None
+            else (v if isinstance(v, bool) else v.lower() in ("true", "1"))
+        )
+    if d == dt.STR:
+        return lambda v: None if v is None else str(v)
+    if d == dt.JSON:
+        return lambda v: (
+            None
+            if v is None
+            else _safe(lambda x: Json(_json.loads(x) if isinstance(x, str) else x), v)
+        )
+    return lambda v: v
+
+
+def _safe(fn, v):
     try:
-        if d == dt.INT:
-            return int(v)
-        if d == dt.FLOAT:
-            return float(v)
-        if d == dt.BOOL:
-            return v if isinstance(v, bool) else v.lower() in ("true", "1")
-        if d == dt.STR:
-            return str(v)
-        if d == dt.JSON:
-            return Json(_json.loads(v) if isinstance(v, str) else v)
+        return fn(v)
     except (ValueError, TypeError):
         return None
-    return v
+
+
+def _coerce_json_one(d):
+    """Column coercer for already-typed (json) input values. Non-JSON
+    dtypes wrap stray list/dict values into Json (matching the historical
+    fs behavior the s3 scanner shares)."""
+    if d == dt.JSON:
+        return lambda v: v if isinstance(v, Json) else Json(v)
+    if d == dt.FLOAT:
+
+        def as_float(v):
+            if isinstance(v, int):
+                return float(v)
+            if isinstance(v, (list, dict)):
+                return Json(v)
+            return v
+
+        return as_float
+
+    def generic(v):
+        if isinstance(v, (list, dict)):
+            return Json(v)
+        return v
+
+    return generic
+
+
+def _coerce(v: Any, schema, name: str) -> Any:
+    """Single-value text coercion (same rules as the per-column closures —
+    kept for callers that coerce ad hoc, e.g. the s3 scanner)."""
+    if schema is None:
+        return v
+    return _coerce_one(schema.dtypes().get(name, dt.ANY).strip_optional())(v)
 
 
 def _coerce_json(v: Any, schema, name: str) -> Any:
     if schema is None:
         return v
-    d = schema.dtypes().get(name, dt.ANY).strip_optional()
-    if d == dt.JSON:
-        return Json(v)
-    if d == dt.FLOAT and isinstance(v, int):
-        return float(v)
-    if isinstance(v, (list, dict)) and d not in (dt.JSON,):
-        return Json(v)
-    return v
+    return _coerce_json_one(
+        schema.dtypes().get(name, dt.ANY).strip_optional()
+    )(v)
 
 
 class _FsStaticSource(StaticSource):
@@ -132,27 +184,35 @@ class _FsStaticSource(StaticSource):
         self.pk_cols = pk_cols
 
     def events(self):
-        rows = []
-        counter = 0
+        import numpy as np
+
+        from pathway_tpu.internals.api import ref_scalars_columns
+
+        all_vals: list[tuple] = []
+        all_pks: list[tuple] = []
         for fpath in _list_files(self.path):
             for pk, vals in _parse_file(
                 fpath, self.format, self.schema, self.csv_settings
             ):
-                if self.pk_cols:
-                    key = int(
-                        ref_scalar(
-                            *[
-                                vals[self.column_names.index(c)]
-                                for c in self.pk_cols
-                            ]
-                        )
-                    )
-                else:
-                    key = int(ref_scalar(*pk))
-                rows.append((key, 1, vals))
-                counter += 1
-        if rows:
-            yield 0, DiffBatch.from_rows(rows, self.column_names)
+                all_vals.append(vals)
+                all_pks.append(pk)
+        if not all_vals:
+            return
+        n = len(all_vals)
+        # batch key derivation through the native hasher — one call for the
+        # whole snapshot instead of a per-row ref_scalar
+        if self.pk_cols:
+            pk_idx = [self.column_names.index(c) for c in self.pk_cols]
+            key_cols = [[v[i] for v in all_vals] for i in pk_idx]
+        else:
+            width = len(all_pks[0])
+            key_cols = [[p[i] for p in all_pks] for i in range(width)]
+        keys = ref_scalars_columns(key_cols, n)
+        cols = {
+            name: make_column([v[i] for v in all_vals])
+            for i, name in enumerate(self.column_names)
+        }
+        yield 0, DiffBatch(keys, np.ones(n, dtype=np.int64), cols)
 
 
 class _FsStreamingSource(StreamingSource):

@@ -59,6 +59,28 @@ def _scores(
     return dots
 
 
+def _masked_topk(s: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Exact top-k over [B, N] scores. For large N uses the two-stage
+    block decomposition (top-k per 1024-column block, then top-k over the
+    block winners) — exact because every global top-k element is within
+    the top-k of its own block, and much friendlier to the TPU than one
+    monolithic 1M-wide TopK."""
+    n = s.shape[-1]
+    blk = 1024
+    if n >= 64 * blk and k <= blk:
+        nblk = (n + blk - 1) // blk
+        pad = nblk * blk - n
+        if pad:
+            s = jnp.pad(s, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        sb = s.reshape(s.shape[0], nblk, blk)
+        sc1, ix1 = jax.lax.top_k(sb, k)  # [B, nblk, k]
+        gidx = ix1 + (jnp.arange(nblk, dtype=ix1.dtype) * blk)[None, :, None]
+        sc2, pos = jax.lax.top_k(sc1.reshape(s.shape[0], -1), k)
+        idx = jnp.take_along_axis(gidx.reshape(s.shape[0], -1), pos, axis=1)
+        return sc2, idx
+    return jax.lax.top_k(s, k)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric", "bf16"))
 def dense_topk(
     queries: jax.Array,  # [B, D] f32
@@ -72,7 +94,7 @@ def dense_topk(
     -inf scores and index -1."""
     s = _scores(queries, corpus, metric, bf16)
     s = jnp.where(valid[None, :], s, -jnp.inf)
-    scores, idx = jax.lax.top_k(s, k)
+    scores, idx = _masked_topk(s, k)
     idx = jnp.where(jnp.isfinite(scores), idx, -1)
     return scores, idx
 
@@ -121,7 +143,7 @@ def dense_topk_prepared(
     else:
         s = dots
     s = jnp.where(valid[None, :], s, -jnp.inf)
-    scores, idx = jax.lax.top_k(s, k)
+    scores, idx = _masked_topk(s, k)
     idx = jnp.where(jnp.isfinite(scores), idx, -1)
     return scores, idx
 
@@ -141,7 +163,7 @@ def _sharded_topk_impl(queries, corpus, valid, base_idx, k, metric, bf16, mesh, 
         s = _scores(q, c, metric, bf16)
         s = jnp.where(v[None, :], s, -jnp.inf)
         kk = min(k, c.shape[0])
-        sc, ix = jax.lax.top_k(s, kk)
+        sc, ix = _masked_topk(s, kk)
         ix = ix + b[0]  # local -> global row index
         # gather candidates from all shards over ICI, merge with final top-k
         sc_all = jax.lax.all_gather(sc, axis, axis=1, tiled=True)
