@@ -91,7 +91,10 @@ def _elementwise(fn: Callable, *arrays: np.ndarray) -> np.ndarray:
             continue
         try:
             out[i] = fn(*args)
-        except Exception:
+        except Exception as exc:
+            from pathway_tpu.internals.errors import record_error
+
+            record_error(exc)
             out[i] = ERROR
     return out
 
@@ -142,6 +145,10 @@ def _binary(op: str, left: np.ndarray, right: np.ndarray) -> np.ndarray:
                 r = right.astype(np.float64)
                 bad = right == 0
                 if bad.any():
+                    from pathway_tpu.internals.errors import record_error
+
+                    for _ in range(int(np.sum(bad))):
+                        record_error("division by zero")
                     res = np.where(bad, np.nan, np.divide(l, np.where(bad, 1, r)))
                     out = res.astype(object)
                     out[np.asarray(bad)] = ERROR
@@ -151,6 +158,10 @@ def _binary(op: str, left: np.ndarray, right: np.ndarray) -> np.ndarray:
                 bad = right == 0
                 fn = np.floor_divide if op == "//" else np.mod
                 if bad.any():
+                    from pathway_tpu.internals.errors import record_error
+
+                    for _ in range(int(np.sum(bad))):
+                        record_error("division by zero")
                     res = fn(left, np.where(bad, 1, right))
                     out = res.astype(object)
                     out[np.asarray(bad)] = ERROR

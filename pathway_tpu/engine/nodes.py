@@ -432,8 +432,16 @@ class GroupByExec(NodeExec):
                 # ordered reducers (tuple/ndarray/earliest) sort by this token
                 order = (vals[self.sort_idx], k) if self.sort_idx is not None else k
                 for acc, idx in zip(gs.accs, self.arg_idx):
+                    args = tuple(vals[j] for j in idx)
+                    if any(a is ERROR for a in args):
+                        # aggregating a poisoned value poisons the aggregate
+                        # while the poisoned row is present; retraction
+                        # un-poisons (reference: Value::Error propagation,
+                        # src/engine/error.rs)
+                        acc.poisoned_count += d
+                        continue
                     try:
-                        acc.update(tuple(vals[j] for j in idx), d, order, t)
+                        acc.update(args, d, order, t)
                     except Exception as exc:
                         record_error(exc, str(self.node))
                 touched[gk] = None
@@ -443,7 +451,10 @@ class GroupByExec(NodeExec):
         for gk, gs in [(gk, self.groups[gk]) for gk in touched]:
             if gs.count > 0:
                 try:
-                    new = gs.gvals + tuple(acc.value() for acc in gs.accs)
+                    new = gs.gvals + tuple(
+                        ERROR if acc.poisoned_count > 0 else acc.value()
+                        for acc in gs.accs
+                    )
                 except Exception as exc:
                     record_error(exc, str(self.node))
                     new = gs.gvals + tuple(ERROR for _ in gs.accs)

@@ -33,6 +33,10 @@ class ReducerSpec:
 
 
 class Accumulator:
+    # net count of ERROR-bearing rows in this aggregate (+diff/-diff), so a
+    # retracted/corrected poison row un-poisons the group
+    poisoned_count = 0
+
     def __init__(self, spec: ReducerSpec):
         self.spec = spec
 

@@ -21,7 +21,7 @@ from pathway_tpu.internals.api import ref_scalar
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._utils import require
-from pathway_tpu.io.fs import _coerce, _coerce_json
+from pathway_tpu.io.fs import _coerce_json_one, _coerce_one, _make_coercers
 
 
 class AwsS3Settings:
@@ -87,20 +87,26 @@ def _parse_object(data: bytes, opath: str, format: str, schema, column_names):
         return [((opath,), (data,))]
     out = []
     if format == "csv":
+        coercers = _make_coercers(schema, list(column_names), _coerce_one)
         reader = _csv.DictReader(io.StringIO(data.decode("utf-8", errors="replace")))
         for i, row in enumerate(reader):
-            vals = tuple(_coerce(row.get(n), schema, n) for n in column_names)
+            if coercers is not None:
+                vals = tuple(fn(row.get(n)) for n, fn in coercers)
+            else:
+                vals = tuple(row.get(n) for n in column_names)
             out.append(((opath, i), vals))
         return out
     if format in ("json", "jsonlines"):
+        coercers = _make_coercers(schema, list(column_names), _coerce_json_one)
         for i, line in enumerate(data.decode("utf-8", errors="replace").splitlines()):
             line = line.strip()
             if not line:
                 continue
             obj = _json.loads(line)
-            vals = tuple(
-                _coerce_json(obj.get(n), schema, n) for n in column_names
-            )
+            if coercers is not None:
+                vals = tuple(fn(obj.get(n)) for n, fn in coercers)
+            else:
+                vals = tuple(obj.get(n) for n in column_names)
             out.append(((opath, i), vals))
         return out
     raise ValueError(f"unknown format {format!r}")
