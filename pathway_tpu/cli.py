@@ -24,6 +24,18 @@ def _spawn(args, extra: list[str]) -> int:
     env_base["PATHWAY_PROCESSES"] = str(n)
     env_base["PATHWAY_THREADS"] = str(args.threads)
     env_base["PATHWAY_FIRST_PORT"] = str(args.first_port)
+    # -t T workers = T engine key-shards over the device mesh (reference:
+    # PATHWAY_THREADS timely workers per process, config.rs:88-121; here
+    # engine/sharded.py execs). The XLA flag only widens the host-CPU
+    # fallback pool — on a TPU host make_mesh picks the real chips.
+    if args.threads > 1:
+        env_base["PATHWAY_ENGINE_SHARDS"] = str(args.threads)
+        flags = env_base.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env_base["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.threads}"
+            ).strip()
     if args.record:
         env_base["PATHWAY_REPLAY_STORAGE"] = args.record_path
         env_base["PATHWAY_SNAPSHOT_ACCESS"] = "record"

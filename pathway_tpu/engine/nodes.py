@@ -291,6 +291,13 @@ class GroupByNode(Node):
         self.sort_by = sort_by
 
     def make_exec(self):
+        from pathway_tpu.parallel.mesh import get_engine_mesh
+
+        em = get_engine_mesh()
+        if em is not None:
+            from pathway_tpu.engine.sharded import ShardedGroupByExec
+
+            return ShardedGroupByExec(self, em[0], em[1])
         return GroupByExec(self)
 
 
@@ -507,6 +514,13 @@ class JoinNode(Node):
         self.id_from = id_from
 
     def make_exec(self):
+        from pathway_tpu.parallel.mesh import get_engine_mesh
+
+        em = get_engine_mesh()
+        if em is not None:
+            from pathway_tpu.engine.sharded import ShardedJoinExec
+
+            return ShardedJoinExec(self, em[0], em[1])
         return JoinExec(self)
 
 

@@ -196,6 +196,15 @@ class Runtime:
         self.stats = RuntimeStats()
         has_consumer = {inp.id for node in self.order for inp in node.inputs}
         self._sinks = [n for n in self.order if n.id not in has_consumer]
+        # engine-level mesh sharding: per-tick frontier consensus rides a
+        # tiny device all-reduce (reference: timely progress broadcast,
+        # SURVEY §5.8 — "frontier consensus → tiny all-reduce")
+        from pathway_tpu.parallel.mesh import get_engine_mesh
+
+        self.engine_mesh = get_engine_mesh()
+        self.global_frontier = 0
+        self.frontier_syncs = 0
+        self._frontier_base: int | None = None
 
     # --- core tick ------------------------------------------------------------
 
@@ -238,6 +247,8 @@ class Runtime:
         stats.current_time = t if not final else stats.current_time
         stats.last_tick_ns = _time.perf_counter_ns() - tick_start
         self._tick_count += 1
+        if self.engine_mesh is not None and not final:
+            self.global_frontier = self._frontier_consensus(t)
         if self.on_tick is not None:
             self.on_tick(t)
 
@@ -319,6 +330,28 @@ class Runtime:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+
+    def _frontier_consensus(self, t: int) -> int:
+        """min-all-reduce of the local clock across engine shards. Times are
+        wall-clock ms (> int32), so the collective carries the offset from
+        the first tick (x64 stays disabled)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pathway_tpu.parallel.collectives import frontier_allreduce
+
+        mesh, axis = self.engine_mesh
+        if self._frontier_base is None:
+            self._frontier_base = t
+        rel = t - self._frontier_base
+        n = mesh.shape[axis]
+        local = jax.device_put(
+            jnp.full((n,), rel, jnp.int32), NamedSharding(mesh, P(axis))
+        )
+        ft = frontier_allreduce(local, mesh, axis)
+        self.frontier_syncs += 1
+        return int(np.asarray(ft)[0]) + self._frontier_base
 
     @staticmethod
     def _now_ms() -> int:

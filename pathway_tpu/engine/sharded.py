@@ -1,0 +1,222 @@
+"""Key-sharded execution of stateful engine operators over a device mesh.
+
+This is the engine-level data parallelism of the reference — every worker
+owns the slice of keys whose shard bits map to it, and an Exchange moves each
+record to its owner before stateful work (reference:
+src/engine/value.rs:38,94 `ShardPolicy`/SHARD_MASK,
+src/engine/dataflow/operators.rs:128,432 Exchange pact,
+src/engine/dataflow/config.rs:63-121 worker topology). Here the workers are
+mesh shards: each stateful exec is split into n_shards sub-execs with
+disjoint keyed state, and rows are routed by the low 16 bits of their group
+key. Numeric rows travel through a real `lax.all_to_all` over ICI
+(parallel/exchange.py); host-only payloads (strings/json) take the
+equivalent host partition path (multi-host deployments would move these over
+DCN — SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import GroupByExec, JoinExec, NodeExec
+
+# Minimum rows per batch before the device all-to-all path is worth the
+# dispatch overhead; tests lower this to force the collective.
+DEVICE_EXCHANGE_MIN_ROWS = 512
+
+SHARD_MASK = 0xFFFF  # low 16 bits route the row (reference value.rs:38)
+
+
+def shard_of(gks: np.ndarray, n_shards: int) -> np.ndarray:
+    return ((gks.astype(np.uint64) & np.uint64(SHARD_MASK)) % np.uint64(
+        n_shards
+    )).astype(np.int32)
+
+
+def _batch_numeric_columns(
+    b: DiffBatch,
+) -> list[tuple[np.ndarray, np.dtype]] | None:
+    """(typed view, ORIGINAL dtype) of every value column, or None if any
+    column holds non-numeric payloads (strings/json/tuples stay host-side).
+    The original dtype lets the receiver restore the exact representation
+    the host-partition path would have kept, so both paths feed identical
+    columns downstream."""
+    from pathway_tpu.parallel.exchange import packable
+
+    out: list[tuple[np.ndarray, np.dtype]] = []
+    for col in b.columns.values():
+        orig = col.dtype
+        arr = col
+        if arr.dtype == object:
+            if not len(arr):
+                return None
+            # type-homogeneous python scalars only: a mixed int/float
+            # column would come back type-changed after the round trip
+            # and hash to different group keys than the host path
+            t0 = type(arr[0])
+            if t0 not in (int, float, bool) or not all(
+                type(v) is t0 for v in arr
+            ):
+                return None
+            try:
+                arr = np.asarray(arr.tolist())
+            except (TypeError, ValueError, OverflowError):
+                return None
+        if arr.dtype.kind == "f" and arr.dtype.itemsize < 4:
+            arr = arr.astype(np.float32)
+        if arr.dtype.kind in "iu" and arr.dtype.itemsize < 8:
+            arr = arr.astype(np.int64)
+        if not packable(arr):
+            return None
+        out.append((arr, orig))
+    return out
+
+
+class _ShardRouter:
+    """Shared routing logic: split each incoming batch into per-shard
+    sub-batches, over the device mesh when rows are numeric."""
+
+    def __init__(self, mesh: Any, axis: str):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = mesh.shape[axis]
+        self.device_exchanges = 0  # observability: collectives actually run
+
+    def route(
+        self, b: DiffBatch, dest: np.ndarray
+    ) -> list[DiffBatch | None]:
+        """Returns one sub-batch per shard (None where empty)."""
+        numeric = (
+            _batch_numeric_columns(b)
+            if len(b) >= DEVICE_EXCHANGE_MIN_ROWS
+            else None
+        )
+        if numeric is not None:
+            return self._route_device(b, dest, numeric)
+        return self._route_host(b, dest)
+
+    def _route_host(self, b, dest):
+        out: list[DiffBatch | None] = [None] * self.n_shards
+        for s in range(self.n_shards):
+            m = dest == s
+            if m.any():
+                out[s] = b.mask(m)
+        return out
+
+    def _route_device(self, b, dest, numeric_cols):
+        from pathway_tpu.parallel.exchange import exchange_rows
+
+        self.device_exchanges += 1
+        arrays = [b.keys, b.diffs] + [a for a, _orig in numeric_cols]
+        blocks = exchange_rows(arrays, dest, self.mesh, self.axis)
+        names = b.column_names
+        origs = [orig for _a, orig in numeric_cols]
+        out: list[DiffBatch | None] = [None] * self.n_shards
+        for s, cols in enumerate(blocks):
+            if not len(cols[0]):
+                continue
+            columns = {
+                # restore each column to its pre-exchange representation
+                # (object columns back to native python scalars, typed
+                # columns back to their original dtype) so sharded results
+                # are identical to the host-partition and unsharded paths
+                name: arr.astype(orig)
+                for name, arr, orig in zip(names, cols[2:], origs)
+            }
+            out[s] = DiffBatch(cols[0], cols[1], columns)
+        return out
+
+
+class ShardedGroupByExec(NodeExec):
+    """groupby-reduce with per-shard disjoint state: rows are exchanged to
+    the shard owning their group key, each shard reduces independently
+    (reference: group_by_table reindex-to-grouping-key + Exchange,
+    src/engine/dataflow.rs:3404)."""
+
+    def __init__(self, node, mesh: Any, axis: str = "data"):
+        super().__init__(node)
+        self.router = _ShardRouter(mesh, axis)
+        self.shards = [GroupByExec(node) for _ in range(self.router.n_shards)]
+
+    def _dests(self, b: DiffBatch) -> np.ndarray:
+        ex = self.shards[0]
+        simple = not self.node.set_id and ex.inst_idx is None
+        if simple:
+            gks = np.asarray(ex._group_keys_batch(b), dtype=np.uint64)
+        else:
+            cols = list(b.columns.values())
+            gks = np.fromiter(
+                (
+                    ex._group_key(tuple(c[i] for c in cols))
+                    & 0xFFFFFFFFFFFFFFFF
+                    for i in range(len(b))
+                ),
+                dtype=np.uint64,
+                count=len(b),
+            )
+        return shard_of(gks, self.router.n_shards)
+
+    def process(self, t, inputs):
+        parts: list[list[DiffBatch]] = [[] for _ in self.shards]
+        for b in inputs[0]:
+            if not len(b):
+                continue
+            for s, sub in enumerate(self.router.route(b, self._dests(b))):
+                if sub is not None:
+                    parts[s].append(sub)
+        out: list[DiffBatch] = []
+        for ex, sub_batches in zip(self.shards, parts):
+            if sub_batches:
+                out.extend(ex.process(t, [sub_batches]))
+        return out
+
+    def shard_group_keys(self) -> list[set[int]]:
+        """Per-shard owned group keys — disjoint by construction (used by
+        tests and the state snapshotter)."""
+        return [set(ex.groups.keys()) for ex in self.shards]
+
+
+class ShardedJoinExec(NodeExec):
+    """Equijoin with per-shard disjoint state: both sides exchange on the
+    join-key hash so matching rows co-locate (reference: join_tables
+    arrange+join_core after Exchange, src/engine/dataflow.rs:2740,2834)."""
+
+    def __init__(self, node, mesh: Any, axis: str = "data"):
+        super().__init__(node)
+        self.router = _ShardRouter(mesh, axis)
+        self.shards = [JoinExec(node) for _ in range(self.router.n_shards)]
+
+    def _dests(self, b: DiffBatch, on_cols: Sequence[str]) -> np.ndarray:
+        from pathway_tpu.internals.api import ref_scalars_columns
+
+        cols = [b.columns[c] for c in on_cols]
+        jks = np.asarray(
+            ref_scalars_columns(cols, len(b)), dtype=np.uint64
+        )
+        return shard_of(jks, self.router.n_shards)
+
+    def process(self, t, inputs):
+        lparts: list[list[DiffBatch]] = [[] for _ in self.shards]
+        rparts: list[list[DiffBatch]] = [[] for _ in self.shards]
+        for b in inputs[0]:
+            if len(b):
+                for s, sub in enumerate(
+                    self.router.route(b, self._dests(b, self.node.left_on))
+                ):
+                    if sub is not None:
+                        lparts[s].append(sub)
+        for b in inputs[1]:
+            if len(b):
+                for s, sub in enumerate(
+                    self.router.route(b, self._dests(b, self.node.right_on))
+                ):
+                    if sub is not None:
+                        rparts[s].append(sub)
+        out: list[DiffBatch] = []
+        for ex, lsub, rsub in zip(self.shards, lparts, rparts):
+            if lsub or rsub:
+                out.extend(ex.process(t, [lsub, rsub]))
+        return out

@@ -24,42 +24,42 @@ def replicated(mesh: Any) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def _exchange_impl(values, dest_shard, mesh, axis):
+def exchange_by_shard(values, dest_shard, mesh, axis: str = "data"):
     """Route rows to the mesh shard given per-row in `dest_shard`
-    (the Exchange pact: key.shard() % n_workers,
-    reference src/engine/dataflow/operators.rs:128). Dense formulation:
-    every device masks + all-gathers, then keeps its rows — exact semantics
-    of a ragged all-to-all with static shapes (XLA optimizes the gather
-    over ICI)."""
-    from jax import shard_map
+    (the Exchange pact: key.shard() % n_workers, reference
+    src/engine/dataflow/operators.rs:128) through a real ragged
+    `lax.all_to_all` (parallel/exchange.py) — per-device memory is
+    O(n_shards × bucket), not O(total rows) like the round-1
+    all-gather+mask placeholder.
+
+    Returns (per_shard_values, per_shard_counts): a [n_shards, cap, d]
+    array whose block s holds the rows shard s received, and the valid row
+    count per block."""
+    import numpy as np
+
+    from pathway_tpu.parallel.exchange import ragged_all_to_all
 
     n_shards = mesh.shape[axis]
-
-    def local(vals, dest):
-        # vals: [n_local, d]; dest: [n_local]
-        me = jax.lax.axis_index(axis)
-        all_vals = jax.lax.all_gather(vals, axis, axis=0, tiled=True)
-        all_dest = jax.lax.all_gather(dest, axis, axis=0, tiled=True)
-        keep = all_dest == me
-        # static shape: every device holds the full set, masked rows zeroed
-        out = jnp.where(keep[:, None], all_vals, 0)
-        return out, keep
-
-    return shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis, None), P(axis)),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )(values, dest_shard)
-
-
-def exchange_by_shard(values, dest_shard, mesh, axis: str = "data"):
-    """All-to-all exchange of rows by destination shard id. Returns
-    (gathered_values, keep_mask) replicated per device — each shard's rows
-    are the masked subset."""
-    return _exchange_impl(values, dest_shard, mesh, axis)
+    vals = np.ascontiguousarray(values)
+    if vals.dtype.itemsize % 4:
+        raise TypeError(
+            f"exchange_by_shard needs a 4/8-byte element dtype, got "
+            f"{vals.dtype}"
+        )
+    d = vals.shape[1]
+    # rows travel as exact int32 bit patterns — no value cast for any dtype
+    words = vals.view(np.int32).reshape(vals.shape[0], -1)
+    blocks = ragged_all_to_all(
+        words, np.asarray(dest_shard, dtype=np.int32), mesh, axis
+    )
+    cap = max((len(b) for b in blocks), default=0)
+    out = np.zeros((n_shards, cap, d), dtype=vals.dtype)
+    counts = np.zeros(n_shards, dtype=np.int64)
+    for s, b in enumerate(blocks):
+        counts[s] = len(b)
+        if len(b):
+            out[s, : len(b)] = b.view(vals.dtype).reshape(len(b), d)
+    return out, counts
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))

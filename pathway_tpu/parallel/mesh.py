@@ -67,3 +67,45 @@ def set_default_mesh(mesh: Any) -> None:
 
 def get_mesh() -> Any:
     return _default_mesh
+
+
+# --- engine mesh ------------------------------------------------------------
+# When set, STATEFUL ENGINE OPERATORS themselves shard over the mesh (per-
+# shard keyed state + all-to-all exchange, engine/sharded.py) — the analog of
+# the reference's PATHWAY_THREADS worker count (config.rs:88-121). Activated
+# explicitly via set_engine_mesh() or by the PATHWAY_ENGINE_SHARDS env var
+# (which `pathway spawn -n N` sets instead of forking redundant processes).
+
+_engine_mesh: Any = None
+_engine_mesh_resolved = False
+
+
+def set_engine_mesh(mesh: Any, axis: str = "data") -> None:
+    """Enable (or disable with mesh=None) engine-level key sharding."""
+    global _engine_mesh, _engine_mesh_resolved
+    _engine_mesh = (mesh, axis) if mesh is not None else None
+    _engine_mesh_resolved = True
+
+
+def get_engine_mesh() -> tuple[Any, str] | None:
+    global _engine_mesh, _engine_mesh_resolved
+    if not _engine_mesh_resolved:
+        _engine_mesh_resolved = True
+        n = os.environ.get("PATHWAY_ENGINE_SHARDS", "")
+        if n.isdigit() and int(n) > 1:
+            try:
+                _engine_mesh = (make_mesh(int(n)), "data")
+            except (ValueError, RuntimeError) as exc:
+                # not enough devices on this host (e.g. the launcher didn't
+                # set xla_force_host_platform_device_count) — run unsharded
+                # rather than crash the pipeline at graph build
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "PATHWAY_ENGINE_SHARDS=%s but no %s-device mesh is "
+                    "available (%s); engine sharding disabled",
+                    n,
+                    n,
+                    exc,
+                )
+    return _engine_mesh

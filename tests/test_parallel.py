@@ -34,19 +34,50 @@ def test_sharded_topk_matches_dense():
 
 
 def test_exchange_by_shard():
-    import jax
-
     from pathway_tpu.parallel.collectives import exchange_by_shard
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = _mesh(8)
     vals = np.arange(32, dtype=np.float32).reshape(16, 2)
     dest = (np.arange(16) % 8).astype(np.int32)
-    v = jax.device_put(vals, NamedSharding(mesh, P("data", None)))
-    d = jax.device_put(dest, NamedSharding(mesh, P("data")))
-    gathered, keep = exchange_by_shard(v, d, mesh)
-    # with replicated output, each row's keep-mask marks its destination
-    assert np.asarray(keep).shape == (16,)
+    blocks, counts = exchange_by_shard(vals, dest, mesh)
+    assert counts.sum() == 16
+    for s in range(8):
+        rows = blocks[s, : counts[s]]
+        # each shard received exactly the rows addressed to it
+        expect = vals[dest == s]
+        assert sorted(map(tuple, rows)) == sorted(map(tuple, expect))
+
+
+def test_ragged_all_to_all_exact():
+    """Typed columns survive the exchange bit-for-bit and land on the
+    right shard (u64 keys, f64 values, i64 diffs)."""
+    from pathway_tpu.parallel.exchange import (
+        exchange_rows,
+        pack_columns,
+        unpack_columns,
+    )
+
+    mesh = _mesh(8)
+    rng = np.random.default_rng(0)
+    n = 1000
+    keys = rng.integers(0, 2**63, size=n).astype(np.uint64)
+    vals = rng.normal(size=n)
+    diffs = rng.choice([-1, 1], size=n).astype(np.int64)
+    dest = (keys % 8).astype(np.int32)
+
+    w, spec = pack_columns([keys, vals, diffs])
+    k2, v2, d2 = unpack_columns(w, spec)
+    assert (k2 == keys).all() and (v2 == vals).all() and (d2 == diffs).all()
+
+    blocks = exchange_rows([keys, vals, diffs], dest, mesh)
+    got = {}
+    for s, (bk, bv, bd) in enumerate(blocks):
+        assert ((bk % 8) == s).all(), f"shard {s} received foreign rows"
+        for k, v, d in zip(bk, bv, bd):
+            got[int(k)] = (float(v), int(d))
+    assert len(got) == len(set(keys.tolist()))
+    for k, v, d in zip(keys, vals, diffs):
+        assert got[int(k)] == (float(v), int(d))
 
 
 def test_sharded_knn_index():
@@ -67,3 +98,182 @@ def test_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level sharding: per-shard state + device exchange
+
+
+def _with_engine_mesh(n=8):
+    from pathway_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.set_engine_mesh(_mesh(n))
+    return mesh_mod
+
+
+def test_sharded_groupby_matches_single_shard():
+    """Same pipeline, sharded vs unsharded engine: identical results, and
+    each shard's keyed state is disjoint (the Exchange invariant)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.sharded import ShardedGroupByExec
+    from pathway_tpu.internals import parse_graph
+    from pathway_tpu.parallel import mesh as mesh_mod
+
+    class S(pw.Schema):
+        word: str
+        v: int
+
+    rows = [(f"w{i % 17}", i % 5) for i in range(300)]
+
+    def build_and_run():
+        t = pw.debug.table_from_rows(S, rows)
+        res = t.groupby(t.word).reduce(
+            t.word, s=pw.reducers.sum(t.v), c=pw.reducers.count()
+        )
+        return pw.debug.table_to_dicts(res)
+
+    keys0, cols0 = build_and_run()
+    try:
+        _with_engine_mesh(8)
+        keys1, cols1 = build_and_run()
+        rt = parse_graph.G.last_runtime
+        sharded_execs = [
+            ex
+            for ex in rt.execs.values()
+            if isinstance(ex, ShardedGroupByExec)
+        ]
+        assert sharded_execs, "engine mesh set but groupby did not shard"
+        owned = sharded_execs[0].shard_group_keys()
+        assert sum(len(s) for s in owned) == 17
+        for i in range(len(owned)):
+            for j in range(i + 1, len(owned)):
+                assert not (owned[i] & owned[j]), "shard state overlaps"
+        assert rt.frontier_syncs > 0  # frontier all-reduce ran per tick
+    finally:
+        mesh_mod.set_engine_mesh(None)
+    assert sorted(keys0) == sorted(keys1)
+    assert cols0 == cols1
+
+
+def test_sharded_groupby_device_exchange_path():
+    """Numeric rows travel through the real device all-to-all."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine import sharded
+    from pathway_tpu.engine.sharded import ShardedGroupByExec
+    from pathway_tpu.internals import parse_graph
+    from pathway_tpu.parallel import mesh as mesh_mod
+
+    class S(pw.Schema):
+        g: int
+        v: float
+
+    rows = [(i % 13, float(i) / 7.0) for i in range(600)]
+
+    def build_and_run():
+        t = pw.debug.table_from_rows(S, rows)
+        res = t.groupby(t.g).reduce(
+            t.g, s=pw.reducers.sum(t.v), c=pw.reducers.count()
+        )
+        return pw.debug.table_to_dicts(res)
+
+    keys0, cols0 = build_and_run()
+    old_min = sharded.DEVICE_EXCHANGE_MIN_ROWS
+    try:
+        sharded.DEVICE_EXCHANGE_MIN_ROWS = 1
+        _with_engine_mesh(8)
+        keys1, cols1 = build_and_run()
+        rt = parse_graph.G.last_runtime
+        ex = next(
+            e for e in rt.execs.values() if isinstance(e, ShardedGroupByExec)
+        )
+        assert ex.router.device_exchanges >= 1, (
+            "numeric groupby never used the device all-to-all"
+        )
+    finally:
+        sharded.DEVICE_EXCHANGE_MIN_ROWS = old_min
+        mesh_mod.set_engine_mesh(None)
+    assert sorted(keys0) == sorted(keys1)
+    assert cols0 == cols1
+
+
+def test_sharded_join_matches_single_shard():
+    import pathway_tpu as pw
+    from pathway_tpu.engine.sharded import ShardedJoinExec
+    from pathway_tpu.internals import parse_graph
+    from pathway_tpu.parallel import mesh as mesh_mod
+
+    class L(pw.Schema):
+        k: str
+        a: int
+
+    class R(pw.Schema):
+        k: str
+        b: int
+
+    lrows = [(f"k{i % 11}", i) for i in range(80)]
+    rrows = [(f"k{i % 7}", i * 10) for i in range(40)]
+
+    def build_and_run():
+        lt = pw.debug.table_from_rows(L, lrows)
+        rt_ = pw.debug.table_from_rows(R, rrows)
+        j = lt.join(rt_, lt.k == rt_.k).select(
+            lt.k, pw.left.a, pw.right.b
+        )
+        return pw.debug.table_to_dicts(j)
+
+    keys0, cols0 = build_and_run()
+    try:
+        _with_engine_mesh(8)
+        keys1, cols1 = build_and_run()
+        rt = parse_graph.G.last_runtime
+        assert any(
+            isinstance(e, ShardedJoinExec) for e in rt.execs.values()
+        ), "engine mesh set but join did not shard"
+    finally:
+        mesh_mod.set_engine_mesh(None)
+    assert sorted(keys0) == sorted(keys1)
+    assert cols0 == cols1
+
+
+def test_cli_spawn_sets_engine_shards(tmp_path):
+    """`pathway-tpu spawn -t N prog` runs the program with an N-shard
+    engine mesh instead of redundant copies (reference: PATHWAY_THREADS
+    workers, src/engine/dataflow/config.rs:88-121)."""
+    import subprocess
+    import sys
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from pathway_tpu.parallel.mesh import get_engine_mesh\n"
+        "em = get_engine_mesh()\n"
+        "assert em is not None, 'engine mesh not configured'\n"
+        "print('shards:', em[0].shape['data'])\n"
+    )
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "spawn",
+            "-t",
+            "4",
+            "--",
+            sys.executable,
+            str(prog),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={
+            **{
+                k: v
+                for k, v in __import__("os").environ.items()
+                if k not in ("XLA_FLAGS", "PATHWAY_ENGINE_SHARDS")
+            },
+            "PYTHONPATH": "/root/repo",
+        },
+    )
+    assert out.returncode == 0, out.stderr
+    assert "shards: 4" in out.stdout
