@@ -88,21 +88,38 @@ class EncoderRuntime:
         mesh: Any = None,
         axis: str = "data",
         cross_encoder: bool = False,
+        model_path: str | None = None,
+        param_dtype: Any = None,
     ):
         self.max_len = max_len
-        enc = TransformerEncoder(
-            vocab_size=vocab_size,
-            dim=dim,
-            depth=depth,
-            heads=heads,
-            max_len=max_len,
-        )
-        self.model: Any = CrossEncoderHead(enc) if cross_encoder else enc
-        self.dim = dim
-        rng = jax.random.PRNGKey(seed)
-        ids0 = jnp.zeros((1, 16), jnp.int32)
-        mask0 = jnp.ones((1, 16), jnp.float32)
-        self.params = self.model.init(rng, ids0, mask0)
+        self.pretrained = False
+        if model_path is not None and not cross_encoder:
+            # pretrained BERT/MiniLM checkpoint: exact post-LN architecture
+            # + safetensors weights (_bert.py); replaces the random-init
+            # trunk entirely
+            from pathway_tpu.xpacks.llm._bert import load_bert_checkpoint
+
+            self.model, self.params = load_bert_checkpoint(
+                model_path,
+                dtype=param_dtype if param_dtype is not None else jnp.float32,
+            )
+            self.dim = self.model.dim
+            self.max_len = min(max_len, self.model.max_len)
+            self.pretrained = True
+        else:
+            enc = TransformerEncoder(
+                vocab_size=vocab_size,
+                dim=dim,
+                depth=depth,
+                heads=heads,
+                max_len=max_len,
+            )
+            self.model = CrossEncoderHead(enc) if cross_encoder else enc
+            self.dim = dim
+            rng = jax.random.PRNGKey(seed)
+            ids0 = jnp.zeros((1, 16), jnp.int32)
+            mask0 = jnp.ones((1, 16), jnp.float32)
+            self.params = self.model.init(rng, ids0, mask0)
         self.mesh = mesh
         self.axis = axis
         if mesh is not None:

@@ -67,6 +67,153 @@ def _bucket_len(n: int, max_len: int) -> int:
     return min(b, max_len)
 
 
+class WordPieceTokenizer:
+    """Real WordPiece over a local vocab.txt — the tokenization BERT/MiniLM
+    checkpoints were trained with (reference embedders tokenize via the HF
+    tokenizer inside sentence-transformers; this is the dependency-free
+    equivalent, verified token-for-token against BertTokenizer in
+    tests/test_bert_parity.py). Basic-tokenizer steps: clean, lowercase +
+    strip accents (uncased models), CJK isolation, punctuation split; then
+    greedy longest-match-first wordpiece with '##' continuations."""
+
+    def __init__(
+        self,
+        vocab_file: str,
+        lowercase: bool = True,
+        max_word_chars: int = 100,
+    ):
+        import unicodedata
+
+        self._ud = unicodedata
+        self.vocab: dict[str, int] = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                self.vocab[line.rstrip("\n")] = i
+        self.vocab_size = len(self.vocab)
+        self.lowercase = lowercase
+        self.max_word_chars = max_word_chars
+        self.pad_id = self.vocab.get("[PAD]", 0)
+        self.unk_id = self.vocab.get("[UNK]", 1)
+        self.cls_id = self.vocab.get("[CLS]", 2)
+        self.sep_id = self.vocab.get("[SEP]", 3)
+
+    # --- basic tokenization (mirrors BERT's BasicTokenizer) ---------------
+
+    def _is_punct(self, ch: str) -> bool:
+        cp = ord(ch)
+        if (
+            33 <= cp <= 47
+            or 58 <= cp <= 64
+            or 91 <= cp <= 96
+            or 123 <= cp <= 126
+        ):
+            return True
+        return self._ud.category(ch).startswith("P")
+
+    def _is_cjk(self, ch: str) -> bool:
+        cp = ord(ch)
+        return (
+            0x4E00 <= cp <= 0x9FFF
+            or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF
+            or 0xF900 <= cp <= 0xFAFF
+        )
+
+    def _basic_tokens(self, text: str) -> list[str]:
+        out: list[str] = []
+        buf: list[str] = []
+
+        def flush():
+            if buf:
+                out.append("".join(buf))
+                buf.clear()
+
+        for ch in text:
+            cp = ord(ch)
+            # exact BertTokenizer rules: \t\n\r are whitespace (NOT
+            # controls, despite their Cc category); all other C* are
+            # stripped; Zs is the only other whitespace class
+            if ch in " \t\n\r":
+                flush()
+                continue
+            if cp == 0 or cp == 0xFFFD or self._ud.category(ch).startswith(
+                "C"
+            ):
+                continue
+            if self._ud.category(ch) == "Zs":
+                flush()
+                continue
+            if self._is_cjk(ch) or self._is_punct(ch):
+                flush()
+                out.append(ch)
+                continue
+            buf.append(ch)
+        flush()
+        if self.lowercase:
+            lowered = []
+            for tok in out:
+                tok = tok.lower()
+                tok = "".join(
+                    c
+                    for c in self._ud.normalize("NFD", tok)
+                    if self._ud.category(c) != "Mn"
+                )
+                if tok:
+                    lowered.append(tok)
+            out = lowered
+        return out
+
+    # --- wordpiece ---------------------------------------------------------
+
+    def _wordpiece(self, word: str) -> list[int]:
+        if len(word) > self.max_word_chars:
+            return [self.unk_id]
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str, max_len: int) -> list[int]:
+        ids = [self.cls_id]
+        for word in self._basic_tokens(text):
+            ids.extend(self._wordpiece(word))
+            if len(ids) >= max_len - 1:
+                break
+        ids = ids[: max_len - 1]
+        ids.append(self.sep_id)
+        return ids
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        encoded = [self.encode(t, max_len) for t in texts]
+        longest = max((len(e) for e in encoded), default=1)
+        bucket = _bucket_len(longest, max_len)
+        ids = np.full((len(texts), bucket), self.pad_id, dtype=np.int32)
+        mask = np.zeros((len(texts), bucket), dtype=np.float32)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = 1.0
+        return ids, mask
+
+    def count_tokens(self, text: str) -> int:
+        return len(self.encode(text, 1 << 30)) - 2
+
+
 class HFTokenizerAdapter:
     """Wraps a locally-cached HuggingFace tokenizer (no downloads)."""
 

@@ -54,18 +54,65 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         batch_size: int = 1024,
         **init_kwargs,
     ):
+        import os
+
+        from pathway_tpu.xpacks.llm._bert import _find_model_dir
         from pathway_tpu.xpacks.llm._encoder import EncoderRuntime
         from pathway_tpu.xpacks.llm._tokenizer import (
             HashingTokenizer,
             HFTokenizerAdapter,
+            WordPieceTokenizer,
         )
 
-        try:
-            self.tokenizer: Any = HFTokenizerAdapter(model)
+        # resolve a pretrained checkpoint: local dir or HF cache; the
+        # random-init flax trunk + hashing tokenizer remain the offline
+        # fallback (reference loads sentence-transformers checkpoints,
+        # embedders.py:270)
+        model_dir = _find_model_dir(model)
+        model_path = None
+        if model_dir is not None and os.path.exists(
+            os.path.join(model_dir, "model.safetensors")
+        ):
+            model_path = model_dir
+        self.tokenizer: Any
+        vocab_txt = (
+            os.path.join(model_dir, "vocab.txt") if model_dir else None
+        )
+        if vocab_txt and os.path.exists(vocab_txt):
+            lowercase = True
+            tok_cfg = os.path.join(model_dir, "tokenizer_config.json")
+            if os.path.exists(tok_cfg):
+                import json
+
+                with open(tok_cfg) as f:
+                    lowercase = bool(
+                        json.load(f).get("do_lower_case", True)
+                    )
+            self.tokenizer = WordPieceTokenizer(
+                vocab_txt, lowercase=lowercase
+            )
             vocab_size = self.tokenizer.vocab_size
-        except Exception:
-            self.tokenizer = HashingTokenizer()
-            vocab_size = self.tokenizer.vocab_size
+        else:
+            try:
+                self.tokenizer = HFTokenizerAdapter(model)
+                vocab_size = self.tokenizer.vocab_size
+            except Exception:
+                self.tokenizer = HashingTokenizer()
+                vocab_size = self.tokenizer.vocab_size
+        if model_path is not None and isinstance(
+            self.tokenizer, HashingTokenizer
+        ):
+            # hash-bucket ids are unrelated to the checkpoint's vocabulary
+            # — pretrained weights would emit noise; use the random trunk
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "checkpoint %s has weights but no usable tokenizer "
+                "(vocab.txt missing); falling back to the random-init "
+                "encoder",
+                model,
+            )
+            model_path = None
         self.runtime = EncoderRuntime(
             vocab_size=vocab_size,
             dim=dim,
@@ -73,13 +120,16 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             heads=heads,
             max_len=max_len,
             mesh=mesh,
+            model_path=model_path,
         )
         self.model = model
         self.kwargs = call_kwargs
 
         def embed_batch(texts: Sequence[str]) -> list[np.ndarray]:
             ids, mask = self.tokenizer.encode_batch(
-                [str(t) for t in texts], max_len
+                # runtime.max_len is clamped to the checkpoint's position
+                # table; exceeding it would silently clamp position ids
+                [str(t) for t in texts], self.runtime.max_len
             )
             out = self.runtime.forward_ids(ids, mask)
             return [out[i] for i in range(len(texts))]
