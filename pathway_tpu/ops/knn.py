@@ -222,7 +222,18 @@ class DeviceCorpus:
     ):
         self.valid_sharding = valid_sharding
         self.dim = dim
-        self.capacity = max(1024, capacity)
+        # align capacity to lcm(1024, n_shards): multiple of 1024 so the
+        # Pallas block kernel (ops/pallas_topk.py, BLK=1024) is always
+        # applicable, AND divisible by the mesh shard count so sharded_topk
+        # can split rows evenly; padding is masked by `valid`
+        align = 1024
+        if sharding is not None:
+            import math
+
+            n_dev = int(np.prod(list(sharding.mesh.shape.values())))
+            align = math.lcm(1024, max(1, n_dev))
+        self._align = align
+        self.capacity = -(-max(1024, capacity) // align) * align
         self.host = np.zeros((self.capacity, dim), dtype=np.float32)
         self.valid_host = np.zeros(self.capacity, dtype=bool)
         self.free: list[int] = list(range(self.capacity - 1, -1, -1))

@@ -96,15 +96,23 @@ def pallas_block_topk(
     return sc, ix
 
 
-@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("k", "metric", "interpret"))
 def pallas_dense_topk(
-    queries: jax.Array,
-    prep: jax.Array,
+    queries: jax.Array,  # [B, D] raw f32 queries
+    prep: jax.Array,  # [N, D] prepared corpus (normalized/cast)
     valid: jax.Array,
     k: int,
+    metric: str = "dot",  # dot | cosine
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """Exact dense top-k via the Pallas block kernel + lax.top_k merge."""
+    """Exact dense top-k via the Pallas block kernel + lax.top_k merge.
+    Owns the query-side metric handling (normalize + cast to the corpus
+    dtype) so every caller scores identically to dense_topk_prepared."""
+    if metric == "cosine":
+        queries = queries / (
+            jnp.linalg.norm(queries, axis=-1, keepdims=True) + 1e-30
+        )
+    queries = queries.astype(prep.dtype)
     sc, ix = pallas_block_topk(queries, prep, valid, k, interpret=interpret)
     b = sc.shape[0]
     sc_f = sc.reshape(b, -1)

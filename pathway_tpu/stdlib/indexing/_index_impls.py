@@ -37,6 +37,7 @@ class TpuDenseKnnIndex:
         reserved_space: int = 1024,
         mesh: Any = None,
         axis: str = "data",
+        kernel: str = "auto",
     ):
         self.dim = dimensions
         self.metric = metric
@@ -45,6 +46,17 @@ class TpuDenseKnnIndex:
         self.axis = axis
         self.corpus: DeviceCorpus | None = None
         self.metadata: dict[int, Any] = {}
+        # scoring kernel: "xla" = dense_topk_prepared; "pallas" = the fused
+        # Pallas block-top-k (ops/pallas_topk.py — only [B, nblk*k]
+        # candidates return to HBM instead of the [B, N] score matrix).
+        # "auto" follows PATHWAY_KNN_KERNEL, defaulting to xla.
+        if kernel == "auto":
+            import os
+
+            kernel = os.environ.get("PATHWAY_KNN_KERNEL", "xla")
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown KNN kernel {kernel!r}")
+        self.kernel = kernel
 
     def _ensure(self, dim: int) -> DeviceCorpus:
         if self.corpus is None:
@@ -101,9 +113,26 @@ class TpuDenseKnnIndex:
             from pathway_tpu.ops.knn import dense_topk_prepared
 
             prep, c2, valid = self.corpus.prepared_arrays(self.metric)
-            scores, idx = dense_topk_prepared(
-                qmat, prep, c2, valid, eff_k, metric=self.metric
-            )
+            scores = idx = None
+            if self.kernel == "pallas" and self.metric in ("cosine", "dot"):
+                from pathway_tpu.ops import pallas_topk as pt
+
+                if pt.supported(prep.shape[0], eff_k):
+                    import jax
+
+                    interpret = jax.devices()[0].platform == "cpu"
+                    scores, idx = pt.pallas_dense_topk(
+                        qmat,
+                        prep,
+                        valid,
+                        eff_k,
+                        metric=self.metric,
+                        interpret=interpret,
+                    )
+            if scores is None:
+                scores, idx = dense_topk_prepared(
+                    qmat, prep, c2, valid, eff_k, metric=self.metric
+                )
         scores = np.asarray(scores)
         idx = np.asarray(idx)
         out = []

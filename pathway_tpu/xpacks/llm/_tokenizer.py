@@ -96,6 +96,11 @@ class WordPieceTokenizer:
         self.unk_id = self.vocab.get("[UNK]", 1)
         self.cls_id = self.vocab.get("[CLS]", 2)
         self.sep_id = self.vocab.get("[SEP]", 3)
+        # BertTokenizer's never_split set: literal special tokens in the
+        # text pass through un-lowercased and un-split
+        self.special_tokens = {
+            "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+        }
 
     # --- basic tokenization (mirrors BERT's BasicTokenizer) ---------------
 
@@ -116,51 +121,59 @@ class WordPieceTokenizer:
             0x4E00 <= cp <= 0x9FFF
             or 0x3400 <= cp <= 0x4DBF
             or 0x20000 <= cp <= 0x2A6DF
+            or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F
+            or 0x2B820 <= cp <= 0x2CEAF
             or 0xF900 <= cp <= 0xFAFF
+            or 0x2F800 <= cp <= 0x2FA1F
         )
 
     def _basic_tokens(self, text: str) -> list[str]:
-        out: list[str] = []
-        buf: list[str] = []
-
-        def flush():
-            if buf:
-                out.append("".join(buf))
-                buf.clear()
-
+        # stage 1 — clean + CJK isolation (BertTokenizer._clean_text +
+        # _tokenize_chinese_chars): \t\n\r are whitespace (NOT controls,
+        # despite their Cc category); all other C* are stripped; Zs is the
+        # only other whitespace class
+        chars: list[str] = []
         for ch in text:
             cp = ord(ch)
-            # exact BertTokenizer rules: \t\n\r are whitespace (NOT
-            # controls, despite their Cc category); all other C* are
-            # stripped; Zs is the only other whitespace class
             if ch in " \t\n\r":
-                flush()
+                chars.append(" ")
                 continue
             if cp == 0 or cp == 0xFFFD or self._ud.category(ch).startswith(
                 "C"
             ):
                 continue
             if self._ud.category(ch) == "Zs":
-                flush()
+                chars.append(" ")
+            elif self._is_cjk(ch):
+                chars.extend((" ", ch, " "))
+            else:
+                chars.append(ch)
+        # stage 2 — whitespace split, then per token: never_split check,
+        # lowercase + accent strip, punctuation split
+        out: list[str] = []
+        for tok in "".join(chars).split():
+            if tok in self.special_tokens:
+                out.append(tok)
                 continue
-            if self._is_cjk(ch) or self._is_punct(ch):
-                flush()
-                out.append(ch)
-                continue
-            buf.append(ch)
-        flush()
-        if self.lowercase:
-            lowered = []
-            for tok in out:
+            if self.lowercase:
                 tok = tok.lower()
                 tok = "".join(
                     c
                     for c in self._ud.normalize("NFD", tok)
                     if self._ud.category(c) != "Mn"
                 )
-                if tok:
-                    lowered.append(tok)
-            out = lowered
+            buf: list[str] = []
+            for ch in tok:
+                if self._is_punct(ch):
+                    if buf:
+                        out.append("".join(buf))
+                        buf.clear()
+                    out.append(ch)
+                else:
+                    buf.append(ch)
+            if buf:
+                out.append("".join(buf))
         return out
 
     # --- wordpiece ---------------------------------------------------------

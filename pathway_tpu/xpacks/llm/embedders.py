@@ -74,11 +74,23 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             os.path.join(model_dir, "model.safetensors")
         ):
             model_path = model_dir
-        self.tokenizer: Any
+        # tokenizer priority: exact HF implementation when importable →
+        # our WordPiece (BertTokenizer-parity, dependency-free) → hashing
+        self.tokenizer: Any = None
+        for candidate in ([model_dir] if model_dir else []) + [model]:
+            try:
+                self.tokenizer = HFTokenizerAdapter(candidate)
+                break
+            except Exception:
+                pass
         vocab_txt = (
             os.path.join(model_dir, "vocab.txt") if model_dir else None
         )
-        if vocab_txt and os.path.exists(vocab_txt):
+        if (
+            self.tokenizer is None
+            and vocab_txt
+            and os.path.exists(vocab_txt)
+        ):
             lowercase = True
             tok_cfg = os.path.join(model_dir, "tokenizer_config.json")
             if os.path.exists(tok_cfg):
@@ -91,14 +103,9 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             self.tokenizer = WordPieceTokenizer(
                 vocab_txt, lowercase=lowercase
             )
-            vocab_size = self.tokenizer.vocab_size
-        else:
-            try:
-                self.tokenizer = HFTokenizerAdapter(model)
-                vocab_size = self.tokenizer.vocab_size
-            except Exception:
-                self.tokenizer = HashingTokenizer()
-                vocab_size = self.tokenizer.vocab_size
+        if self.tokenizer is None:
+            self.tokenizer = HashingTokenizer()
+        vocab_size = self.tokenizer.vocab_size
         if model_path is not None and isinstance(
             self.tokenizer, HashingTokenizer
         ):

@@ -101,6 +101,8 @@ def test_wordpiece_matches_bert_tokenizer(checkpoint):
         "the\tquick\r\nfox",
         "hello\x00world\x7f!",  # real controls ARE stripped
         "hello world",  # unicode thin space (Zs)
+        "hello [SEP] world [MASK]",  # literal special tokens pass through
+        "hello\u4e16\u754cworld",  # CJK chars isolate into own tokens
     ]
     for text in cases:
         expected = ref(text)["input_ids"]
@@ -114,9 +116,11 @@ def test_sentence_transformer_embedder_loads_checkpoint(checkpoint):
 
     emb = SentenceTransformerEmbedder(model=str(d))
     assert emb.runtime.pretrained
-    from pathway_tpu.xpacks.llm._tokenizer import WordPieceTokenizer
+    from pathway_tpu.xpacks.llm._tokenizer import HashingTokenizer
 
-    assert isinstance(emb.tokenizer, WordPieceTokenizer)
+    # a real vocab-backed tokenizer must be selected (HF adapter when
+    # transformers can load it, else our WordPiece) — never hashing
+    assert not isinstance(emb.tokenizer, HashingTokenizer)
     v = emb._embed_batch(["hello world", "the quick brown fox"])
     assert len(v) == 2 and v[0].shape == (32,)
     # deterministic: same text -> same embedding

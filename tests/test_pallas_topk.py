@@ -1,0 +1,79 @@
+"""Pallas KNN kernel: exactness vs the XLA scoring path (interpret mode on
+the CPU backend; the driver bench compares both compiled on TPU).
+Reference: src/external_integration/brute_force_knn_integration.rs:22."""
+
+import numpy as np
+import pytest
+
+
+def _random_corpus(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    corpus = rng.normal(size=(n, d)).astype(np.float32)
+    valid = np.ones(n, dtype=bool)
+    valid[n // 3] = False  # a deleted slot must never be returned
+    return corpus, valid
+
+
+def test_pallas_dense_topk_matches_xla():
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import pallas_topk as pt
+    from pathway_tpu.ops.knn import dense_topk_prepared, prepare_corpus
+
+    n, d, k = 2048, 64, 7
+    corpus, valid = _random_corpus(n, d)
+    queries = np.random.default_rng(1).normal(size=(5, d)).astype(np.float32)
+
+    prep, c2 = prepare_corpus(jnp.asarray(corpus), "cosine")
+    s_ref, i_ref = dense_topk_prepared(
+        jnp.asarray(queries), prep, c2, jnp.asarray(valid), k, metric="cosine"
+    )
+    s_pl, i_pl = pt.pallas_dense_topk(
+        jnp.asarray(queries),
+        prep,
+        jnp.asarray(valid),
+        k,
+        metric="cosine",
+        interpret=True,
+    )
+    assert (np.asarray(i_ref) == np.asarray(i_pl)).all()
+    assert np.allclose(np.asarray(s_ref), np.asarray(s_pl), atol=1e-6)
+    assert (np.asarray(i_pl) != n // 3).all()
+
+
+def test_index_pallas_kernel_matches_xla():
+    from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
+
+    rng = np.random.default_rng(2)
+    vecs = rng.normal(size=(300, 16)).astype(np.float32)
+
+    def build(kernel):
+        ix = TpuDenseKnnIndex(
+            dimensions=16, reserved_space=1024, kernel=kernel
+        )
+        for i in range(len(vecs)):
+            ix.upsert(i, vecs[i], None)
+        ix.remove(123)
+        return ix
+
+    queries = [(vecs[7], 5, None), (vecs[123], 5, None)]
+    res_x = build("xla").search(queries)
+    res_p = build("pallas").search(queries)
+    for rx, rp in zip(res_x, res_p):
+        assert [r[0] for r in rx] == [r[0] for r in rp]
+        assert np.allclose(
+            [r[1] for r in rx], [r[1] for r in rp], atol=1e-6
+        )
+    assert res_p[0][0][0] == 7
+    assert all(r[0] != 123 for r in res_p[1])
+
+
+def test_kernel_env_var_and_validation(monkeypatch):
+    from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
+
+    monkeypatch.setenv("PATHWAY_KNN_KERNEL", "pallas")
+    assert TpuDenseKnnIndex(dimensions=4).kernel == "pallas"
+    monkeypatch.delenv("PATHWAY_KNN_KERNEL")
+    assert TpuDenseKnnIndex(dimensions=4).kernel == "xla"
+    with pytest.raises(ValueError):
+        TpuDenseKnnIndex(dimensions=4, kernel="cuda")
