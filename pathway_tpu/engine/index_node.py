@@ -71,6 +71,28 @@ class ExternalIndexExec(NodeExec):
         self.live_queries: dict[int, tuple] = {}
         self.emitted: dict[int, tuple] = {}
 
+    def state_dict(self) -> dict:
+        # indexes holding device arrays expose their own host-side snapshot;
+        # pure-python indexes (BM25) pickle wholesale
+        if hasattr(self.index, "state_dict"):
+            index_state = ("dict", self.index.state_dict())
+        else:
+            index_state = ("pickle", self.index)
+        return {
+            "live_queries": self.live_queries,
+            "emitted": self.emitted,
+            "index_state": index_state,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.live_queries = dict(state["live_queries"])
+        self.emitted = dict(state["emitted"])
+        kind, payload = state["index_state"]
+        if kind == "dict":
+            self.index.load_state(payload)
+        else:
+            self.index = payload
+
     def _answer(self, items: list[tuple[int, tuple]]) -> dict[int, tuple]:
         """items: (query_key, qvals) → reply tuples."""
         triples = []

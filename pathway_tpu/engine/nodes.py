@@ -64,6 +64,22 @@ class NodeExec:
     def on_end(self) -> list[DiffBatch]:
         return []
 
+    # --- operator-state snapshots (reference: chunked operator snapshots,
+    # src/persistence/operator_snapshot.rs:21-31 + MaybePersist wrappers,
+    # src/engine/dataflow/persist.rs) -----------------------------------
+    # Default: every attribute except the build-time node descriptor IS the
+    # incremental state (the exec pattern keeps all state in plain dicts).
+    # Execs holding unpicklables (device arrays, meshes) override.
+
+    def state_dict(self) -> dict | None:
+        """Picklable snapshot of this exec's incremental state, or None
+        when the exec is stateless."""
+        state = {k: v for k, v in self.__dict__.items() if k != "node"}
+        return state or None
+
+    def load_state(self, state: dict) -> None:
+        self.__dict__.update(state)
+
 
 def _concat_inputs(batches: list[DiffBatch], names: Sequence[str]) -> DiffBatch:
     batches = [b for b in batches if len(b)]

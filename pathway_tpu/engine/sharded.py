@@ -178,6 +178,15 @@ class ShardedGroupByExec(NodeExec):
         tests and the state snapshotter)."""
         return [set(ex.groups.keys()) for ex in self.shards]
 
+    def state_dict(self) -> dict:
+        # router holds the (unpicklable) mesh; shard states carry the data
+        return {"shards": [ex.state_dict() for ex in self.shards]}
+
+    def load_state(self, state: dict) -> None:
+        for ex, st in zip(self.shards, state["shards"]):
+            if st:
+                ex.load_state(st)
+
 
 class ShardedJoinExec(NodeExec):
     """Equijoin with per-shard disjoint state: both sides exchange on the
@@ -220,3 +229,11 @@ class ShardedJoinExec(NodeExec):
             if lsub or rsub:
                 out.extend(ex.process(t, [lsub, rsub]))
         return out
+
+    def state_dict(self) -> dict:
+        return {"shards": [ex.state_dict() for ex in self.shards]}
+
+    def load_state(self, state: dict) -> None:
+        for ex, st in zip(self.shards, state["shards"]):
+            if st:
+                ex.load_state(st)

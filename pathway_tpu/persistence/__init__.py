@@ -81,6 +81,12 @@ class Config:
     snapshot_access: Any = None
     persistence_mode: Any = None
     continue_after_replay: bool = True
+    # operator-state snapshots (reference: operator_snapshot.rs + the
+    # OPERATOR_PERSISTING mode): every `snapshot_every`-th data commit dumps
+    # all exec states and truncates the covered input log, bounding both
+    # restart replay and log growth. False = input-log-only persistence.
+    snapshot_operators: bool = True
+    snapshot_every: int = 8
 
     @classmethod
     def simple_config(

@@ -13,10 +13,15 @@ A "commit" atomically records (input log chunks, per-source offsets,
 metadata) so replay and seek can never disagree — the reference gets the
 same property from snapshotting both under one frontier.
 
-Resume = replay logged ticks through the freshly built node graph at their
-original logical times (deterministic, same results), then restore source
-offsets so connectors continue where they left off. At-least-once, like the
-reference's OSS mode (README.md:110).
+Resume = restore operator-state snapshots (reference:
+src/persistence/operator_snapshot.rs:21-31 chunked state dumps +
+src/engine/dataflow/persist.rs MaybePersist wrappers), then replay only the
+log TAIL — events newer than the snapshot — then restore source offsets.
+Each successful full-graph snapshot truncates the input log (compaction:
+operator_snapshot.rs:342's background merge collapses to "delete covered
+chunks" in the single-driver setting), so both restart time and log size
+stay bounded by the churn since the last snapshot, not by history.
+At-least-once, like the reference's OSS mode (README.md:110).
 """
 
 from __future__ import annotations
@@ -77,10 +82,22 @@ class PersistenceDriver:
             pid: [] for pid in self.inputs
         }
         self._chunk_counts: dict[str, int] = {}
+        self._live_chunks: dict[str, list[int]] = {}
         self._last_commit_wall = 0.0
         self._committed_time = 0
         self._last_real_time = 0
         self._orig_tick = runtime.tick
+        # operator snapshots: on by default; every snapshot_every-th commit
+        # dumps all exec states and truncates the covered log
+        self.snapshot_operators = bool(
+            getattr(config, "snapshot_operators", True)
+        )
+        self.snapshot_every = max(
+            int(getattr(config, "snapshot_every", 8) or 8), 1
+        )
+        self._commits_since_snapshot = 0
+        self.replayed_events = 0  # observability: bounded-replay assertions
+        self.restored_from_snapshot = False
 
     # --- commit path ----------------------------------------------------------
 
@@ -89,6 +106,15 @@ class PersistenceDriver:
         if raw is None:
             return {"last_time": 0, "chunks": {}}
         return json.loads(raw.decode())
+
+    def _node_ordinals(self) -> list[tuple[int, str, Any]]:
+        """(ordinal, class name, exec) for every node, ordinal = topo
+        position — the stable cross-restart identity (same role as
+        effective_persistent_id for inputs)."""
+        out = []
+        for i, node in enumerate(self.runtime.order):
+            out.append((i, type(node).__name__, self.runtime.execs[node.id]))
+        return out
 
     def on_tick(self, t: int, injected: dict[int, list[DiffBatch]] | None = None):
         self._orig_tick(t, injected)
@@ -115,9 +141,16 @@ class PersistenceDriver:
 
     def commit(self, final: bool = False) -> None:
         """Atomically advance the durable frontier: flush pending log chunks,
-        snapshot source offsets, then write metadata last (metadata names
-        exactly the chunks+offsets that form the consistent cut)."""
+        snapshot source offsets (and, periodically, every operator's state),
+        then write metadata last (metadata names exactly the chunks +
+        offsets + state generation forming the consistent cut). A crash
+        mid-commit leaves the previous metadata — and so the previous
+        consistent cut — untouched."""
         meta = self._load_meta()
+        if not self._live_chunks:
+            self._live_chunks = {
+                pid: list(v) for pid, v in meta.get("live_chunks", {}).items()
+            }
         wrote = False
         for pid, pending in self._pending.items():
             if not pending:
@@ -127,6 +160,7 @@ class PersistenceDriver:
                 f"inputs/{pid}/chunk-{idx:08d}.pkl", pickle.dumps(pending)
             )
             self._chunk_counts[pid] = idx + 1
+            self._live_chunks.setdefault(pid, []).append(idx)
             self._pending[pid] = []
             wrote = True
         offsets_changed = False
@@ -144,34 +178,103 @@ class PersistenceDriver:
             if state is not None:
                 self.store.put(f"offsets/{pid}.pkl", pickle.dumps(state))
                 offsets_changed = True
-        if wrote or offsets_changed or final:
+        snap = None
+        self._commits_since_snapshot += 1
+        if (
+            self.snapshot_operators
+            and (wrote or final)
+            and self._commits_since_snapshot >= self.snapshot_every
+        ):
+            snap = self._snapshot_operators(meta)
+        if wrote or offsets_changed or final or snap:
             meta["chunks"].update(self._chunk_counts)
+            meta["live_chunks"] = self._live_chunks
             meta["last_time"] = max(meta.get("last_time", 0), self._last_real_time)
+            if snap:
+                meta["state"] = snap
+                meta["live_chunks"] = self._live_chunks = {
+                    pid: [] for pid in self._live_chunks
+                }
             if final:
                 meta["finished"] = True
             self.store.put(_META_KEY, json.dumps(meta).encode())
             self._committed_time = meta["last_time"]
+            if snap:
+                self._commits_since_snapshot = 0
+                self._gc(meta, snap)
+
+    def _snapshot_operators(self, meta: dict) -> dict | None:
+        """Dump every exec's state under a fresh generation. Returns the
+        state descriptor, or None if ANY node failed to serialize — a
+        partial snapshot must not truncate the log (correctness over
+        compaction)."""
+        gen = int(meta.get("state", {}).get("gen", 0)) + 1
+        nodes: dict[str, str] = {}
+        for ordinal, cls, ex in self._node_ordinals():
+            try:
+                state = ex.state_dict()
+                if state is None:
+                    continue
+                blob = pickle.dumps(state)
+            except Exception:
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "operator snapshot skipped: node %s (ordinal %d) has "
+                    "unpicklable state; log compaction disabled",
+                    cls,
+                    ordinal,
+                )
+                return None
+            self.store.put(f"states/gen-{gen:06d}/{ordinal:05d}.pkl", blob)
+            nodes[str(ordinal)] = cls
+        # snapshot covers everything up to and including the last processed
+        # tick; all flushed chunks hold rows with time <= this
+        return {"gen": gen, "time": self._last_real_time, "nodes": nodes}
+
+    def _gc(self, meta: dict, snap: dict) -> None:
+        """After the metadata naming the new generation is durable, delete
+        the input chunks the snapshot covers and older state generations."""
+        for key in self.store.list_keys("inputs/"):
+            self.store.remove(key)
+        prefix = f"states/gen-{snap['gen']:06d}/"
+        for key in self.store.list_keys("states/"):
+            if not key.startswith(prefix):
+                self.store.remove(key)
 
     # --- resume path ----------------------------------------------------------
 
     def replay(self) -> None:
-        """Feed logged events back through the graph at their original
-        logical times, then restore connector offsets."""
+        """Restore operator snapshots, then feed only the log TAIL (events
+        newer than the snapshot) through the graph at original logical
+        times, then restore connector offsets."""
         meta = self._load_meta()
         self._chunk_counts = dict(meta.get("chunks", {}))
+        self._live_chunks = {
+            pid: list(v) for pid, v in meta.get("live_chunks", {}).items()
+        }
         if not self.replay_allowed:
             return
+        state_time = -1  # -1 = no snapshot: replay everything incl. t=0
+        snap = meta.get("state")
+        if snap:
+            state_time = self._restore_operators(snap)
         events: list[tuple[int, int, DiffBatch]] = []  # (time, node_id, batch)
         for pid, node in self.inputs.items():
-            n_chunks = meta.get("chunks", {}).get(pid, 0)
-            for i in range(n_chunks):
+            chunk_ids = self._live_chunks.get(pid)
+            if chunk_ids is None:  # pre-compaction metadata: contiguous
+                chunk_ids = list(range(meta.get("chunks", {}).get(pid, 0)))
+            for i in chunk_ids:
                 raw = self.store.get(f"inputs/{pid}/chunk-{i:08d}.pkl")
                 if raw is None:
                     continue
                 for t, rows in pickle.loads(raw):
+                    if t <= state_time:
+                        continue  # covered by the operator snapshot
                     events.append(
                         (t, node.id, DiffBatch.from_rows(rows, node.column_names))
                     )
+        self.replayed_events = len(events)
         events.sort(key=lambda e: e[0])
         i, n = 0, len(events)
         while i < n:
@@ -194,9 +297,30 @@ class PersistenceDriver:
             elif hasattr(src, "seek"):
                 src.seek(state)
 
+    def _restore_operators(self, snap: dict) -> int:
+        """Load every node's snapshotted state; on any structural mismatch
+        (different graph shape/classes than when snapshotted) fall back to
+        full-log replay by reporting state_time -1."""
+        gen = int(snap["gen"])
+        ordinals = {i: (cls, ex) for i, cls, ex in self._node_ordinals()}
+        loaded: list[tuple[Any, dict]] = []
+        for key, cls in snap.get("nodes", {}).items():
+            ordinal = int(key)
+            if ordinal not in ordinals or ordinals[ordinal][0] != cls:
+                return -1
+            raw = self.store.get(f"states/gen-{gen:06d}/{ordinal:05d}.pkl")
+            if raw is None:
+                return -1
+            loaded.append((ordinals[ordinal][1], pickle.loads(raw)))
+        for ex, state in loaded:
+            ex.load_state(state)
+        self.restored_from_snapshot = True
+        return int(snap.get("time", 0))
+
 
 def attach_persistence(runtime: Runtime, config: Any) -> PersistenceDriver:
     driver = PersistenceDriver(runtime, config)
     driver.replay()
     runtime.tick = driver.on_tick  # type: ignore[method-assign]
+    runtime.persistence_driver = driver  # type: ignore[attr-defined]
     return driver

@@ -88,6 +88,44 @@ class TpuDenseKnnIndex:
             self.corpus.remove(key)
         self.metadata.pop(key, None)
 
+    # --- operator-snapshot support (reference: operator_snapshot.rs) ------
+    # host-side content only; device arrays are re-uploaded lazily
+
+    def state_dict(self) -> dict:
+        c = self.corpus
+        return {
+            "metadata": self.metadata,
+            "corpus": None
+            if c is None
+            else {
+                "dim": c.dim,
+                "capacity": c.capacity,
+                "host": c.host,
+                "valid_host": c.valid_host,
+                "free": list(c.free),
+                "slot_of": dict(c.slot_of),
+                "key_of": dict(c.key_of),
+            },
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.metadata = dict(state["metadata"])
+        cs = state["corpus"]
+        self.corpus = None
+        if cs is None:
+            return
+        c = self._ensure(cs["dim"])  # fresh corpus with current sharding
+        if c.capacity == cs["capacity"]:
+            c.host = cs["host"]
+            c.valid_host = cs["valid_host"]
+            c.free = list(cs["free"])
+            c.slot_of = dict(cs["slot_of"])
+            c.key_of = dict(cs["key_of"])
+            c._dirty = True
+        else:  # capacity alignment changed between versions: re-upsert
+            for key, slot in cs["slot_of"].items():
+                c.upsert(key, cs["host"][slot])
+
     def search(self, queries: Sequence[tuple[Any, int, Any]]):
         if self.corpus is None or len(self.corpus) == 0 or not queries:
             return [() for _ in queries]
@@ -280,6 +318,20 @@ class LshKnnIndex:
             for t, b in enumerate(ids):
                 self.buckets[t][int(b)].discard(key)
         self.metadata.pop(key, None)
+
+    def state_dict(self) -> dict:
+        # planes/offsets are deterministic from the constructor args, so
+        # only the mutable content snapshots (jax arrays stay out)
+        return {
+            "buckets": [dict(b) for b in self.buckets],
+            "vectors": self.vectors,
+            "metadata": self.metadata,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.buckets = [defaultdict(set, b) for b in state["buckets"]]
+        self.vectors = dict(state["vectors"])
+        self.metadata = dict(state["metadata"])
 
     def search(self, queries: Sequence[tuple[Any, int, Any]]):
         if not self.vectors:
