@@ -5,10 +5,19 @@ loop, src/external_integration/brute_force_knn_integration.rs:22, here
 mapped onto the MXU): for each grid step one [BLK, D] corpus tile is
 staged in VMEM, scored against the [B, D] queries on the MXU, masked, and
 reduced to the tile's top-k (k max/argmax/suppress passes on the VPU) —
-so only [B, nblk*k] candidates ever return to HBM instead of the full
+so only [B, nblk*KP] candidates ever return to HBM instead of the full
 [B, N] score matrix. A final lax.top_k merges block winners (exact, same
 argument as ops/knn._masked_topk). Runs in interpreter mode off-TPU so
 tests cover it on the CPU backend.
+
+TPU lowering constraint (the round-2 failure): the last two dims of every
+block must be divisible by (8, 128) or equal the overall array dims. The
+outputs are therefore laid out 2-D as [B, nblk*KP] where KP = k padded up
+to a multiple of 128 — each grid step writes its own lane-aligned (B, KP)
+tile (KP % 128 == 0; B equals the array dim), with the real k winners in
+the leading lanes and -inf/0 padding after. The caller reshapes to
+[B, nblk, KP] and slices [..., :k]. `check_tpu_block_rules` asserts the
+constraint statically so tests gate it without TPU hardware.
 """
 
 from __future__ import annotations
@@ -22,7 +31,57 @@ from jax.experimental import pallas as pl
 BLK = 1024
 
 
-def _topk_block_kernel(k: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref):
+def _kpad(k: int) -> int:
+    """k padded up to the TPU lane width (multiple of 128)."""
+    return -(-k // 128) * 128
+
+
+def check_tpu_block_rules(block_shape, array_shape) -> None:
+    """Static mirror of the Mosaic lowering rule: the last two dims of a
+    block must be divisible by (8, 128) respectively, or equal the
+    corresponding overall-array dims. Raises ValueError otherwise — the
+    compiled-mode test gate calls this for every spec the kernel uses so
+    an un-lowerable shape fails the suite even on the CPU backend."""
+    if len(block_shape) != len(array_shape):
+        raise ValueError(
+            f"block rank {len(block_shape)} != array rank {len(array_shape)}"
+        )
+    if len(block_shape) < 2:
+        return
+    checks = ((block_shape[-2], array_shape[-2], 8), (
+        block_shape[-1], array_shape[-1], 128))
+    for blk_dim, arr_dim, align in checks:
+        if blk_dim % align != 0 and blk_dim != arr_dim:
+            raise ValueError(
+                f"block shape {tuple(block_shape)} vs array "
+                f"{tuple(array_shape)}: dim {blk_dim} is neither divisible "
+                f"by {align} nor equal to the array dim {arr_dim}"
+            )
+
+
+def _specs(bq: int, d: int, n: int, k: int):
+    """(grid, in_specs, out_specs, out_shapes, nblk, kp) for the block-
+    top-k call — the single source for the kernel's layout, shared by the
+    caller and the static test gate so they can't drift apart."""
+    nblk = n // BLK
+    kp = _kpad(k)
+    in_specs = [
+        (pl.BlockSpec((bq, d), lambda i: (0, 0)), (bq, d)),
+        (pl.BlockSpec((BLK, d), lambda i: (i, 0)), (n, d)),
+        (pl.BlockSpec((1, BLK), lambda i: (0, i)), (1, n)),
+    ]
+    out_specs = [
+        (pl.BlockSpec((bq, kp), lambda i: (0, i)), (bq, nblk * kp)),
+        (pl.BlockSpec((bq, kp), lambda i: (0, i)), (bq, nblk * kp)),
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((bq, nblk * kp), jnp.float32),
+        jax.ShapeDtypeStruct((bq, nblk * kp), jnp.int32),
+    ]
+    return (nblk,), in_specs, out_specs, out_shapes, nblk, kp
+
+
+def _topk_block_kernel(k: int, kp: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref):
     # q: [B, D] f32/bf16; c: [BLK, D]; valid: [1, BLK] f32 (1.0/0.0)
     q = q_ref[:]
     c = c_ref[:]
@@ -35,6 +94,7 @@ def _topk_block_kernel(k: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref):
     s = jnp.where(valid_ref[:] > 0.5, s, -jnp.inf)
     b = s.shape[0]
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    out_cols = jax.lax.broadcasted_iota(jnp.int32, (b, kp), 1)
 
     def body(i, carry):
         s_cur, _sc, _ix = carry
@@ -42,17 +102,20 @@ def _topk_block_kernel(k: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref):
         is_max = s_cur == m[:, None]
         # first column attaining the max
         a = jnp.min(jnp.where(is_max, cols, BLK), axis=1).astype(jnp.int32)
-        sc = _sc.at[:, i].set(m)
-        ix = _ix.at[:, i].set(a)
+        # one-hot lane write (dynamic per-lane .at[] scatters lower poorly
+        # on the VPU; a masked select vectorizes)
+        hit = out_cols == i
+        sc = jnp.where(hit, m[:, None], _sc)
+        ix = jnp.where(hit, a[:, None], _ix)
         suppress = cols == a[:, None]
         s_next = jnp.where(suppress, -jnp.inf, s_cur)
         return s_next, sc, ix
 
-    sc0 = jnp.full((b, k), -jnp.inf, jnp.float32)
-    ix0 = jnp.zeros((b, k), jnp.int32)
+    sc0 = jnp.full((b, kp), -jnp.inf, jnp.float32)
+    ix0 = jnp.zeros((b, kp), jnp.int32)
     _s, sc, ix = jax.lax.fori_loop(0, k, body, (s, sc0, ix0))
-    sc_ref[:] = sc[:, None, :]
-    ix_ref[:] = ix[:, None, :]
+    sc_ref[:] = sc
+    ix_ref[:] = ix
 
 
 @functools.partial(
@@ -70,27 +133,19 @@ def pallas_block_topk(
     bq, d = queries.shape
     n = prep.shape[0]
     assert n % BLK == 0, "pad the corpus to a multiple of BLK"
-    nblk = n // BLK
     validf = valid.astype(jnp.float32).reshape(1, n)
-    kernel = functools.partial(_topk_block_kernel, k)
+    grid, in_specs, out_specs, out_shapes, nblk, kp = _specs(bq, d, n, k)
+    kernel = functools.partial(_topk_block_kernel, k, kp)
     sc, ix = pl.pallas_call(
         kernel,
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((bq, d), lambda i: (0, 0)),
-            pl.BlockSpec((BLK, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, BLK), lambda i: (0, i)),
-        ],
-        out_specs=[
-            pl.BlockSpec((bq, 1, k), lambda i: (0, i, 0)),
-            pl.BlockSpec((bq, 1, k), lambda i: (0, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bq, nblk, k), jnp.float32),
-            jax.ShapeDtypeStruct((bq, nblk, k), jnp.int32),
-        ],
+        grid=grid,
+        in_specs=[spec for spec, _ in in_specs],
+        out_specs=[spec for spec, _ in out_specs],
+        out_shape=out_shapes,
         interpret=interpret,
     )(queries, prep, validf)
+    sc = sc.reshape(bq, nblk, kp)[:, :, :k]
+    ix = ix.reshape(bq, nblk, kp)[:, :, :k]
     # local -> global indices
     ix = ix + (jnp.arange(nblk, dtype=jnp.int32) * BLK)[None, :, None]
     return sc, ix
@@ -127,6 +182,9 @@ def supported(n: int, k: int) -> bool:
     return n % BLK == 0 and k <= BLK
 
 
-def _kernel_out_block_fix():  # pragma: no cover - doc anchor
-    """Out specs use a singleton middle dim so each grid step owns its
-    [B, 1, k] slice of the [B, nblk, k] outputs."""
+def validate_lowering(bq: int, d: int, n: int, k: int) -> None:
+    """Assert every block spec the kernel will use satisfies the TPU
+    lowering rule. Used by the compiled-mode test gate."""
+    _grid, in_specs, out_specs, _shapes, _nblk, _kp = _specs(bq, d, n, k)
+    for spec, arr_shape in in_specs + out_specs:
+        check_tpu_block_rules(spec.block_shape, arr_shape)

@@ -68,6 +68,55 @@ def test_index_pallas_kernel_matches_xla():
     assert all(r[0] != 123 for r in res_p[1])
 
 
+def test_tpu_lowering_shape_gate():
+    """Compiled-mode gate (VERDICT r2 item 2): every block spec the kernel
+    will emit for the bench shapes must satisfy the Mosaic TPU rule (last
+    two block dims divisible by (8, 128) or equal to the array dims), so a
+    kernel that cannot lower on hardware fails the suite even on the CPU
+    backend. The round-2 kernel shipped green with interpret=True and then
+    crashed on TPU with exactly the shape this asserts."""
+    from pathway_tpu.ops import pallas_topk as pt
+
+    # bench shape (1M-row corpus, single query), batched queries, k > 128
+    pt.validate_lowering(bq=1, d=384, n=977 * 1024, k=10)
+    pt.validate_lowering(bq=16, d=384, n=64 * 1024, k=10)
+    pt.validate_lowering(bq=7, d=128, n=2048, k=130)
+
+    # the rule-checker itself must reject the round-2 failure shape:
+    # block (1, 1, 10) over array (1, 977, 10) — middle dim 1 vs 977
+    with pytest.raises(ValueError):
+        pt.check_tpu_block_rules((1, 1, 10), (1, 977, 10))
+    # and a lane dim neither 128-aligned nor equal to the array's
+    with pytest.raises(ValueError):
+        pt.check_tpu_block_rules((8, 10), (8, 2048))
+
+
+def test_pallas_compiled_on_tpu():
+    """When a real TPU is attached (driver bench environment), actually
+    compile and run the kernel with interpret=False and compare against
+    the XLA path — the hard gate the shape assertion approximates."""
+    import jax
+
+    if jax.default_backend() not in ("tpu",):
+        pytest.skip("no TPU attached; shape gate covers lowering rules")
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import pallas_topk as pt
+    from pathway_tpu.ops.knn import dense_topk_prepared, prepare_corpus
+
+    n, d, k = 2048, 128, 5
+    corpus, valid = _random_corpus(n, d)
+    queries = np.random.default_rng(3).normal(size=(4, d)).astype(np.float32)
+    prep, c2 = prepare_corpus(jnp.asarray(corpus), "cosine")
+    s_ref, i_ref = dense_topk_prepared(
+        jnp.asarray(queries), prep, c2, jnp.asarray(valid), k, metric="cosine"
+    )
+    s_pl, i_pl = pt.pallas_dense_topk(
+        jnp.asarray(queries), prep, jnp.asarray(valid), k, metric="cosine"
+    )
+    assert (np.asarray(i_ref) == np.asarray(i_pl)).all()
+
+
 def test_kernel_env_var_and_validation(monkeypatch):
     from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
 
