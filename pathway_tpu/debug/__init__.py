@@ -22,11 +22,14 @@ from pathway_tpu.internals.universe import Universe
 class _RowsSource(StaticSource):
     def __init__(self, column_names, events):
         super().__init__(column_names)
-        self._events = events  # list[(time, rows)]
+        # columnarize at declare time — ingestion-to-columnar conversion is
+        # I/O-layer work and must not be re-paid on every run of the graph
+        self._events = [
+            (t, DiffBatch.from_rows(rows, column_names)) for t, rows in events
+        ]
 
     def events(self):
-        for t, rows in self._events:
-            yield t, DiffBatch.from_rows(rows, self.column_names)
+        yield from self._events
 
 
 def _parse_value(s: str) -> Any:
@@ -251,12 +254,28 @@ class _Capture:
         self.updates: list[tuple[int, int, int, tuple]] = []  # (time,key,diff,vals)
 
     def on_batch(self, t: int, batch: DiffBatch) -> None:
+        rows = self.rows
+        updates = self.updates
+        if len(batch) > 512 and bool((batch.diffs > 0).all()):
+            # insert-only bulk: one C-level dict.update instead of a
+            # per-row loop (bulk joins emit hundreds of thousands of rows)
+            import itertools
+
+            keys = batch.keys.tolist()
+            cols = [c.tolist() for c in batch.columns.values()]
+            vals = list(zip(*cols)) if cols else [()] * len(keys)
+            diffs = batch.diffs.tolist()
+            updates.extend(
+                zip(itertools.repeat(t), keys, diffs, vals)
+            )
+            rows.update(zip(keys, vals))
+            return
         for k, d, vals in batch.iter_rows():
-            self.updates.append((t, k, d, vals))
+            updates.append((t, k, d, vals))
             if d > 0:
-                self.rows[k] = vals
+                rows[k] = vals
             else:
-                self.rows.pop(k, None)
+                rows.pop(k, None)
 
 
 def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
@@ -278,8 +297,10 @@ def table_to_dicts(table: Table):
     cap = _run_capture([table])[0]
     col_names = table.column_names()
     keys = list(cap.rows.keys())
+    vals = list(cap.rows.values())
     columns = {
-        n: {k: cap.rows[k][i] for k in keys} for i, n in enumerate(col_names)
+        n: dict(zip(keys, [v[i] for v in vals]))
+        for i, n in enumerate(col_names)
     }
     return keys, columns
 

@@ -17,22 +17,30 @@ import numpy as np
 END_OF_TIME = 1 << 62
 
 
+def _obj_column(values: Sequence[Any]) -> np.ndarray:
+    """Object-dtype column via one C-level slice assignment (a per-row
+    Python loop here was a hot spot of the engine ingest path). Falls back
+    to the loop when numpy would broadcast the elements instead of storing
+    them (equal-length tuples/ndarrays become a 2-D RHS and raise)."""
+    out = np.empty(len(values), dtype=object)
+    try:
+        out[:] = values if isinstance(values, (list, tuple)) else list(values)
+    except ValueError:
+        for i, v in enumerate(values):
+            out[i] = v
+    return out
+
+
 def make_column(values: Sequence[Any], np_dtype: Any = None) -> np.ndarray:
     """Build a column array; object dtype is element-safe for tuples/arrays."""
     if isinstance(values, np.ndarray) and np_dtype is None:
         return values
     if np_dtype is None or np.dtype(np_dtype) == np.dtype(object):
-        out = np.empty(len(values), dtype=object)
-        for i, v in enumerate(values):
-            out[i] = v
-        return out
+        return _obj_column(values)
     try:
         return np.asarray(values, dtype=np_dtype)
     except (ValueError, TypeError, OverflowError):
-        out = np.empty(len(values), dtype=object)
-        for i, v in enumerate(values):
-            out[i] = v
-        return out
+        return _obj_column(values)
 
 
 class DiffBatch:
@@ -68,14 +76,16 @@ class DiffBatch:
     ) -> "DiffBatch":
         """rows: (key, diff, values-tuple)"""
         n = len(rows)
-        keys = np.empty(n, dtype=np.uint64)
-        diffs = np.empty(n, dtype=np.int64)
-        cols = [np.empty(n, dtype=object) for _ in column_names]
-        for i, (k, d, vals) in enumerate(rows):
-            keys[i] = k
-            diffs[i] = d
-            for j, v in enumerate(vals):
-                cols[j][i] = v
+        if n == 0:
+            return DiffBatch.empty(column_names)
+        # transpose once at C speed instead of a per-row/per-column loop
+        keys_t, diffs_t, vals_t = zip(*rows)
+        keys = np.fromiter(keys_t, dtype=np.uint64, count=n)
+        diffs = np.fromiter(diffs_t, dtype=np.int64, count=n)
+        if column_names:
+            cols = [_obj_column(col) for col in zip(*vals_t)]
+        else:
+            cols = []
         return DiffBatch(keys, diffs, dict(zip(column_names, cols)))
 
     # --- basics ---------------------------------------------------------------
@@ -91,9 +101,13 @@ class DiffBatch:
         return tuple(col[i] for col in self.columns.values())
 
     def iter_rows(self) -> Iterator[tuple[int, int, tuple]]:
-        cols = list(self.columns.values())
-        for i in range(len(self.keys)):
-            yield int(self.keys[i]), int(self.diffs[i]), tuple(c[i] for c in cols)
+        # one C-level transpose instead of per-row generator expressions;
+        # numeric columns yield Python scalars (tolist), matching what the
+        # batch hashers serialize
+        n = len(self.keys)
+        cols = [c.tolist() for c in self.columns.values()]
+        vals: Iterable[tuple] = zip(*cols) if cols else ((),) * n
+        return zip(self.keys.tolist(), self.diffs.tolist(), vals)
 
     def mask(self, m: np.ndarray) -> "DiffBatch":
         return DiffBatch(

@@ -18,6 +18,7 @@ import itertools
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
+import pandas as pd  # factorize powers the columnar groupby/join paths
 
 from pathway_tpu.engine.batch import (
     END_OF_TIME,
@@ -364,24 +365,90 @@ class GroupByExec(NodeExec):
         gcols = [cols[i] for i in self.g_idx]
         return ref_scalars_columns(gcols, len(b))
 
-    _BULK_KINDS = ("count", "sum", "avg")
+    _BULK_SEMIGROUP = ("count", "sum", "avg")
+    _BULK_MULTISET = ("min", "max", "argmin", "argmax", "unique", "any")
 
-    def _try_bulk(self, b, gks, touched, t) -> bool:
-        """Vectorized path for semigroup reducers (count/sum/avg): one
-        np.unique + per-group partial sums instead of a per-row Python loop
-        (the columnar analog of the reference's SemigroupReducerImpl fast
-        path, src/engine/reduce.rs:40)."""
+    # pandas hashes some value pairs equal that ref_scalar distinguishes
+    # (True==1==1.0; None merges with NaN in float columns), so the
+    # factorize fast path only fires when each grouping column's value
+    # types make those collisions impossible; anything else falls back to
+    # the exact per-row hash.
+    _SAFE_TYPESETS = (
+        {str},
+        {str, type(None)},
+        {int},
+        {int, type(None)},
+        {float},
+        {bool},
+        {type(None)},
+    )
+
+    def _bulk_codes(self, b):
+        """Factorize the grouping columns: (codes [n] int64 dense 0..nu-1 in
+        first-appearance order, nu, first_idx [nu]) or None when any column
+        is factorize-unsafe. Replaces hashing every row: group keys are
+        derived (via the exact C hasher) for the nu distinct groups only —
+        the O(n) work drops from ~1 us/row blake2b to a pandas hash."""
+        cols = list(b.columns.values())
+        parts: list[tuple[np.ndarray, int]] = []
+        for j in self.g_idx:
+            arr = cols[j]
+            if arr.dtype == object:
+                ts = set(map(type, arr.tolist()))
+                if ts not in self._SAFE_TYPESETS:
+                    return None
+            elif arr.dtype.kind not in "biuf" or arr.ndim != 1:
+                return None
+            try:
+                codes_j, uniq_j = pd.factorize(arr, use_na_sentinel=False)
+            except TypeError:
+                return None
+            parts.append((codes_j.astype(np.int64), max(1, len(uniq_j))))
+        codes, nu = parts[0]
+        if len(parts) > 1:
+            # mixed-radix combination must fit int64 or wrapped codes could
+            # collide and silently merge distinct groups — fall back to the
+            # exact per-row hash beyond that
+            radix = nu
+            for _cj, nj in parts[1:]:
+                radix *= nj
+                if radix > (1 << 62):
+                    return None
+            for cj, nj in parts[1:]:
+                codes = codes * nj + cj
+            codes, uniq_c = pd.factorize(codes, use_na_sentinel=False)
+            codes = codes.astype(np.int64)
+            nu = len(uniq_c)
+        n = len(codes)
+        # smallest row index per group: reversed fancy assignment makes the
+        # earliest row the last (winning) write for each code
+        first_idx = np.empty(nu, dtype=np.int64)
+        first_idx[codes[::-1]] = np.arange(n - 1, -1, -1, dtype=np.int64)
+        return codes, nu, first_idx
+
+    def _try_bulk(self, b, touched, t) -> bool:
+        """Columnar groupby path (the microbatch analog of differential's
+        batched reduce, reference src/engine/reduce.rs:40): factorize the
+        grouping columns, hash only the distinct groups, accumulate
+        semigroup reducers (count/sum/avg) with bincount-style partial sums
+        and multiset reducers (min/max/argmin/argmax/unique/any) with one
+        tight per-group bulk update — no per-row Python tuples."""
         if self.sort_idx is not None or len(b) < 256:
             return False
-        if not all(
-            s.kind in self._BULK_KINDS and not s.skip_nones for s in self.specs
-        ):
-            return False
+        for s in self.specs:
+            if s.kind in self._BULK_SEMIGROUP:
+                # count(col) must see its argument column (ERROR poison,
+                # skip_nones) — only argument-less count is a pure semigroup
+                if s.skip_nones or (s.kind == "count" and s.arg_cols):
+                    return False
+            elif s.kind not in self._BULK_MULTISET:
+                return False
         cols = list(b.columns.values())
         diffs = b.diffs
+        # pre-validate semigroup argument columns as dense numerics
         arg_arrays: list[np.ndarray | None] = []
         for spec, idx in zip(self.specs, self.arg_idx):
-            if spec.kind == "count":
+            if spec.kind not in self._BULK_SEMIGROUP or spec.kind == "count":
                 arg_arrays.append(None)
                 continue
             arr = cols[idx[0]]
@@ -393,21 +460,38 @@ class GroupByExec(NodeExec):
             if arr.dtype.kind not in "if" or arr.ndim != 1:
                 return False  # ndarray-valued sums use the per-row path
             arg_arrays.append(arr)
-        uniq, first_idx, inv = np.unique(
-            gks, return_index=True, return_inverse=True
+        fact = self._bulk_codes(b)
+        if fact is None:
+            return False
+        codes, nu, first_idx = fact
+        # exact group keys for the distinct groups only (same C hasher and
+        # column layout as _group_keys_batch, so keys are byte-identical
+        # across the bulk and per-row paths)
+        from pathway_tpu.internals.api import ref_scalars_columns
+
+        gks_u = ref_scalars_columns(
+            [cols[j][first_idx] for j in self.g_idx], nu
         )
-        dcounts = np.zeros(len(uniq), dtype=np.int64)
-        np.add.at(dcounts, inv, diffs)
+        dcounts = np.zeros(nu, dtype=np.int64)
+        np.add.at(dcounts, codes, diffs)
         partials: list[np.ndarray | None] = []
         for spec, arr in zip(self.specs, arg_arrays):
             if arr is None:
                 partials.append(None)
             else:
-                part = np.zeros(len(uniq), dtype=arr.dtype if arr.dtype.kind == "i" else np.float64)
-                np.add.at(part, inv, arr * diffs)
+                part = np.zeros(
+                    nu, dtype=arr.dtype if arr.dtype.kind == "i" else np.float64
+                )
+                np.add.at(part, codes, arr * diffs)
                 partials.append(part)
-        for gi in range(len(uniq)):
-            gk = int(uniq[gi])
+        # group the batch's row positions by code for multiset bulk updates
+        any_multiset = any(s.kind in self._BULK_MULTISET for s in self.specs)
+        if any_multiset:
+            order = np.argsort(codes, kind="stable")
+            bounds = np.searchsorted(codes[order], np.arange(nu + 1))
+            diffs_l = diffs.tolist()
+        for gi in range(nu):
+            gk = int(gks_u[gi])
             gs = self.groups.get(gk)
             if gs is None:
                 i0 = int(first_idx[gi])
@@ -417,16 +501,32 @@ class GroupByExec(NodeExec):
                 self.groups[gk] = gs
             d = int(dcounts[gi])
             gs.count += d
-            for acc, spec, part in zip(gs.accs, self.specs, partials):
+            if any_multiset:
+                g_rows = order[bounds[gi] : bounds[gi + 1]]
+            for acc, spec, part, idx in zip(
+                gs.accs, self.specs, partials, self.arg_idx
+            ):
                 if spec.kind == "count":
                     acc.c += d
                 elif spec.kind == "sum":
                     p = part[gi]
-                    acc.s = acc.s + (int(p) if part.dtype.kind == "i" else float(p))
+                    acc.s = acc.s + (
+                        int(p) if part.dtype.kind == "i" else float(p)
+                    )
                     acc.n += d
-                else:  # avg
+                elif spec.kind == "avg":
                     acc.s += float(part[gi])
                     acc.c += d
+                else:  # multiset bulk
+                    try:
+                        acc.update_bulk(
+                            [cols[j][g_rows].tolist() for j in idx],
+                            [diffs_l[r] for r in g_rows],
+                        )
+                    except Exception as exc:
+                        # same degraded-but-running contract as the per-row
+                        # path (e.g. unhashable ndarray args)
+                        record_error(exc, str(self.node))
             touched[gk] = None
         return True
 
@@ -435,9 +535,9 @@ class GroupByExec(NodeExec):
         touched: dict[int, None] = {}
         simple_keys = not self.node.set_id and self.inst_idx is None
         for b in batches:
-            gks = self._group_keys_batch(b) if simple_keys and len(b) else None
-            if gks is not None and self._try_bulk(b, gks, touched, t):
+            if simple_keys and len(b) and self._try_bulk(b, touched, t):
                 continue
+            gks = self._group_keys_batch(b) if simple_keys and len(b) else None
             cols = list(b.columns.values())
             keys_a, diffs_a = b.keys, b.diffs
             for i in range(len(b)):
@@ -541,13 +641,48 @@ class JoinNode(Node):
 
 
 class _SideState:
-    __slots__ = ("by_jk",)
+    __slots__ = ("by_jk", "_pending", "pending_jks")
 
     def __init__(self):
         # jk -> {rowkey: [vals, count]}
         self.by_jk: dict[int, dict[int, list]] = {}
+        # bulk-loaded batches whose dict state hasn't been needed yet: a
+        # batch-analytics join never probes its own build side again, so
+        # the per-row dict build is deferred until an incremental tick
+        # actually touches the state (columnar-first, reference analog:
+        # differential arrangements are also built lazily from batches)
+        self._pending: list[tuple[list, list, list]] = []
+        self.pending_jks: set[int] = set()
+
+    def defer_bulk(self, jks: list, keys: list, cols: list[np.ndarray]):
+        self._pending.append((jks, keys, cols))
+        self.pending_jks.update(jks)
+
+    def _materialize(self):
+        by = self.by_jk
+        for jks, keys, cols in self._pending:
+            vals: Any = (
+                zip(*[c.tolist() for c in cols]) if cols else iter(
+                    [()] * len(keys)
+                )
+            )
+            for jk, k, v in zip(jks, keys, vals):
+                rows = by.get(jk)
+                if rows is None:
+                    by[jk] = {k: [v, 1]}
+                else:
+                    e = rows.get(k)
+                    if e is None:
+                        rows[k] = [v, 1]
+                    else:
+                        e[1] += 1
+                        e[0] = v
+        self._pending.clear()
+        self.pending_jks.clear()
 
     def apply(self, jk: int, k: int, d: int, vals: tuple):
+        if self._pending:
+            self._materialize()
         rows = self.by_jk.setdefault(jk, {})
         e = rows.get(k)
         if e is None:
@@ -563,6 +698,8 @@ class _SideState:
             del self.by_jk[jk]
 
     def rows(self, jk: int) -> dict[int, list]:
+        if self._pending:
+            self._materialize()
         return self.by_jk.get(jk, {})
 
 
@@ -638,20 +775,104 @@ class JoinExec(NodeExec):
                 emit(okey, (None,) * self.n_l + rvals + (None, Pointer(rk)))
         return out
 
+    def _batch_jks(self, b, on_idx) -> np.ndarray:
+        """Join keys for a whole batch via the C batch hasher (byte-
+        identical to per-row ref_scalar, same contract as the groupby
+        path's _group_keys_batch)."""
+        from pathway_tpu.internals.api import ref_scalars_columns
+
+        cols = list(b.columns.values())
+        return ref_scalars_columns([cols[i] for i in on_idx], len(b))
+
+    def _try_bulk(self, lb, rb, jks_l, jks_r):
+        """Columnar hash-join fast path (the batched analog of
+        differential's join_core merge, reference src/engine/dataflow.rs:
+        2834): for insert-only inner-join batches whose join keys are all
+        new to the operator state, matching pairs are found with one sort +
+        searchsorted and output columns are built by fancy indexing — no
+        per-row Python tuples on the emit path. Returns the output batches
+        or None when ineligible (the per-row incremental path then runs)."""
+        node = self.node
+        if node.mode != "inner" or node.id_from is not None:
+            return None
+        n_l, n_r = len(lb), len(rb)
+        if n_l + n_r < 1024:
+            return None  # small ticks: per-row path is cheap and general
+        if (lb.diffs != 1).any() or (rb.diffs != 1).any():
+            return None
+        lbj, rbj = self.left.by_jk, self.right.by_jk
+        lpend, rpend = self.left.pending_jks, self.right.pending_jks
+        if lbj or rbj or lpend or rpend:
+            for j in np.unique(np.concatenate([jks_l, jks_r])).tolist():
+                if j in lbj or j in rbj or j in lpend or j in rpend:
+                    return None
+        order_r = np.argsort(jks_r, kind="stable")
+        jr_sorted = jks_r[order_r]
+        lo = np.searchsorted(jr_sorted, jks_l, "left")
+        hi = np.searchsorted(jr_sorted, jks_l, "right")
+        counts = hi - lo
+        total = int(counts.sum())
+        out = []
+        if total:
+            li = np.repeat(np.arange(n_l), counts)
+            starts = np.repeat(lo, counts)
+            offs = np.arange(total) - np.repeat(
+                np.cumsum(counts) - counts, counts
+            )
+            ri = order_r[starts + offs]
+            lcols = list(lb.columns.values())
+            rcols = list(rb.columns.values())
+            from pathway_tpu.engine.batch import _obj_column
+            from pathway_tpu.internals.api import ref_scalars_columns
+
+            lptr = _obj_column(list(map(Pointer, lb.keys[li].tolist())))
+            rptr = _obj_column(list(map(Pointer, rb.keys[ri].tolist())))
+            okeys = ref_scalars_columns([lptr, rptr], total)
+            columns = {}
+            names = self.node.column_names
+            ncol = 0
+            for c in lcols:
+                columns[names[ncol]] = c[li]
+                ncol += 1
+            for c in rcols:
+                columns[names[ncol]] = c[ri]
+                ncol += 1
+            columns[names[ncol]] = lptr
+            columns[names[ncol + 1]] = rptr
+            out.append(
+                DiffBatch(okeys, np.ones(total, dtype=np.int64), columns)
+            )
+        # state update deferred: dict state materializes only if a later
+        # tick probes it (see _SideState.defer_bulk)
+        self.left.defer_bulk(
+            jks_l.tolist(), lb.keys.tolist(), list(lb.columns.values())
+        )
+        self.right.defer_bulk(
+            jks_r.tolist(), rb.keys.tolist(), list(rb.columns.values())
+        )
+        return out
+
     def process(self, t, inputs):
         lb = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
         rb = _concat_inputs(inputs[1], self.node.inputs[1].column_names)
         if not len(lb) and not len(rb):
             return []
+        jks_l = self._batch_jks(lb, self.l_on_idx) if len(lb) else np.empty(0, np.uint64)
+        jks_r = self._batch_jks(rb, self.r_on_idx) if len(rb) else np.empty(0, np.uint64)
+        bulk = self._try_bulk(lb, rb, jks_l, jks_r)
+        if bulk is not None:
+            return bulk
         touched: dict[int, None] = {}
+        jl = jks_l.tolist()
         l_updates = []
-        for k, d, vals in lb.iter_rows():
-            jk = self._jk(vals, self.l_on_idx)
+        for i, (k, d, vals) in enumerate(lb.iter_rows()):
+            jk = jl[i]
             touched[jk] = None
             l_updates.append((jk, k, d, vals))
+        jr = jks_r.tolist()
         r_updates = []
-        for k, d, vals in rb.iter_rows():
-            jk = self._jk(vals, self.r_on_idx)
+        for i, (k, d, vals) in enumerate(rb.iter_rows()):
+            jk = jr[i]
             touched[jk] = None
             r_updates.append((jk, k, d, vals))
         before = {jk: self._outputs_for_jk(jk) for jk in touched}

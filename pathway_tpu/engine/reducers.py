@@ -126,6 +126,27 @@ class _MultisetAcc(Accumulator):
         else:
             self.items[k] = c
 
+    def update_bulk(self, argcols: list[list], diffs: list[int]) -> None:
+        """Apply one group's slice of a batch in a single tight loop (the
+        columnar groupby path, engine/nodes.py). ERROR args feed
+        poisoned_count exactly like the per-row path; returns nothing —
+        state mutates in place."""
+        items = self.items
+        skip = self.spec.skip_nones
+        for k in zip(*argcols, diffs):
+            d = k[-1]
+            args = k[:-1]
+            if skip and args[0] is None:
+                continue
+            if any(a is ERROR for a in args):
+                self.poisoned_count += d
+                continue
+            c = items.get(args, 0) + d
+            if c == 0:
+                items.pop(args, None)
+            else:
+                items[args] = c
+
 
 def _sort_key(v: Any) -> Any:
     # heterogeneous-safe sort key
