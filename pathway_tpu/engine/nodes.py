@@ -1041,6 +1041,13 @@ class SortNode(Node):
 
 
 class SortExec(NodeExec):
+    """Incremental prev/next maintenance: a sorted (sortval, rowkey) list
+    per instance, updated by bisect so a tick touching c rows costs
+    O(c log n) comparisons and emits only the changed pointer pairs — the
+    microbatch analog of the reference's pointer-maintaining prev_next
+    operator (src/engine/dataflow/operators/prev_next.rs:1-891). Ticks that
+    change a large fraction of an instance fall back to one full sort."""
+
     def __init__(self, node: SortNode):
         super().__init__(node)
         in_cols = node.inputs[0].column_names
@@ -1050,41 +1057,254 @@ class SortExec(NodeExec):
         )
         # instance -> {rowkey: sortval}
         self.instances: dict[Any, dict[int, Any]] = {}
+        # instance -> maintained sorted list[(sortval, rowkey)]
+        self.orders: dict[Any, list] = {}
         # instance -> {rowkey: (prev, next)} previously emitted
         self.emitted: dict[Any, dict[int, tuple]] = {}
+        # instances that ever saw a NaN sort key: bisect cannot locate NaN
+        # tuples, so those instances stay on the full-rebuild path
+        self.nan_insts: set = set()
+
+    def _emit_diff(self, out_rows, emitted, k, new):
+        old = emitted.get(k)
+        if old == new:
+            return
+        if old is not None:
+            out_rows.append((k, -1, old))
+        if new is not None:
+            out_rows.append((k, 1, new))
+            emitted[k] = new
+        else:
+            emitted.pop(k, None)
+
+    def _rebuild(self, out_rows, rows, order, emitted):
+        order[:] = sorted((v, k) for k, v in rows.items())
+        new_vals: dict[int, tuple] = {}
+        n = len(order)
+        for i, (_, k) in enumerate(order):
+            prev_k = Pointer(order[i - 1][1]) if i > 0 else None
+            next_k = Pointer(order[i + 1][1]) if i < n - 1 else None
+            new_vals[k] = (prev_k, next_k)
+        for k in set(emitted) | set(new_vals):
+            self._emit_diff(out_rows, emitted, k, new_vals.get(k))
+
+    def _drop_entry(self, order, affected, v, k, bisect_left) -> None:
+        idx = bisect_left(order, (v, k))
+        if idx < len(order) and order[idx] == (v, k):
+            order.pop(idx)
+            # the two rows that now become neighbors
+            if idx > 0:
+                affected.add(order[idx - 1][1])
+            if idx < len(order):
+                affected.add(order[idx][1])
+
+    def _incremental(self, out_rows, rows, order, emitted, chs, bisect_left):
+        affected: set[int] = set()
+        deleted: set[int] = set()
+        for k, d, v in chs:
+            if d > 0:
+                if k in rows:
+                    # upsert / repeated insert: drop the stale order entry
+                    # first or it would linger as a ghost (the rows dict is
+                    # last-write-wins, matching the full-rebuild path)
+                    self._drop_entry(order, affected, rows[k], k, bisect_left)
+                rows[k] = v
+                idx = bisect_left(order, (v, k))
+                # the two rows that will now point at k
+                if idx > 0:
+                    affected.add(order[idx - 1][1])
+                if idx < len(order):
+                    affected.add(order[idx][1])
+                order.insert(idx, (v, k))
+                affected.add(k)
+                deleted.discard(k)
+            else:
+                if k not in rows:
+                    continue
+                v_old = rows.pop(k)
+                self._drop_entry(order, affected, v_old, k, bisect_left)
+                deleted.add(k)
+                affected.discard(k)
+        for k in deleted:
+            self._emit_diff(out_rows, emitted, k, None)
+        n = len(order)
+        for k in affected:
+            v = rows.get(k)
+            if v is None and k not in rows:
+                continue  # re-deleted within this tick
+            idx = bisect_left(order, (v, k))
+            prev_k = Pointer(order[idx - 1][1]) if idx > 0 else None
+            next_k = Pointer(order[idx + 1][1]) if idx < n - 1 else None
+            self._emit_diff(out_rows, emitted, k, (prev_k, next_k))
 
     def process(self, t, inputs):
-        touched_instances: dict[Any, None] = {}
+        from bisect import bisect_left
+
+        changes: dict[Any, list] = {}
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 inst = vals[self.i_idx] if self.i_idx is not None else None
-                rows = self.instances.setdefault(inst, {})
-                if d > 0:
-                    rows[k] = vals[self.k_idx]
-                else:
-                    rows.pop(k, None)
-                touched_instances[inst] = None
-        out_rows = []
-        for inst in touched_instances:
-            rows = self.instances.get(inst, {})
-            order = sorted(rows.items(), key=lambda kv: (kv[1], kv[0]))
-            new_vals: dict[int, tuple] = {}
-            for i, (k, _) in enumerate(order):
-                prev_k = Pointer(order[i - 1][0]) if i > 0 else None
-                next_k = Pointer(order[i + 1][0]) if i < len(order) - 1 else None
-                new_vals[k] = (prev_k, next_k)
+                changes.setdefault(inst, []).append((k, d, vals[self.k_idx]))
+        out_rows: list[tuple[int, int, tuple]] = []
+        for inst, chs in changes.items():
+            rows = self.instances.setdefault(inst, {})
+            order = self.orders.setdefault(inst, [])
             emitted = self.emitted.setdefault(inst, {})
-            for k in set(emitted) | set(new_vals):
-                old = emitted.get(k)
-                new = new_vals.get(k)
-                if old == new:
-                    continue
-                if old is not None:
-                    out_rows.append((k, -1, old))
-                    del emitted[k]
-                if new is not None:
-                    out_rows.append((k, 1, new))
-                    emitted[k] = new
+            if inst not in self.nan_insts and any(
+                isinstance(v, float) and v != v for _k, _d, v in chs
+            ):
+                self.nan_insts.add(inst)
+            if inst in self.nan_insts or len(chs) * 8 >= len(order) + 1:
+                for k, d, v in chs:
+                    if d > 0:
+                        rows[k] = v
+                    else:
+                        rows.pop(k, None)
+                self._rebuild(out_rows, rows, order, emitted)
+            else:
+                self._incremental(
+                    out_rows, rows, order, emitted, chs, bisect_left
+                )
+            if not rows:
+                self.instances.pop(inst, None)
+                self.orders.pop(inst, None)
+                self.emitted.pop(inst, None)
+                self.nan_insts.discard(inst)
+        if not out_rows:
+            return []
+        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+
+
+# ---------------------------------------------------------------------------
+# Gradual broadcast
+
+
+class GradualBroadcastNode(Node):
+    """Roll out a changing scalar (model version, threshold, ...) to all
+    rows without mass retraction (reference:
+    src/engine/dataflow/operators/gradual_broadcast.rs:1-490, API at
+    python/pathway/internals/table.py:631). The threshold table supplies a
+    (lower, value, upper) triplet; each data row gets apx_value = upper if
+    its key hash falls below the (value-lower)/(upper-lower) fraction of
+    the key space, else lower — so as `value` sweeps lower->upper, rows
+    flip individually instead of all at once."""
+
+    def __init__(self, data: Node, thr: Node):
+        super().__init__([data, thr], ["apx_value"])
+
+    def make_exec(self):
+        return GradualBroadcastExec(self)
+
+
+_KEY_SPACE = float(1 << 64)
+
+
+class GradualBroadcastExec(NodeExec):
+    def __init__(self, node: GradualBroadcastNode):
+        super().__init__(node)
+        self.counts: dict[int, int] = {}  # data rowkey -> multiplicity
+        self.keys_sorted: list[int] = []
+        self.thr_state: dict[int, list] = {}  # thr rowkey -> [vals, count]
+        self.triplet: tuple | None = None
+        self.emitted: dict[int, Any] = {}  # data rowkey -> apx value
+
+    @staticmethod
+    def _threshold(triplet) -> int:
+        lower, value, upper = triplet
+        if upper == lower:
+            frac = 1.0
+        else:
+            frac = (value - lower) / (upper - lower)
+        frac = min(max(frac, 0.0), 1.0)
+        return int(frac * _KEY_SPACE)
+
+    @staticmethod
+    def _apx(k: int, triplet, thr: int):
+        return triplet[2] if k < thr else triplet[0]
+
+    def process(self, t, inputs):
+        from bisect import bisect_left, insort
+
+        out_rows: list[tuple[int, int, tuple]] = []
+        # 1) data-side changes evaluated under the current triplet
+        #    (reference: input1 batches apply with the pre-update triplet)
+        thr_now = self._threshold(self.triplet) if self.triplet else None
+        for b in inputs[0]:
+            for k, d in zip(b.keys.tolist(), b.diffs.tolist()):
+                c = self.counts.get(k, 0)
+                nc = c + d
+                if c <= 0 < nc:
+                    insort(self.keys_sorted, k)
+                    if self.triplet is not None:
+                        v = self._apx(k, self.triplet, thr_now)
+                        out_rows.append((k, 1, (v,)))
+                        self.emitted[k] = v
+                elif nc <= 0 < c:
+                    idx = bisect_left(self.keys_sorted, k)
+                    if idx < len(self.keys_sorted) and self.keys_sorted[idx] == k:
+                        self.keys_sorted.pop(idx)
+                    old = self.emitted.pop(k, None)
+                    if old is not None:
+                        out_rows.append((k, -1, (old,)))
+                if nc == 0:
+                    self.counts.pop(k, None)
+                else:
+                    self.counts[k] = nc
+        # 2) threshold-side changes
+        last_inserted = None
+        thr_changed = False
+        for b in inputs[1]:
+            for k, d, vals in b.iter_rows():
+                thr_changed = True
+                e = self.thr_state.get(k)
+                if e is None:
+                    if d != 0:
+                        self.thr_state[k] = [vals, d]
+                else:
+                    e[1] += d
+                    if d > 0:
+                        e[0] = vals
+                    if e[1] == 0:
+                        del self.thr_state[k]
+                if d > 0:
+                    last_inserted = vals
+        if thr_changed:
+            if last_inserted is not None:
+                new_triplet = tuple(last_inserted[:3])
+            elif self.thr_state:
+                new_triplet = tuple(next(iter(self.thr_state.values()))[0][:3])
+            else:
+                new_triplet = self.triplet  # emptied: keep last (ref. keeps)
+            if new_triplet is not None and new_triplet != self.triplet:
+                old_triplet = self.triplet
+                self.triplet = new_triplet
+                thr_new = self._threshold(new_triplet)
+                if old_triplet is None:
+                    for k in self.keys_sorted:
+                        v = self._apx(k, new_triplet, thr_new)
+                        out_rows.append((k, 1, (v,)))
+                        self.emitted[k] = v
+                else:
+                    # both apx functions are two-valued step functions with
+                    # one breakpoint, so they differ on at most 3 contiguous
+                    # key ranges — emit diffs only there (the "gradual"
+                    # property: a value sweep touches only the swept range)
+                    thr_old = self._threshold(old_triplet)
+                    t1, t2 = min(thr_old, thr_new), max(thr_old, thr_new)
+                    ks = self.keys_sorted
+                    for seg_lo, seg_hi in ((0, t1), (t1, t2), (t2, 1 << 64)):
+                        if seg_lo >= seg_hi:
+                            continue
+                        old_v = self._apx(seg_lo, old_triplet, thr_old)
+                        new_v = self._apx(seg_lo, new_triplet, thr_new)
+                        if old_v == new_v:
+                            continue
+                        lo_i = bisect_left(ks, seg_lo)
+                        hi_i = bisect_left(ks, seg_hi)
+                        for k in ks[lo_i:hi_i]:
+                            out_rows.append((k, -1, (self.emitted[k],)))
+                            out_rows.append((k, 1, (new_v,)))
+                            self.emitted[k] = new_v
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]

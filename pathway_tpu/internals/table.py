@@ -657,6 +657,32 @@ class Table(Joinable):
             self._universe,
         )
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column: ColumnExpression,
+        value_column: ColumnExpression,
+        upper_column: ColumnExpression,
+    ) -> "Table":
+        """self + apx_value, where apx_value rolls from `lower` to `upper`
+        gradually as `value` sweeps the [lower, upper] interval (reference:
+        Table._gradual_broadcast, python/pathway/internals/table.py:631;
+        operator: src/engine/dataflow/operators/gradual_broadcast.rs)."""
+        thr_prep = threshold_table._build_rowwise(
+            {
+                "_lower": lower_column,
+                "_value": value_column,
+                "_upper": upper_column,
+            }
+        )
+        node = nodes.GradualBroadcastNode(self._node, thr_prep._node)
+        apx = Table._from_node(
+            node,
+            {"apx_value": thr_prep._schema["_value"].dtype},
+            self._universe,
+        )
+        return self.with_columns(apx)
+
     def diff(
         self,
         timestamp: ColumnExpression,

@@ -741,3 +741,198 @@ def test_bulk_join_matches_per_row():
             if lk[i] == rk[jr]:
                 expected.append((i, 1000 + jr))
     assert got == sorted(expected)
+
+
+def test_sort_incremental_o_changes():
+    """SortExec maintains prev/next incrementally: after a 100k-row bulk
+    load, a tick updating 100 rows must be orders of magnitude cheaper
+    than the load tick and emit only the touched pointer pairs
+    (reference: prev_next.rs pointer maintenance in O(changes))."""
+    import time as _time
+
+    import numpy as np
+
+    from pathway_tpu.engine.nodes import InputNode, SortNode
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.runtime import StaticSource
+
+    n = 100_000
+    rng = np.random.default_rng(5)
+    vals = rng.permutation(n)
+
+    node_in = InputNode(StaticSource(["v"]), ["v"])
+    sort_node = SortNode(node_in, "v", None)
+    ex = sort_node.make_exec()
+
+    load = DiffBatch.from_rows(
+        [(k + 1, 1, (int(vals[k]),)) for k in range(n)], ["v"]
+    )
+    t0 = _time.perf_counter()
+    out0 = ex.process(0, [[load]])
+    t_load = _time.perf_counter() - t0
+    assert sum(len(b) for b in out0) == n
+
+    # 100 value updates (retract + reinsert with new sortval)
+    upd_rows = []
+    for i in range(100):
+        k = i * 997 + 1
+        upd_rows.append((k, -1, (int(vals[k - 1]),)))
+        upd_rows.append((k, 1, (int(vals[k - 1]) + n,)))
+    upd = DiffBatch.from_rows(upd_rows, ["v"])
+    t0 = _time.perf_counter()
+    out1 = ex.process(2, [[upd]])
+    t_upd = _time.perf_counter() - t0
+
+    n_changed = sum(len(b) for b in out1)
+    # each moved row touches itself + up to 2 old and 2 new neighbors,
+    # each emitting a retraction+insertion — far below n
+    assert 0 < n_changed < 100 * 12
+    # O(changes): the update tick must be dramatically cheaper than the
+    # bulk tick (conservative 20x bound to stay flake-proof in CI)
+    assert t_upd < t_load / 20, (t_load, t_upd)
+
+
+def test_sort_incremental_matches_rebuild():
+    """Pointer output after incremental updates equals a from-scratch sort."""
+    import numpy as np
+
+    rng = np.random.default_rng(6)
+
+    class S(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        v: int
+
+    n = 600
+    vals = [int(x) for x in rng.integers(0, 10_000, size=n)]
+    rows = [(i, vals[i], 0, 1) for i in range(n)]
+    # move 40 rows to new positions at t=2 (small tick -> incremental path)
+    for i in range(0, 80, 2):
+        rows.append((i, vals[i], 2, -1))
+        rows.append((i, vals[i] + 20_000, 2, 1))
+    t = pw.debug.table_from_rows(S, rows, is_stream=True)
+    res = t.sort(key=t.v)
+    _keys, cols = pw.debug.table_to_dicts(res)
+
+    final = {i: (vals[i] + 20_000 if i < 80 and i % 2 == 0 else vals[i])
+             for i in range(n)}
+    # source values per engine row key, from the same deterministic graph
+    _k2, src_cols = pw.debug.table_to_dicts(t)
+    vmap = src_cols["v"]
+    prevs = cols["prev"]
+    nexts = cols["next"]
+    assert len(prevs) == n
+    heads = [k for k, p in prevs.items() if p is None]
+    assert len(heads) == 1
+    # walk the chain: every row exactly once, values non-decreasing, and
+    # the visited value sequence equals the expected full re-sort
+    walked = []
+    cur = heads[0]
+    while cur is not None:
+        walked.append(vmap[cur])
+        nxt = nexts[cur]
+        cur = int(nxt) if nxt is not None else None
+    assert len(walked) == n
+    assert walked == sorted(final.values())
+
+
+def test_gradual_broadcast_static():
+    """apx_value splits rows ~proportionally to (value-lower)/(upper-lower)
+    (reference: python/pathway/tests/test_gradual_broadcast.py;
+    operator: src/engine/dataflow/operators/gradual_broadcast.rs)."""
+    class S(pw.Schema):
+        val: int
+
+    class Thr(pw.Schema):
+        lower: float
+        value: float
+        upper: float
+
+    n = 500
+    tab = pw.debug.table_from_rows(S, [(i,) for i in range(n)])
+    thr = pw.debug.table_from_rows(Thr, [(20.5, 29.5, 30.5)])
+    ext = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    assert ext.column_names() == ["val", "apx_value"]
+    _keys, cols = pw.debug.table_to_dicts(ext)
+    vals = list(cols["apx_value"].values())
+    assert len(vals) == n
+    assert set(vals) <= {20.5, 30.5}
+    hi = sum(1 for v in vals if v == 30.5)
+    # fraction = 0.9; key hashes are uniform, allow generous slack
+    assert 0.8 * n < hi < n
+
+
+def test_gradual_broadcast_sweep_no_mass_retraction():
+    """As `value` sweeps lower->upper each row flips from lower to upper
+    exactly once — a 5-step sweep must NOT retract everything per step."""
+    from pathway_tpu.debug import _run_capture
+
+    class S(pw.Schema):
+        val: int
+
+    class Thr(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        lower: float
+        value: float
+        upper: float
+
+    n = 400
+    tab = pw.debug.table_from_rows(S, [(i,) for i in range(n)])
+    # single logical threshold row upserted over 6 times
+    thr_rows = []
+    for step in range(6):
+        t = 2 * step
+        if step > 0:
+            thr_rows.append((0, 0.0, float(step - 1), 5.0, t, -1))
+        thr_rows.append((0, 0.0, float(step), 5.0, t, 1))
+    thr = pw.debug.table_from_rows(Thr, thr_rows, is_stream=True)
+    ext = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    cap = _run_capture([ext])[0]
+    vals = [v[1] for v in cap.rows.values()]
+    assert len(vals) == n
+    # value=5.0 == upper at the end -> every row reads upper
+    assert all(v == 5.0 for v in vals)
+    # each row: 1 initial insert + <=2 events per single flip (retract +
+    # re-insert), plus slack; a mass-retraction implementation would emit
+    # ~6 * 2n events
+    assert len(cap.updates) < 4.5 * n, len(cap.updates)
+
+
+def test_sort_incremental_upsert_and_duplicate():
+    """Incremental-path regression: an upsert without prior retraction and
+    a repeated +1 for the same key must not leave ghost entries in the
+    maintained order or emit self-pointing rows."""
+    from pathway_tpu.engine.nodes import InputNode, SortNode
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.runtime import StaticSource
+
+    node_in = InputNode(StaticSource(["v"]), ["v"])
+    ex = SortNode(node_in, "v", None).make_exec()
+
+    load = DiffBatch.from_rows(
+        [(k, 1, (k * 10,)) for k in range(1, 101)], ["v"]
+    )
+    ex.process(0, [[load]])
+    assert len(ex.orders[None]) == 100
+
+    # upsert key 5 to a new position WITHOUT a retraction (small tick ->
+    # incremental path), plus a duplicate +1 for key 7 at its same value
+    upd = DiffBatch.from_rows([(5, 1, (2000,)), (7, 1, (70,))], ["v"])
+    out = ex.process(2, [[upd]])
+    assert len(ex.orders[None]) == 100  # no ghosts
+    assert ex.instances[None][5] == 2000
+    for b in out:
+        for k, d, vals in b.iter_rows():
+            if d > 0:
+                prev_k, next_k = vals
+                assert prev_k is None or int(prev_k) != k
+                assert next_k is None or int(next_k) != k
+    # key 5 is now last: its next is None and its prev is key 100
+    emitted5 = ex.emitted[None][5]
+    assert emitted5[1] is None and int(emitted5[0]) == 100
+
+    # retract the upserted row: order shrinks cleanly
+    out2 = ex.process(4, [[DiffBatch.from_rows([(5, -1, (2000,))], ["v"])]])
+    assert len(ex.orders[None]) == 99
+    assert 5 not in ex.instances[None]
+    # key 100 becomes the tail again
+    assert ex.emitted[None][100][1] is None
