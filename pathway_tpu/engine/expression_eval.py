@@ -485,17 +485,9 @@ def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndar
 
         return await asyncio.gather(*[one(i) for i in range(n)])
 
-    try:
-        loop = asyncio.get_running_loop()
-    except RuntimeError:
-        loop = None
-    if loop is not None:
-        import concurrent.futures
+    from pathway_tpu.internals.udfs import run_async_blocking
 
-        with concurrent.futures.ThreadPoolExecutor(1) as pool:
-            results = pool.submit(lambda: asyncio.run(run_all())).result()
-    else:
-        results = asyncio.run(run_all())
+    results = run_async_blocking(run_all)
     out = np.empty(n, dtype=object)
     for i, r in enumerate(results):
         out[i] = r

@@ -66,6 +66,20 @@ def async_options(**kwargs):
     return wrapper
 
 
+def run_async_blocking(coro_factory: Callable[[], Any]) -> Any:
+    """Run a coroutine to completion from sync code, whether or not an
+    event loop is already running in this thread (shared by the expression
+    evaluator's async apply and AsyncTransformer)."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro_factory())
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        return pool.submit(lambda: asyncio.run(coro_factory())).result()
+
+
 def coerce_async(fn: Callable) -> Callable:
     if asyncio.iscoroutinefunction(fn):
         return fn

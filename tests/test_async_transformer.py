@@ -1,0 +1,196 @@
+"""AsyncTransformer semantics (reference:
+python/pathway/stdlib/utils/async_transformer.py:281-511 and its tests):
+successful/failed split, instance consistency, options, retractions."""
+
+import asyncio
+
+import pytest
+
+import pathway_tpu as pw
+
+
+class OutSchema(pw.Schema):
+    ret: int
+
+
+class InSchema(pw.Schema):
+    value: int
+
+
+def _input(rows):
+    return pw.debug.table_from_rows(InSchema, rows)
+
+
+def test_basic_success():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            await asyncio.sleep(0.001)
+            return {"ret": value + 1}
+
+    t = _input([(42,), (44,)])
+    res = Inc(input_table=t).successful
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert sorted(cols["ret"].values()) == [43, 45]
+
+
+def test_failed_split():
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            if value % 2 == 0:
+                raise RuntimeError("boom")
+            return {"ret": value * 10}
+
+    t = _input([(1,), (2,), (3,), (4,)])
+    tr = Flaky(input_table=t)
+    _k, ok = pw.debug.table_to_dicts(tr.successful)
+    assert sorted(ok["ret"].values()) == [10, 30]
+    pw.internals.parse_graph.G.clear()
+    t = _input([(1,), (2,), (3,), (4,)])
+    tr = Flaky(input_table=t)
+    _k2, bad = pw.debug.table_to_dicts(tr.failed)
+    assert len(bad["ret"]) == 2
+    assert all(v is None for v in bad["ret"].values())
+
+
+def test_finished_status_column():
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            if value == 2:
+                raise RuntimeError("boom")
+            return {"ret": value}
+
+    t = _input([(1,), (2,)])
+    fin = Flaky(input_table=t).finished
+    _k, cols = pw.debug.table_to_dicts(fin)
+    assert sorted(cols["_async_status"].values()) == ["-FAILURE-", "-SUCCESS-"]
+
+
+def test_instance_consistency():
+    """A failure poisons same-instance successes (reference `failed` doc:
+    rows executed successfully whose instance saw a failure at <= time are
+    reported as failed)."""
+
+    class InSchema2(pw.Schema):
+        value: int
+        group: int
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            if value == 2:
+                raise RuntimeError("boom")
+            return {"ret": value}
+
+    rows = [(1, 0), (2, 0), (3, 1)]
+    t = pw.debug.table_from_rows(InSchema2, rows)
+    tr = Flaky(input_table=t, instance=t.group)
+    _k, cols = pw.debug.table_to_dicts(tr.successful)
+    # group 0 contains the failing row -> row (1, 0) must not be successful
+    assert list(cols["ret"].values()) == [3]
+
+
+def test_bad_result_schema_is_failure():
+    class Wrong(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            return {"unexpected": 1}
+
+    t = _input([(7,)])
+    tr = Wrong(input_table=t)
+    _k, cols = pw.debug.table_to_dicts(tr.failed)
+    assert len(cols["ret"]) == 1
+
+
+def test_signature_check():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, wrong_name) -> dict:  # pragma: no cover
+            return {"ret": 0}
+
+    with pytest.raises(TypeError, match="wrong_name"):
+        Inc(input_table=_input([(1,)]))
+
+
+def test_with_options_timeout_and_retry():
+    calls = {"n": 0}
+
+    class Slow(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("transient")
+            return {"ret": value}
+
+    t = _input([(5,)])
+    tr = Slow(input_table=t).with_options(
+        capacity=2,
+        retry_strategy=pw.udfs.FixedDelayRetryStrategy(
+            max_retries=4, delay_ms=1
+        ),
+    )
+    _k, cols = pw.debug.table_to_dicts(tr.successful)
+    assert list(cols["ret"].values()) == [5]
+    assert calls["n"] == 3
+
+
+def test_retraction_reemits():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, i, value) -> dict:
+            return {"ret": value + 1}
+
+    class InK(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        value: int
+
+    rows = [(0, 10, 0, 1), (1, 20, 0, 1), (0, 10, 2, -1)]
+    t = pw.debug.table_from_rows(InK, rows, is_stream=True)
+    tr = Inc(input_table=t)
+    _k, cols = pw.debug.table_to_dicts(tr.successful)
+    assert list(cols["ret"].values()) == [21]
+
+
+def test_open_close_called():
+    seen = []
+
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            return {"ret": value}
+
+        def open(self):
+            seen.append("open")
+
+        def close(self):
+            seen.append("close")
+
+    t = _input([(1,)])
+    pw.debug.table_to_dicts(Inc(input_table=t).successful)
+    assert seen == ["open", "close"]
+
+
+def test_same_tick_insert_retract_no_ghost():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, i, value) -> dict:
+            return {"ret": value + 1}
+
+    class InK(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        value: int
+
+    rows = [(0, 10, 0, 1), (0, 10, 0, -1), (1, 20, 0, 1)]
+    t = pw.debug.table_from_rows(InK, rows, is_stream=True)
+    _k, cols = pw.debug.table_to_dicts(Inc(input_table=t).successful)
+    assert list(cols["ret"].values()) == [21]
+
+
+def test_cache_strategy_memoizes_results():
+    calls = {"n": 0}
+
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:
+            calls["n"] += 1
+            return {"ret": value + 1}
+
+    t = _input([(5,), (5,), (6,)])
+    tr = Inc(input_table=t).with_options(
+        cache_strategy=pw.udfs.InMemoryCache()
+    )
+    _k, cols = pw.debug.table_to_dicts(tr.successful)
+    assert sorted(cols["ret"].values()) == [6, 6, 7]
+    assert calls["n"] == 2  # (5,) invoked once, cached for the twin row
