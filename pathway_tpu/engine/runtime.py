@@ -345,6 +345,11 @@ class Runtime:
         if self._frontier_base is None:
             self._frontier_base = t
         rel = t - self._frontier_base
+        if rel > (1 << 30):
+            # re-base before the int32 payload could overflow (~24.8 days
+            # of uptime); the consensus value is monotone either way
+            self._frontier_base = t
+            rel = 0
         n = mesh.shape[axis]
         local = jax.device_put(
             jnp.full((n,), rel, jnp.int32), NamedSharding(mesh, P(axis))

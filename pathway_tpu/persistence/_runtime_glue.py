@@ -98,6 +98,9 @@ class PersistenceDriver:
         self._commits_since_snapshot = 0
         self.replayed_events = 0  # observability: bounded-replay assertions
         self.restored_from_snapshot = False
+        # set when the latest snapshot attempt aborted on an unpicklable
+        # exec ("<class>#<ordinal>"); also mirrored into metadata
+        self.degraded_snapshot: str | None = None
 
     # --- commit path ----------------------------------------------------------
 
@@ -210,6 +213,7 @@ class PersistenceDriver:
         compaction)."""
         gen = int(meta.get("state", {}).get("gen", 0)) + 1
         nodes: dict[str, str] = {}
+        written: list[str] = []
         for ordinal, cls, ex in self._node_ordinals():
             try:
                 state = ex.state_dict()
@@ -225,9 +229,21 @@ class PersistenceDriver:
                     cls,
                     ordinal,
                 )
+                # clean up this aborted generation's files so they don't
+                # orphan until a later successful snapshot, and record the
+                # degraded mode durably so operators can see why the input
+                # log keeps growing (ADVICE r2: all-or-nothing snapshot)
+                for key in written:
+                    self.store.remove(key)
+                self.degraded_snapshot = f"{cls}#{ordinal}"
+                meta["snapshot_degraded"] = self.degraded_snapshot
                 return None
-            self.store.put(f"states/gen-{gen:06d}/{ordinal:05d}.pkl", blob)
+            key = f"states/gen-{gen:06d}/{ordinal:05d}.pkl"
+            self.store.put(key, blob)
+            written.append(key)
             nodes[str(ordinal)] = cls
+        self.degraded_snapshot = None
+        meta.pop("snapshot_degraded", None)
         # snapshot covers everything up to and including the last processed
         # tick; all flushed chunks hold rows with time <= this
         return {"gen": gen, "time": self._last_real_time, "nodes": nodes}

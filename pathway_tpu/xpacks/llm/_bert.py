@@ -124,9 +124,22 @@ def _find_model_dir(name_or_path: str) -> str | None:
         "HF_HOME", os.path.expanduser("~/.cache/huggingface")
     )
     slug = "models--" + name_or_path.replace("/", "--")
-    snaps = os.path.join(cache, "hub", slug, "snapshots")
+    root = os.path.join(cache, "hub", slug)
+    snaps = os.path.join(root, "snapshots")
     if os.path.isdir(snaps):
-        for snap in sorted(os.listdir(snaps), reverse=True):
+        # prefer the snapshot the refs/main file points at (the current
+        # one); commit-hash names carry no order, so fall back to mtime
+        candidates: list[str] = []
+        ref_file = os.path.join(root, "refs", "main")
+        if os.path.isfile(ref_file):
+            with open(ref_file) as f:
+                candidates.append(f.read().strip())
+        candidates += sorted(
+            os.listdir(snaps),
+            key=lambda s: os.path.getmtime(os.path.join(snaps, s)),
+            reverse=True,
+        )
+        for snap in candidates:
             d = os.path.join(snaps, snap)
             if os.path.exists(os.path.join(d, "model.safetensors")):
                 return d

@@ -92,10 +92,20 @@ class WordPieceTokenizer:
         self.vocab_size = len(self.vocab)
         self.lowercase = lowercase
         self.max_word_chars = max_word_chars
+        missing = [
+            tok for tok in ("[UNK]", "[CLS]", "[SEP]") if tok not in self.vocab
+        ]
+        if missing:
+            # guessing ids here would silently produce garbage token
+            # streams (ADVICE r2) — a BERT vocab without these is broken
+            raise ValueError(
+                f"vocab file {vocab_file!r} is missing required special "
+                f"tokens {missing}"
+            )
         self.pad_id = self.vocab.get("[PAD]", 0)
-        self.unk_id = self.vocab.get("[UNK]", 1)
-        self.cls_id = self.vocab.get("[CLS]", 2)
-        self.sep_id = self.vocab.get("[SEP]", 3)
+        self.unk_id = self.vocab["[UNK]"]
+        self.cls_id = self.vocab["[CLS]"]
+        self.sep_id = self.vocab["[SEP]"]
         # BertTokenizer's never_split set: literal special tokens in the
         # text pass through un-lowercased and un-split
         self.special_tokens = {
