@@ -18,7 +18,7 @@ from pathway_tpu.io._utils import add_writer, jsonable, require
 from pathway_tpu.io.kafka import _parse_message
 
 
-class _NatsSource(StreamingSource):  # pragma: no cover - needs server
+class _NatsSource(StreamingSource):
     def __init__(self, uri, topic, format, column_names, schema):
         super().__init__(column_names)
         require("nats", "nats")
@@ -90,7 +90,7 @@ def read(
 
 def write(
     table: Table, uri: str, topic: str, *, format: str = "json", **kwargs: Any
-) -> None:  # pragma: no cover - needs server
+) -> None:
     require("nats", "nats")
     import asyncio
 
