@@ -73,6 +73,15 @@ from pathway_tpu.internals.iterate import iterate, iterate_universe
 from pathway_tpu.internals.yaml_loader import load_yaml
 import pathway_tpu.persistence as persistence
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.internals.row_transformer import (
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.sql import sql
 
 
@@ -157,6 +166,13 @@ __all__ = [
     "JoinResult",
     "JoinMode",
     "AsyncTransformer",
+    "ClassArg",
+    "attribute",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
     "reducers",
     "schema_from_types",
     "schema_from_dict",

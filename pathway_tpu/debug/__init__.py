@@ -158,7 +158,9 @@ def table_from_markdown(
         d = int(parsed[diff_idx]) if diff_idx is not None else 1
         vals = tuple(parsed[i] for i in value_cols_idx)
         if ids is not None:
-            key = int(ref_scalar(ids[ri]))
+            # hash the PARSED label ("1" -> int 1) so explicit markdown ids
+            # match pointer_from(<value>) — the reference's id derivation
+            key = int(ref_scalar(_parse_value(ids[ri])))
         elif id_from:
             key = int(
                 ref_scalar(*[vals[col_names.index(c)] for c in id_from])

@@ -145,11 +145,6 @@ def test_kafka_read_seek_offsets(monkeypatch):
     monkeypatch.setitem(
         sys.modules, "confluent_kafka", _fake_confluent_kafka(broker)
     )
-    from pathway_tpu.io.kafka import _KafkaSource
-
-    src = _KafkaSource({}, "t2", "plaintext", ["data"], None)
-    src.seek({"offsets": {0: 2}})  # first two already consumed
-
     t = pw.io.kafka.read({}, topic="t2", format="plaintext")
     t._node.source.seek({"offsets": {0: 2}})
     seen = _run_streaming_until(t, 1)
