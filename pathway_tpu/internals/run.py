@@ -35,6 +35,11 @@ def run(
     G = parse_graph.G
     if not G.outputs:
         return
+    # join the process group when `pathway spawn -n N` launched us
+    # (reference env contract PATHWAY_PROCESSES/PROCESS_ID, config.rs:88)
+    from pathway_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
     runtime = Runtime(G.outputs, autocommit_ms=autocommit_duration_ms)
     G.runtime = runtime
     G.last_runtime = runtime

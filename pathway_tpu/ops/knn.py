@@ -152,6 +152,14 @@ def cosine_topk(queries, corpus, valid, k):
     return dense_topk(queries, corpus, valid, k, metric="cosine")
 
 
+def shard_base_indices(n: int, n_shards: int) -> np.ndarray:
+    """Per-row base offset of its shard (local->global index mapping in the
+    sharded merge); single source for sharded_topk and the multi-process
+    sharded_topk_global."""
+    per = n // n_shards
+    return (np.arange(n) // per * per).astype(np.int32)
+
+
 @functools.partial(
     jax.jit, static_argnames=("k", "metric", "bf16", "mesh", "axis")
 )
@@ -198,8 +206,7 @@ def sharded_topk(
     n = corpus.shape[0]
     n_shards = mesh.shape[axis]
     assert n % n_shards == 0, "pad corpus to a multiple of the shard count"
-    per = n // n_shards
-    base_idx = (np.arange(n) // per * per).astype(np.int32)
+    base_idx = shard_base_indices(n, n_shards)
     return _sharded_topk_impl(
         queries, corpus, valid, jnp.asarray(base_idx), k, metric, bf16, mesh, axis
     )

@@ -33,7 +33,11 @@ def make_mesh(
     if backend is not None:
         devices = jax.devices(backend)
     else:
-        devices = jax.devices()
+        # LOCAL devices only: the engine mesh (host-driven per-tick
+        # device_put/np.asarray round trips) must never include another
+        # process's non-addressable devices; cross-process meshes are
+        # built explicitly via parallel.distributed.global_mesh
+        devices = jax.local_devices()
         if n_devices is not None and len(devices) < n_devices:
             try:
                 cpu = jax.devices("cpu")

@@ -1,0 +1,116 @@
+"""Multi-process execution smoke tests (VERDICT r2 item 9): two real OS
+processes join via jax.distributed (gloo CPU collectives) and run the
+corpus-sharded KNN with a true cross-process collective merge, asserting
+exact equality with a single-process reference. Pattern: reference
+integration_tests/wordcount spawns real process groups."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pathway_tpu.parallel import distributed as dist
+
+    assert dist.maybe_initialize(), "expected multi-process mode"
+    assert jax.process_count() == 2, jax.process_count()
+
+    pid = jax.process_index()
+    n_global, dim, k = 64, 16, 5
+    rng = np.random.default_rng(0)
+    corpus = rng.normal(size=(n_global, dim)).astype(np.float32)
+    valid = np.ones(n_global, bool)
+    valid[7] = False
+    queries = rng.normal(size=(3, dim)).astype(np.float32)
+
+    half = n_global // 2
+    lo, hi = pid * half, (pid + 1) * half
+    sc, ix = dist.sharded_topk_global(
+        queries, corpus[lo:hi], valid[lo:hi], k, metric="cosine"
+    )
+
+    # single-device reference on the full corpus
+    from pathway_tpu.ops.knn import dense_topk
+    import jax.numpy as jnp
+    s_ref, i_ref = dense_topk(
+        jnp.asarray(queries), jnp.asarray(corpus), jnp.asarray(valid),
+        k, metric="cosine",
+    )
+    assert (np.asarray(i_ref) == ix).all(), (np.asarray(i_ref), ix)
+    assert np.allclose(np.asarray(s_ref), sc, atol=1e-5)
+    print(f"WORKER-OK pid={pid}", flush=True)
+    """
+)
+
+
+def test_two_process_sharded_knn(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
+        )
+        env.pop("XLA_FLAGS", None)  # one CPU device per process
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    try:
+        outs = [p.communicate(timeout=150)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pid={pid} failed:\n{out[-3000:]}"
+        assert f"WORKER-OK pid={pid}" in out
+
+
+def test_process_env_defaults(monkeypatch):
+    from pathway_tpu.parallel import distributed as dist
+
+    monkeypatch.delenv("PATHWAY_PROCESSES", raising=False)
+    monkeypatch.delenv("PATHWAY_PROCESS_ID", raising=False)
+    monkeypatch.delenv("PATHWAY_FIRST_PORT", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    n, pid, coord = dist.process_env()
+    assert (n, pid) == (1, 0) and coord.startswith("127.0.0.1:")
+    assert dist.maybe_initialize() is False  # single process: no-op
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "4")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "3")
+    monkeypatch.setenv("PATHWAY_FIRST_PORT", "12345")
+    n, pid, coord = dist.process_env()
+    assert (n, pid, coord) == (4, 3, "127.0.0.1:12345")
