@@ -321,7 +321,6 @@ def _bench_rag_rest_p50(np, on_accel):
     sees. Under the axon tunnel each query pays ~2 device dispatches of
     link latency (see extra.dispatch_floor_ms)."""
     import socket
-    import threading
 
     import pathway_tpu as pw
     from pathway_tpu.xpacks.llm._encoder import EncoderRuntime
@@ -367,6 +366,7 @@ def _bench_rag_rest_p50(np, on_accel):
             if client.query("warmup query", k=3):
                 ok = True
                 break
+            time.sleep(0.5)  # up but not yet indexed: don't busy-spin
         except Exception:
             time.sleep(0.5)
     try:

@@ -1,60 +1,26 @@
-"""Fuzzy join ops (reference: stdlib/ml/smart_table_ops/_fuzzy_join.py,
-711 LoC). Minimal capability: fuzzy self/cross match by feature overlap."""
+"""Smart table ops — normalized fuzzy join family (reference:
+python/pathway/stdlib/ml/smart_table_ops/_fuzzy_join.py:1-711)."""
 
-from __future__ import annotations
+from pathway_tpu.stdlib.ml.smart_table_ops._fuzzy_join import (
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    JoinNormalization,
+    fuzzy_match,
+    fuzzy_match_tables,
+    fuzzy_match_with_hint,
+    fuzzy_self_match,
+    smart_fuzzy_join,
+    smart_fuzzy_match,
+)
 
-from enum import Enum
-from typing import Any
-
-import pathway_tpu.reducers as reducers
-from pathway_tpu.internals.common import apply_with_type
-from pathway_tpu.internals.table import Table
-from pathway_tpu.internals.thisclass import this
-
-
-class JoinNormalization(Enum):
-    NONE = "none"
-    LOG = "log"
-
-
-def smart_fuzzy_join(
-    left: Table,
-    right: Table,
-    reflexive: bool = False,
-    normalization: Any = JoinNormalization.LOG,
-    **kwargs: Any,
-) -> Table:
-    """Match rows of `left` to rows of `right` by token overlap of their
-    first string column. Returns (left_id, right_id, weight)."""
-    import math
-
-    import pathway_tpu as pw
-
-    lcol = left.column_names()[0]
-    rcol = right.column_names()[0]
-
-    def tokens(s: str) -> tuple:
-        return tuple(str(s).lower().split())
-
-    l_tok = left.select(
-        lid=this.id, toks=apply_with_type(tokens, tuple, left[lcol])
-    ).flatten(this.toks)
-    r_tok = right.select(
-        rid=this.id, toks=apply_with_type(tokens, tuple, right[rcol])
-    ).flatten(this.toks)
-    pairs = l_tok.join(r_tok, l_tok.toks == r_tok.toks).select(
-        lid=pw.left.lid, rid=pw.right.rid
-    )
-    weights = pairs.groupby(pairs.lid, pairs.rid).reduce(
-        left_id=pairs.lid,
-        right_id=pairs.rid,
-        weight=reducers.count(),
-    )
-    best = weights.groupby(this.left_id).reduce(
-        match_id=reducers.argmax(this.weight)
-    )
-    return weights.having(best.match_id)
-
-
-def fuzzy_match_tables(left: Table, right: Table, **kwargs: Any) -> Table:
-    return smart_fuzzy_join(left, right, **kwargs)
+__all__ = [
+    "FuzzyJoinFeatureGeneration",
+    "FuzzyJoinNormalization",
+    "JoinNormalization",
+    "fuzzy_match",
+    "fuzzy_match_tables",
+    "fuzzy_match_with_hint",
+    "fuzzy_self_match",
+    "smart_fuzzy_join",
+    "smart_fuzzy_match",
+]

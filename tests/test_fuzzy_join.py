@@ -1,0 +1,275 @@
+"""Smart fuzzy join spec — modeled on the reference's
+python/pathway/tests/test_fuzzy_join.py."""
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from pathway_tpu.stdlib.ml.smart_table_ops import (
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    fuzzy_match,
+    fuzzy_match_tables,
+    smart_fuzzy_match,
+)
+
+
+def _pairs(res):
+    _k, cols = pw.debug.table_to_dicts(res)
+    return sorted(
+        (int(l), int(r), round(w, 6))
+        for l, r, w in zip(
+            cols["left"].values(), cols["right"].values(),
+            cols["weight"].values(),
+        )
+    )
+
+
+def test_fuzzy_match_edge_level():
+    """Reference test_fuzzy_match_simple: three disjoint features, WEIGHT
+    normalization -> each pair scores 1/2^ceil(log2 2) = 0.5."""
+    nodes = T(
+        """
+        name
+        a
+        b
+        c
+        AA
+        BB
+        CC
+        """,
+        id_from=["name"],
+    )
+    features = T(
+        """
+      | weight | normalization_type
+    1 | 1.0    | 1
+    2 | 1.0    | 1
+    3 | 1.0    | 1
+    """
+    )
+    nf_left = T(
+        """
+     node | feature | weight
+        a |       1 |    1.0
+        b |       2 |    1.0
+        c |       3 |    1.0
+    """
+    ).with_columns(
+        node=nodes.pointer_from(pw.this.node),
+        feature=features.pointer_from(pw.this.feature),
+    )
+    nf_right = T(
+        """
+     node | feature | weight
+       AA |       1 |    1.0
+       BB |       2 |    1.0
+       CC |       3 |    1.0
+    """
+    ).with_columns(
+        node=nodes.pointer_from(pw.this.node),
+        feature=features.pointer_from(pw.this.feature),
+    )
+    res = fuzzy_match(nf_left, nf_right, features)
+    got = _pairs(res)
+    exp = sorted(
+        (
+            int(pw.internals.api.ref_scalar(l)),
+            int(pw.internals.api.ref_scalar(r)),
+            0.5,
+        )
+        for l, r in (("a", "AA"), ("b", "BB"), ("c", "CC"))
+    )
+    assert got == exp
+
+
+def test_fuzzy_match_tables_names():
+    """Same-name rows with rare shared tokens match; ubiquitous tokens are
+    down-weighted (reference test_fuzzy_match_tables behavior)."""
+    left = T(
+        """
+        name
+        john smith
+        anne brown
+        david li
+        """
+    )
+    right = T(
+        """
+        surname
+        smith john
+        brown anne
+        li david
+        """
+    )
+    res = fuzzy_match_tables(left, right)
+    _k, cols = pw.debug.table_to_dicts(res)
+    # every left row finds exactly its permuted twin
+    assert len(cols["left"]) == 3
+    assert all(w > 0 for w in cols["weight"].values())
+    # verify the pairing is the permutation by checking sources
+    _kl, lcols = pw.debug.table_to_dicts(left)
+    _kr, rcols = pw.debug.table_to_dicts(right)
+    lmap = {k: v for k, v in lcols["name"].items()}
+    rmap = {k: v for k, v in rcols["surname"].items()}
+    for l, r in zip(cols["left"].values(), cols["right"].values()):
+        assert sorted(lmap[int(l)].split()) == sorted(rmap[int(r)].split())
+
+
+def test_mutual_best_selection():
+    """A right row shared by two left rows goes to the stronger match."""
+    left = T(
+        """
+        name
+        alpha beta gamma
+        alpha
+        """
+    )
+    right = T(
+        """
+        name
+        alpha beta gamma
+        """
+    )
+    res = fuzzy_match_tables(left, right)
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert len(cols["left"]) == 1
+    _kl, lcols = pw.debug.table_to_dicts(left)
+    winner = lcols["name"][int(next(iter(cols["left"].values())))]
+    assert winner == "alpha beta gamma"
+
+
+def test_letters_feature_generation():
+    left = T(
+        """
+        name
+        qwxz
+        """
+    )
+    right = T(
+        """
+        name
+        q-w-x-z
+        """
+    )
+    res = fuzzy_match_tables(
+        left, right, feature_generation=FuzzyJoinFeatureGeneration.LETTERS
+    )
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert len(cols["left"]) == 1  # shares all letters despite no tokens
+
+
+def test_by_hand_match_override():
+    left = T(
+        """
+        name
+        aaa bbb
+        ccc ddd
+        """
+    )
+    right = T(
+        """
+        name
+        aaa bbb
+        ccc ddd
+        """
+    )
+    # pin the CROSS pairing by hand; automatic matching must not override
+    _kl, lcols = pw.debug.table_to_dicts(left)
+    _kr, rcols = pw.debug.table_to_dicts(right)
+    l_ids = {v: k for k, v in lcols["name"].items()}
+    r_ids = {v: k for k, v in rcols["name"].items()}
+
+    class Hand(pw.Schema):
+        left: pw.Pointer
+        right: pw.Pointer
+        weight: float
+
+    hand = pw.debug.table_from_rows(
+        Hand,
+        [(pw.internals.api.Pointer(l_ids["aaa bbb"]),
+          pw.internals.api.Pointer(r_ids["ccc ddd"]), 99.0)],
+    )
+    res = fuzzy_match_tables(left, right, by_hand_match=hand)
+    _k, cols = pw.debug.table_to_dicts(res)
+    pairs = {
+        (int(l), int(r)): w
+        for l, r, w in zip(
+            cols["left"].values(), cols["right"].values(),
+            cols["weight"].values(),
+        )
+    }
+    assert (l_ids["aaa bbb"], r_ids["ccc ddd"]) in pairs
+    assert pairs[(l_ids["aaa bbb"], r_ids["ccc ddd"])] == 99.0
+    # the pinned left row must not also auto-match
+    assert (l_ids["aaa bbb"], r_ids["aaa bbb"]) not in pairs
+
+
+def test_self_match_symmetric():
+    t = T(
+        """
+        name
+        hello world
+        world hello
+        unrelated thing
+        """
+    )
+    res = smart_fuzzy_match(t.name, t.name)
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert len(cols["left"]) == 1
+    (l,), (r,) = cols["left"].values(), cols["right"].values()
+    assert int(l) < int(r)
+
+
+def test_symmetric_by_hand_excludes_right_node():
+    t = T(
+        """
+        name
+        xx yy
+        xx yy zz
+        yy zz
+        """
+    )
+    _kt, tcols = pw.debug.table_to_dicts(t)
+    ids = {v: k for k, v in tcols["name"].items()}
+
+    class Hand(pw.Schema):
+        left: pw.Pointer
+        right: pw.Pointer
+        weight: float
+
+    hand = pw.debug.table_from_rows(
+        Hand,
+        [(pw.internals.api.Pointer(ids["xx yy"]),
+          pw.internals.api.Pointer(ids["xx yy zz"]), 7.0)],
+    )
+    res = smart_fuzzy_match(t.name, t.name, by_hand_match=hand)
+    _k, cols = pw.debug.table_to_dicts(res)
+    auto_nodes = set()
+    for l, r, w in zip(
+        cols["left"].values(), cols["right"].values(),
+        cols["weight"].values(),
+    ):
+        if w != 7.0:
+            auto_nodes |= {int(l), int(r)}
+    # BOTH pinned nodes are out of automatic matching
+    assert ids["xx yy"] not in auto_nodes
+    assert ids["xx yy zz"] not in auto_nodes
+
+
+def test_smart_fuzzy_join_compat_case_insensitive():
+    from pathway_tpu.stdlib.ml.smart_table_ops import smart_fuzzy_join
+
+    left = T(
+        """
+        name
+        John Smith
+        """
+    )
+    right = T(
+        """
+        name
+        john smith
+        """
+    )
+    res = smart_fuzzy_join(left, right)
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert len(cols["left_id"]) == 1
