@@ -112,9 +112,21 @@ def _parse_object(data: bytes, opath: str, format: str, schema, column_names):
     raise ValueError(f"unknown format {format!r}")
 
 
-def _rows_for_object(fs, opath, format, schema, column_names, pk_cols):
-    with fs.open(opath, "rb") as f:
-        data = f.read()
+def _rows_for_object(fs, opath, format, schema, column_names, pk_cols,
+                     cache=None, sig=None):
+    data = None
+    if cache is not None and sig is not None:
+        # download-once: an object whose (mtime, size) signature matches
+        # the cached version is served from the local blob cache
+        # (reference: cached_object_storage.rs download-once semantics)
+        meta = cache.metadata(opath)
+        if meta is not None and meta.get("sig") == list(sig):
+            data = cache.get(opath)
+    if data is None:
+        with fs.open(opath, "rb") as f:
+            data = f.read()
+        if cache is not None:
+            cache.upsert(opath, data, {"sig": list(sig) if sig else None})
     rows = []
     for pk, vals in _parse_object(data, opath, format, schema, column_names):
         if pk_cols:
@@ -128,23 +140,35 @@ def _rows_for_object(fs, opath, format, schema, column_names, pk_cols):
 
 
 class _S3StaticSource(StaticSource):
-    def __init__(self, path, settings, format, schema, column_names, pk_cols):
+    def __init__(self, path, settings, format, schema, column_names, pk_cols,
+                 object_cache=None):
         super().__init__(column_names)
         self.path = path
         self.settings = settings
         self.format = format
         self.schema = schema
         self.pk_cols = pk_cols
+        self.object_cache = object_cache
 
     def events(self):
         fs, _ = _open_fs(self.path, self.settings)
         rows = []
         for opath in sorted(fs.find(self.path)):
+            sig = None
+            if self.object_cache is not None:
+                try:
+                    info = fs.info(opath)
+                    sig = (
+                        str(info.get("mtime", info.get("LastModified", ""))),
+                        info.get("size"),
+                    )
+                except OSError:
+                    pass
             rows.extend(
                 (k, 1, v)
                 for k, v in _rows_for_object(
                     fs, opath, self.format, self.schema, self.column_names,
-                    self.pk_cols,
+                    self.pk_cols, cache=self.object_cache, sig=sig,
                 )
             )
         if rows:
@@ -154,7 +178,7 @@ class _S3StaticSource(StaticSource):
 class _S3StreamingSource(StreamingSource):
     def __init__(
         self, path, settings, format, schema, column_names, pk_cols,
-        refresh_s=1.0,
+        refresh_s=1.0, object_cache=None,
     ):
         super().__init__(column_names)
         self.path = path
@@ -163,6 +187,7 @@ class _S3StreamingSource(StreamingSource):
         self.schema = schema
         self.pk_cols = pk_cols
         self.refresh_s = refresh_s
+        self.object_cache = object_cache
         self._stop = threading.Event()
         self._thread = None
         self._seen: dict[str, Any] = {}
@@ -190,7 +215,7 @@ class _S3StreamingSource(StreamingSource):
             try:
                 new = _rows_for_object(
                     fs, opath, self.format, self.schema, self.column_names,
-                    self.pk_cols,
+                    self.pk_cols, cache=self.object_cache, sig=sig,
                 )
             except OSError:
                 continue
@@ -201,11 +226,20 @@ class _S3StreamingSource(StreamingSource):
 
     def _loop(self):
         fs, _ = _open_fs(self.path, self.settings)
+        scans = 0
         while not self._stop.is_set():
             try:
                 self._scan(fs)
             except OSError:
                 pass
+            scans += 1
+            if self.object_cache is not None and scans % 60 == 0:
+                # bound cache growth: superseded object versions pile up
+                # one per change otherwise
+                try:
+                    self.object_cache.vacuum()
+                except OSError:
+                    pass
             self._stop.wait(self.refresh_s)
 
     def start(self):
@@ -227,8 +261,19 @@ def read(
     mode: str = "streaming",
     name: str | None = None,
     persistent_id: str | None = None,
+    object_cache: Any = None,
     **kwargs: Any,
 ) -> Table:
+    """``object_cache`` — a persistence Backend or CachedObjectStorage:
+    unchanged objects are served from the local versioned blob cache
+    instead of being re-downloaded (reference: cached_object_storage.rs)."""
+    if object_cache is not None:
+        from pathway_tpu.persistence.cached_object_storage import (
+            CachedObjectStorage,
+        )
+
+        if not isinstance(object_cache, CachedObjectStorage):
+            object_cache = CachedObjectStorage(object_cache)
     if format in ("plaintext", "plaintext_by_file"):
         column_names = ["data"]
         dtypes = {"data": dt.STR}
@@ -245,11 +290,13 @@ def read(
     pk_cols = schema_.primary_key_columns() if schema_ else None
     if mode == "static":
         source: Any = _S3StaticSource(
-            path, aws_s3_settings, format, schema_, column_names, pk_cols
+            path, aws_s3_settings, format, schema_, column_names, pk_cols,
+            object_cache=object_cache,
         )
     else:
         source = _S3StreamingSource(
-            path, aws_s3_settings, format, schema_, column_names, pk_cols
+            path, aws_s3_settings, format, schema_, column_names, pk_cols,
+            object_cache=object_cache,
         )
     source.persistent_id = persistent_id or name
     node = InputNode(source, column_names)

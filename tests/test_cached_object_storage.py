@@ -1,0 +1,112 @@
+"""CachedObjectStorage: versioned download-once blob cache (reference:
+src/persistence/cached_object_storage.rs:1-377)."""
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend
+from pathway_tpu.persistence.cached_object_storage import CachedObjectStorage
+
+
+def test_upsert_lookup_remove(tmp_path):
+    cos = CachedObjectStorage(Backend.filesystem(str(tmp_path)))
+    v1 = cos.upsert("s3://b/a.txt", b"hello", {"etag": "x1"})
+    v2 = cos.upsert("s3://b/b.txt", b"world", {"etag": "y1"})
+    assert (v1, v2) == (1, 2)
+    assert cos.contains("s3://b/a.txt")
+    assert cos.get("s3://b/a.txt") == b"hello"
+    assert cos.metadata("s3://b/b.txt") == {"etag": "y1"}
+    v3 = cos.upsert("s3://b/a.txt", b"hello2", {"etag": "x2"})
+    assert v3 == 3 and cos.get("s3://b/a.txt") == b"hello2"
+    cos.remove("s3://b/b.txt")
+    assert not cos.contains("s3://b/b.txt")
+    assert cos.get("s3://b/b.txt") is None
+    assert sorted(cos.uris()) == ["s3://b/a.txt"]
+
+
+def test_rebuild_after_restart(tmp_path):
+    backend = Backend.filesystem(str(tmp_path))
+    cos = CachedObjectStorage(backend)
+    cos.upsert("u1", b"v1", {"m": 1})
+    cos.upsert("u1", b"v2", {"m": 2})
+    cos.upsert("u2", b"w", {})
+    cos.remove("u2")
+    # fresh instance over the same backend = restart
+    cos2 = CachedObjectStorage(Backend.filesystem(str(tmp_path)))
+    assert cos2.actual_version() == 4
+    assert cos2.get("u1") == b"v2"
+    assert cos2.metadata("u1") == {"m": 2}
+    assert not cos2.contains("u2")
+    # new versions continue after the restored counter
+    assert cos2.upsert("u3", b"x", {}) == 5
+
+
+def test_vacuum_drops_superseded(tmp_path):
+    backend = Backend.filesystem(str(tmp_path))
+    cos = CachedObjectStorage(backend)
+    cos.upsert("a", b"1", {})
+    cos.upsert("a", b"2", {})
+    cos.upsert("b", b"3", {})
+    cos.remove("b")
+    removed = cos.vacuum()
+    assert removed == 3  # a@1 superseded, b@3 deleted, delete event b@4
+    assert cos.get("a") == b"2"
+    cos3 = CachedObjectStorage(Backend.filesystem(str(tmp_path)))
+    assert cos3.get("a") == b"2" and not cos3.contains("b")
+
+
+def test_s3_scanner_download_once(tmp_path):
+    """The S3 scanner must serve unchanged objects from the cache on a
+    fresh run instead of re-downloading."""
+    import threading
+    import time
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "one.txt").write_text("alpha\n")
+    cache_dir = tmp_path / "cache"
+
+    import fsspec
+    import fsspec.implementations.local
+
+    counting = {"opens": 0}
+    base_open = fsspec.implementations.local.LocalFileSystem._open
+
+    def run_once():
+        pw.internals.parse_graph.G.clear()
+        t = pw.io.s3.read(
+            str(data_dir),
+            format="plaintext",
+            mode="streaming",
+            object_cache=pw.persistence.Backend.filesystem(str(cache_dir)),
+        )
+        seen = []
+        pw.io.subscribe(
+            t, lambda key, row, time, is_addition: seen.append(row["data"])
+        )
+
+        def stopper():
+            deadline = time.time() + 10
+            while time.time() < deadline and not seen:
+                time.sleep(0.05)
+            time.sleep(0.3)
+            pw.internals.parse_graph.G.runtime.stop()
+
+        threading.Thread(target=stopper, daemon=True).start()
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        return seen
+
+    class CountingFS(fsspec.implementations.local.LocalFileSystem):
+        def _open(self, *a, **kw):
+            counting["opens"] += 1
+            return base_open(self, *a, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(
+        fsspec.implementations.local.LocalFileSystem, "_open", CountingFS._open
+    ):
+        assert run_once() == ["alpha"]
+        first = counting["opens"]
+        assert first >= 1
+        # second run: same bytes must come from the cache, zero downloads
+        assert run_once() == ["alpha"]
+        assert counting["opens"] == first, "object was re-downloaded"
