@@ -205,6 +205,12 @@ class Runtime:
         self.global_frontier = 0
         self.frontier_syncs = 0
         self._frontier_base: int | None = None
+        # OTLP operator-latency histogram (no-op without a metrics SDK)
+        from pathway_tpu.internals.telemetry import get_metrics
+
+        self._otel_metrics = get_metrics()
+        self._otel_on = self._otel_metrics.enabled
+        self._node_names = {n.id: type(n).__name__ for n in self.order}
 
     # --- core tick ------------------------------------------------------------
 
@@ -230,9 +236,12 @@ class Runtime:
             nrows = sum(len(b) for b in out)
             if nrows:
                 stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
-            stats.node_ns[node.id] = (
-                stats.node_ns.get(node.id, 0) + _time.perf_counter_ns() - t0
-            )
+            node_ns = _time.perf_counter_ns() - t0
+            stats.node_ns[node.id] = stats.node_ns.get(node.id, 0) + node_ns
+            if self._otel_on:
+                self._otel_metrics.record_operator_latency(
+                    self._node_names[node.id], node_ns
+                )
             if isinstance(ex, InputExec) and nrows:
                 stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
         for node in self._sinks:

@@ -18,7 +18,22 @@ BASE_PORT = 20000
 
 
 def _render_metrics(runtime) -> str:
+    import time as _time
+
+    from pathway_tpu.internals.telemetry import process_gauges
+
     s = runtime.stats
+    gauges = process_gauges()
+    # frontier lag vs wall clock — the reference's input/output latency
+    # gauges (http_server.rs:25-90). Only meaningful when logical times
+    # ARE wall-clock ms (streaming mode); static runs with explicit small
+    # event times would otherwise report a multi-decade "lag"
+    now_ms = _time.time() * 1000.0
+    week_ms = 7 * 86400 * 1000.0
+    if 0 < s.current_time <= now_ms and now_ms - s.current_time < week_ms:
+        lag_ms = now_ms - s.current_time
+    else:
+        lag_ms = 0.0
     lines = [
         "# TYPE pathway_ticks_total counter",
         f"pathway_ticks_total {s.ticks}",
@@ -26,6 +41,12 @@ def _render_metrics(runtime) -> str:
         f"pathway_logical_time {s.current_time}",
         "# TYPE pathway_last_tick_seconds gauge",
         f"pathway_last_tick_seconds {s.last_tick_ns / 1e9}",
+        "# TYPE pathway_frontier_lag_ms gauge",
+        f"pathway_frontier_lag_ms {lag_ms}",
+        "# TYPE pathway_process_cpu_seconds_total counter",
+        f"pathway_process_cpu_seconds_total {gauges['process_cpu_seconds_total']}",
+        "# TYPE pathway_process_memory_rss_bytes gauge",
+        f"pathway_process_memory_rss_bytes {gauges['process_memory_rss_bytes']}",
         "# TYPE pathway_input_rows_total counter",
         "# TYPE pathway_output_rows_total counter",
         "# TYPE pathway_operator_rows_total counter",

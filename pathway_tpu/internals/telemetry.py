@@ -63,8 +63,106 @@ class Telemetry:
         return f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-{ctx.trace_flags:02x}"
 
 
+def process_gauges() -> dict[str, float]:
+    """Process CPU/memory gauges (reference: sysinfo-backed gauges,
+    src/engine/telemetry.rs:359-416). Stdlib-only: os.times for CPU,
+    /proc/self/status (linux) or ru_maxrss for resident memory."""
+    import os
+
+    t = os.times()
+    gauges = {"process_cpu_seconds_total": float(t.user + t.system)}
+    rss = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = float(line.split()[1]) * 1024.0
+                    break
+    except OSError:
+        pass
+    if rss is None:
+        # fallback is PEAK rss (and on macOS ru_maxrss is bytes, not KiB)
+        try:
+            import resource
+            import sys
+
+            raw = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            rss = float(raw if sys.platform == "darwin" else raw * 1024)
+        except Exception:
+            rss = 0.0
+    gauges["process_memory_rss_bytes"] = rss
+    return gauges
+
+
+class _OtelMetrics:
+    """OTLP metrics when an OTel metrics SDK is configured by the host
+    application (reference: telemetry.rs:327-357 operator latency +
+    process gauges exported over OTLP); free no-ops otherwise."""
+
+    def __init__(self):
+        self._hist = None
+        self.enabled = False
+        try:
+            from opentelemetry import metrics as _metrics
+
+            # a bare OTel API (no SDK) hands out proxy instruments that
+            # accept-and-drop every record — skip the per-tick cost unless
+            # a real SDK provider is configured at Runtime build time
+            provider = _metrics.get_meter_provider()
+            if not type(provider).__module__.startswith("opentelemetry.sdk"):
+                return
+            meter = _metrics.get_meter("pathway_tpu")
+            self._hist = meter.create_histogram(
+                "pathway.operator.latency",
+                unit="ns",
+                description="per-operator batch processing time",
+            )
+            meter.create_observable_gauge(
+                "pathway.process.cpu_seconds",
+                callbacks=[self._observe_cpu],
+            )
+            meter.create_observable_gauge(
+                "pathway.process.memory_rss_bytes",
+                callbacks=[self._observe_rss],
+            )
+            self.enabled = True
+        except Exception:  # pragma: no cover - no OTel metrics API
+            self._hist = None
+            self.enabled = False
+
+    @staticmethod
+    def _observe_cpu(_options):
+        from opentelemetry.metrics import Observation
+
+        yield Observation(process_gauges()["process_cpu_seconds_total"])
+
+    @staticmethod
+    def _observe_rss(_options):
+        from opentelemetry.metrics import Observation
+
+        yield Observation(process_gauges()["process_memory_rss_bytes"])
+
+    def record_operator_latency(self, operator: str, ns: int) -> None:
+        if self._hist is not None:
+            try:
+                self._hist.record(ns, {"operator": operator})
+            except Exception:
+                pass
+
+
 _GLOBAL = Telemetry()
+_METRICS: _OtelMetrics | None = None
+_METRICS_LOCK = __import__("threading").Lock()
 
 
 def get_telemetry() -> Telemetry:
     return _GLOBAL
+
+
+def get_metrics() -> _OtelMetrics:
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                _METRICS = _OtelMetrics()
+    return _METRICS

@@ -66,3 +66,29 @@ def test_metrics_http_endpoint():
         assert status["ticks"] >= 1
     finally:
         server.shutdown()
+
+
+def test_process_gauges_and_metrics_endpoint():
+    """Process CPU/mem gauges (reference: telemetry.rs:359-416) surface on
+    the Prometheus endpoint alongside operator latency and frontier lag."""
+    from pathway_tpu.internals.telemetry import process_gauges
+
+    g = process_gauges()
+    assert g["process_cpu_seconds_total"] > 0
+    assert g["process_memory_rss_bytes"] > 1024 * 1024  # at least 1 MiB
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.monitoring_server import _render_metrics
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.debug.table_from_rows(S, [(1,), (2,)])
+    res = t.reduce(s=pw.reducers.sum(t.v))
+    pw.debug.table_to_dicts(res)
+    rt = pw.internals.parse_graph.G.last_runtime
+    body = _render_metrics(rt)
+    assert "pathway_process_cpu_seconds_total" in body
+    assert "pathway_process_memory_rss_bytes" in body
+    assert "pathway_frontier_lag_ms" in body
+    assert "pathway_operator_seconds_total" in body
