@@ -238,7 +238,9 @@ class Runtime:
                 stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
             node_ns = _time.perf_counter_ns() - t0
             stats.node_ns[node.id] = stats.node_ns.get(node.id, 0) + node_ns
-            if self._otel_on:
+            if self._otel_on and (nrows or any(inputs)):
+                # only ticks that did work: idle 50 ms autocommit ticks
+                # would swamp the latency distribution with ~0 samples
                 self._otel_metrics.record_operator_latency(
                     self._node_names[node.id], node_ns
                 )

@@ -117,7 +117,8 @@ class _OtelMetrics:
                 unit="ns",
                 description="per-operator batch processing time",
             )
-            meter.create_observable_gauge(
+            # CPU time is monotonic cumulative -> counter semantics
+            meter.create_observable_counter(
                 "pathway.process.cpu_seconds",
                 callbacks=[self._observe_cpu],
             )
@@ -159,10 +160,25 @@ def get_telemetry() -> Telemetry:
     return _GLOBAL
 
 
+def _sdk_provider_active() -> bool:
+    try:
+        from opentelemetry import metrics as _metrics
+
+        return type(_metrics.get_meter_provider()).__module__.startswith(
+            "opentelemetry.sdk"
+        )
+    except Exception:
+        return False
+
+
 def get_metrics() -> _OtelMetrics:
+    """Metrics singleton. A disabled instance is re-evaluated on each call
+    (cheap: one provider type check) so an SDK MeterProvider configured
+    AFTER the first Runtime still turns metrics on for later runtimes."""
     global _METRICS
-    if _METRICS is None:
-        with _METRICS_LOCK:
-            if _METRICS is None:
-                _METRICS = _OtelMetrics()
+    with _METRICS_LOCK:
+        if _METRICS is None or (
+            not _METRICS.enabled and _sdk_provider_active()
+        ):
+            _METRICS = _OtelMetrics()
     return _METRICS
