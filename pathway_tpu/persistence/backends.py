@@ -110,6 +110,68 @@ class MemoryStore(BackendStore):
             self._blobs.pop(key, None)
 
 
+class FsspecStore(BackendStore):
+    """Object-store backend over a real client (reference:
+    src/persistence/backends/s3.rs:150 over rust-s3). Any fsspec protocol
+    works — ``s3://`` (s3fs), ``gs://`` (gcsfs), ``memory://`` (in-process
+    fake object store, how tests exercise this path without credentials).
+    Object-store PUTs are atomic per object, giving the same torn-write
+    guarantee the filesystem store gets from rename."""
+
+    def __init__(self, url: str, storage_options: dict | None = None):
+        import fsspec
+
+        assert "://" in url, f"expected a protocol URL, got {url!r}"
+        self.protocol, rest = url.split("://", 1)
+        self.fs = fsspec.filesystem(self.protocol, **(storage_options or {}))
+        self.root = rest.rstrip("/")
+
+    def _path(self, key: str) -> str:
+        return f"{self.root}/{key}"
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        if "/" in key:
+            # directory-backed protocols (file://) need parents; a no-op
+            # on true object stores
+            try:
+                self.fs.makedirs(path.rsplit("/", 1)[0], exist_ok=True)
+            except OSError:
+                pass
+        self.fs.pipe_file(path, data)
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            return self.fs.cat_file(self._path(key))
+        except OSError:
+            return None
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        # narrow the listing to the deepest directory of the prefix
+        base = f"{self.root}/{prefix}"
+        directory = base.rsplit("/", 1)[0]
+        try:
+            found = self.fs.find(directory)
+        except OSError:
+            return []
+        out = []
+        lead = f"{self.root}/"
+        for p in found:
+            p = p.lstrip("/")
+            if not p.startswith(lead.lstrip("/")):
+                continue
+            rel = p[len(lead.lstrip("/")) :]
+            if rel.startswith(prefix):
+                out.append(rel)
+        return sorted(out)
+
+    def remove(self, key: str) -> None:
+        try:
+            self.fs.rm_file(self._path(key))
+        except OSError:
+            pass
+
+
 def store_for_backend(backend) -> BackendStore:
     """Map a user-facing `pw.persistence.Backend` config onto a store."""
     kind = getattr(backend, "kind", "filesystem")
@@ -118,7 +180,24 @@ def store_for_backend(backend) -> BackendStore:
     if kind == "memory" or kind == "mock":
         return MemoryStore(getattr(backend, "name", "default"))
     if kind == "s3":
-        # No S3 SDK baked into the image: treat the root_path as a mounted
-        # object-store path (gcsfuse/s3fs) — same durability contract.
-        return FilesystemStore(getattr(backend, "root_path", "."))
+        root = getattr(backend, "root_path", ".")
+        if "://" in root:
+            settings = getattr(backend, "bucket_settings", None)
+            if settings is None:
+                opts = None
+            elif hasattr(settings, "storage_options"):
+                opts = settings.storage_options()
+            elif isinstance(settings, dict):
+                opts = settings
+            else:
+                # silently dropping explicit credentials would connect
+                # with ambient identity and fail far from the cause
+                raise TypeError(
+                    "bucket_settings must be an AwsS3Settings-like object "
+                    "with .storage_options() or a dict of fsspec storage "
+                    f"options, got {type(settings).__name__}"
+                )
+            return FsspecStore(root, opts)
+        # bare path: a mounted object store (gcsfuse/s3fs mount)
+        return FilesystemStore(root)
     raise ValueError(f"unknown persistence backend kind {kind!r}")

@@ -305,3 +305,88 @@ def test_knn_index_state_roundtrip():
     assert [r[0] for r in res_a[0]] == [r[0] for r in res_b[0]]
     assert b.metadata[7] == {"i": 7}
     assert all(r[0] != 5 for r in res_b[0])
+
+
+def test_fsspec_object_store_backend():
+    """Real client-based object-store backend (reference: backends/s3.rs
+    over rust-s3; here FsspecStore): round trip + prefix listing via the
+    in-process memory:// object store."""
+    import uuid
+
+    from pathway_tpu.persistence.backends import FsspecStore, store_for_backend
+
+    url = f"memory://pwtest-{uuid.uuid4().hex}"
+    st = FsspecStore(url)
+    st.put("inputs/a/chunk-00000001.pkl", b"one")
+    st.put("inputs/a/chunk-00000002.pkl", b"two")
+    st.put("offsets/a.pkl", b"off")
+    assert st.get("inputs/a/chunk-00000001.pkl") == b"one"
+    assert st.get("missing") is None
+    assert st.list_keys("inputs/") == [
+        "inputs/a/chunk-00000001.pkl",
+        "inputs/a/chunk-00000002.pkl",
+    ]
+    st.remove("inputs/a/chunk-00000001.pkl")
+    assert st.list_keys("inputs/") == ["inputs/a/chunk-00000002.pkl"]
+    st.remove("missing")  # no-op
+
+    # the Backend.s3 factory routes URLs to the fsspec store
+    be = pw.persistence.Backend.s3(url)
+    st2 = store_for_backend(be)
+    assert isinstance(st2, FsspecStore)
+    assert st2.get("offsets/a.pkl") == b"off"
+
+
+def test_kill_restart_on_object_store(tmp_path):
+    """Full kill/restart durability against the object-store backend — the
+    same wordcount cycle the filesystem backend passes."""
+    import uuid
+
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    out_a = tmp_path / "out_a.jsonl"
+    out_b = tmp_path / "out_b.jsonl"
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.s3(f"memory://pwtest-{uuid.uuid4().hex}")
+    )
+
+    _write_words(input_dir / "f1.jsonl", ["a", "b", "a", "c", "a"])
+    _build_wordcount(input_dir, out_a)
+    _run_until.cfg = cfg
+
+    def _a_done():
+        try:
+            return _final_counts(out_a).get("a") == 3
+        except OSError:
+            return False
+
+    assert _run_until(_a_done)
+
+    pw.internals.parse_graph.G.clear()
+    _write_words(input_dir / "f2.jsonl", ["b", "d"])
+    _build_wordcount(input_dir, out_b)
+
+    def _b_done():
+        try:
+            got = _final_counts(out_b)
+        except OSError:
+            return False
+        return got.get("b") == 2 and got.get("d") == 1
+
+    assert _run_until(_b_done)
+    assert _final_counts(out_b) == {"a": 3, "b": 2, "c": 1, "d": 1}
+
+
+def test_fsspec_file_protocol_nested_keys(tmp_path):
+    from pathway_tpu.persistence.backends import FsspecStore
+
+    st = FsspecStore(f"file://{tmp_path}/ckpt")
+    st.put("inputs/a/chunk-00000001.pkl", b"x")  # parents auto-created
+    assert st.get("inputs/a/chunk-00000001.pkl") == b"x"
+
+    import pytest
+
+    with pytest.raises(TypeError, match="bucket_settings"):
+        from pathway_tpu.persistence.backends import store_for_backend
+
+        store_for_backend(pw.persistence.Backend.s3("memory://x", object()))
