@@ -330,3 +330,136 @@ def test_mongodb_write(monkeypatch):
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
     assert sorted(d["name"] for d in written) == ["m1", "m2"]
     assert all(d["diff"] == 1 and "key" in d and "time" in d for d in written)
+
+
+# --------------------------------------------------------------------------
+# Slack / Logstash (HTTP writers)
+
+
+def test_slack_alerts(monkeypatch):
+    posts = []
+
+    class _Resp:
+        def raise_for_status(self):
+            pass
+
+    class _Session:
+        def __init__(self):
+            self.headers = {}
+
+        def post(self, url, json=None, timeout=None):
+            posts.append((url, json, dict(self.headers)))
+            return _Resp()
+
+    import requests
+
+    monkeypatch.setattr(requests, "Session", _Session)
+
+    class A(pw.Schema):
+        message: str
+
+    t = pw.debug.table_from_rows(A, [("disk full",), ("cpu hot",)])
+    pw.io.slack.send_alerts(t, "C12345", "xoxb-token")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(posts) == 2
+    assert all(u.endswith("chat.postMessage") for u, _j, _h in posts)
+    assert sorted(j["text"] for _u, j, _h in posts) == ["cpu hot", "disk full"]
+    assert all(j["channel"] == "C12345" for _u, j, _h in posts)
+    assert all(
+        h.get("Authorization") == "Bearer xoxb-token" for _u, _j, h in posts
+    )
+
+
+def test_logstash_write_with_retry(monkeypatch):
+    calls = {"n": 0}
+    docs = []
+
+    class _Resp:
+        def raise_for_status(self):
+            pass
+
+    class _Session:
+        def __init__(self):
+            self.headers = {}
+
+        def post(self, url, json=None, timeout=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                import requests
+
+                raise requests.RequestException("transient")
+            docs.append(json)
+            return _Resp()
+
+    import requests
+
+    monkeypatch.setattr(requests, "Session", _Session)
+
+    t = pw.debug.table_from_rows(InSchema, [("l1", 1)])
+    pw.io.logstash.write(t, "http://fake:8080", n_retries=2)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert [d["name"] for d in docs] == ["l1"]
+    assert calls["n"] == 2  # one failure + one retry success
+
+
+# --------------------------------------------------------------------------
+# BigQuery / PubSub
+
+
+def test_bigquery_write(monkeypatch):
+    inserted = []
+
+    class _Client:
+        def insert_rows_json(self, target, rows):
+            inserted.append((target, rows))
+            return []
+
+        def close(self):
+            pass
+
+    bq_mod = types.ModuleType("google.cloud.bigquery")
+    bq_mod.Client = _Client
+    google = types.ModuleType("google")
+    cloud = types.ModuleType("google.cloud")
+    google.cloud = cloud
+    cloud.bigquery = bq_mod
+    monkeypatch.setitem(sys.modules, "google", google)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.bigquery", bq_mod)
+
+    t = pw.debug.table_from_rows(InSchema, [("b1", 1), ("b2", 2)])
+    pw.io.bigquery.write(t, "ds", "tbl")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert inserted and inserted[0][0] == "ds.tbl"
+    names = sorted(r["name"] for _t, rows in inserted for r in rows)
+    assert names == ["b1", "b2"]
+    assert all(
+        "time" in r and "diff" in r for _t, rows in inserted for r in rows
+    )
+
+
+def test_pubsub_write():
+    published = []
+
+    class _Future:
+        def result(self, timeout=None):
+            return "msgid"
+
+    class _Publisher:
+        def topic_path(self, project, topic):
+            return f"projects/{project}/topics/{topic}"
+
+        def publish(self, topic_path, data, **attrs):
+            published.append((topic_path, json.loads(data), attrs))
+            return _Future()
+
+    t = pw.debug.table_from_rows(InSchema, [("p1", 9)])
+    pw.io.pubsub.write(
+        t, publisher=_Publisher(), project_id="proj", topic_id="top"
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(published) == 1
+    path, doc, attrs = published[0]
+    assert path == "projects/proj/topics/top"
+    assert doc["name"] == "p1" and doc["n"] == 9
+    assert attrs["pathway_diff"] == "1" and "pathway_key" in attrs
