@@ -220,6 +220,10 @@ def _bench_groupby(np):
     # fresh app: otherwise replacing G.last_runtime frees the previous
     # bench's entire state graph inside the timed region
     pw.internals.parse_graph.G.clear()
+    import gc
+
+    gc.collect()  # don't let gen-2 passes over other benches' survivors
+    # (jaxpr caches etc.) fire inside the timed region
     n_rows = 500_000
     vocab = [f"word{i}" for i in range(1000)]
     rng = np.random.default_rng(1)
@@ -244,6 +248,9 @@ def _bench_join(np):
     import pathway_tpu as pw
 
     pw.internals.parse_graph.G.clear()
+    import gc
+
+    gc.collect()
     # FK-shaped join: right keys unique, each left row matches exactly one
     # right row — output size == n_l, the typical enrichment-join workload
     n_l, n_r = 400_000, 100_000
