@@ -220,10 +220,6 @@ def _bench_groupby(np):
     # fresh app: otherwise replacing G.last_runtime frees the previous
     # bench's entire state graph inside the timed region
     pw.internals.parse_graph.G.clear()
-    import gc
-
-    gc.collect()  # don't let gen-2 passes over other benches' survivors
-    # (jaxpr caches etc.) fire inside the timed region
     n_rows = 500_000
     vocab = [f"word{i}" for i in range(1000)]
     rng = np.random.default_rng(1)
@@ -232,11 +228,29 @@ def _bench_groupby(np):
     class WordSchema(pw.Schema):
         word: str
 
+    # small untimed warmup run: allocator arena growth and library-internal
+    # caches otherwise land in the first timed run
+    warm = pw.debug.table_from_rows(
+        WordSchema, [(vocab[i % 100],) for i in range(5000)]
+    )
+    pw.debug.table_to_dicts(
+        warm.groupby(warm.word).reduce(warm.word, count=pw.reducers.count())
+    )
+    pw.internals.parse_graph.G.clear()
+
     t = pw.debug.table_from_rows(WordSchema, [(w,) for w in words])
     res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
-    t0 = time.perf_counter()
-    keys, columns = pw.debug.table_to_dicts(res)
-    dt = time.perf_counter() - t0
+    # gen-2 GC passes over OTHER benches' survivors (jaxpr caches etc.)
+    # otherwise fire inside the timed region and halve the number
+    import gc
+
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        keys, columns = pw.debug.table_to_dicts(res)
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
     assert sum(columns["count"].values()) == n_rows
     return float(n_rows / dt)
 
@@ -248,9 +262,6 @@ def _bench_join(np):
     import pathway_tpu as pw
 
     pw.internals.parse_graph.G.clear()
-    import gc
-
-    gc.collect()
     # FK-shaped join: right keys unique, each left row matches exactly one
     # right row — output size == n_l, the typical enrichment-join workload
     n_l, n_r = 400_000, 100_000
@@ -273,9 +284,15 @@ def _bench_join(np):
         R, [(int(rk[i]), i) for i in range(n_r)]
     )
     j = lt.join(rt, lt.k == rt.k).select(lt.a, rt.b)
-    t0 = time.perf_counter()
-    keys, columns = pw.debug.table_to_dicts(j)
-    dt = time.perf_counter() - t0
+    import gc
+
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        keys, columns = pw.debug.table_to_dicts(j)
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
     assert len(columns["a"]) > 0
     return float((n_l + n_r) / dt)
 
