@@ -59,8 +59,55 @@ class NoRetryStrategy(AsyncRetryStrategy):
     pass
 
 
-def async_options(**kwargs):
+def with_capacity(fn: Callable, capacity: int) -> Callable:
+    """Bound concurrent invocations of an async fn with a semaphore
+    (reference: executors.py with_capacity). One semaphore per event loop:
+    each engine tick runs its own asyncio.run, and a semaphore must not
+    carry waiters across loops."""
+    import weakref
+
+    sems: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    @functools.wraps(fn)
+    async def limited(*args, **kwargs):
+        loop = asyncio.get_running_loop()
+        sem = sems.get(loop)
+        if sem is None:
+            sem = asyncio.Semaphore(capacity)
+            sems[loop] = sem
+        async with sem:
+            return await fn(*args, **kwargs)
+
+    return limited
+
+
+def with_timeout(fn: Callable, timeout: float) -> Callable:
+    @functools.wraps(fn)
+    async def timed(*args, **kwargs):
+        return await asyncio.wait_for(fn(*args, **kwargs), timeout=timeout)
+
+    return timed
+
+
+def async_options(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: "AsyncRetryStrategy | None" = None,
+    cache_strategy: "CacheStrategy | None" = None,
+):
+    """Decorator applying the async execution options to a coroutine fn
+    (reference: udfs.async_options)."""
+
     def wrapper(fn):
+        fn = coerce_async(fn)
+        if cache_strategy is not None:
+            fn = with_cache_strategy(fn, cache_strategy)
+        if retry_strategy is not None:
+            fn = with_retry_strategy(fn, retry_strategy)
+        if timeout is not None:
+            fn = with_timeout(fn, timeout)
+        if capacity is not None:
+            fn = with_capacity(fn, capacity)
         return fn
 
     return wrapper
@@ -81,12 +128,19 @@ def run_async_blocking(coro_factory: Callable[[], Any]) -> Any:
 
 
 def coerce_async(fn: Callable) -> Callable:
+    """Lift a sync fn to a coroutine running in the default thread pool —
+    calling it inline would serialize the whole gather behind each
+    blocking call (reference: executors.py coerce_async dispatches sync
+    fns via run_in_executor)."""
     if asyncio.iscoroutinefunction(fn):
         return fn
 
     @functools.wraps(fn)
     async def wrapper(*args, **kwargs):
-        return fn(*args, **kwargs)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, functools.partial(fn, *args, **kwargs)
+        )
 
     return wrapper
 
@@ -167,6 +221,7 @@ class UDF:
         self._retry_strategy = retry_strategy
         self._timeout = timeout
         self._max_batch_size = max_batch_size
+        self._executor = executor
         if hasattr(self, "__wrapped__"):
             self._prepare(self.__wrapped__)
 
@@ -174,20 +229,29 @@ class UDF:
         self._fn_raw = fn
         self._is_async = asyncio.iscoroutinefunction(fn)
         fn2 = fn
+        ex = getattr(self, "_executor", None)
+        if isinstance(ex, AsyncExecutor):
+            # async execution requested: lift sync fns and fold the
+            # executor's options into the UDF-level ones
+            fn2 = coerce_async(fn2)
+            self._is_async = True
+            if ex.retry_strategy is not None and self._retry_strategy is None:
+                self._retry_strategy = ex.retry_strategy
+            if ex.timeout is not None and self._timeout is None:
+                self._timeout = ex.timeout
+        elif isinstance(ex, SyncExecutor) and self._is_async:
+            raise TypeError(
+                "sync_executor() cannot run a coroutine function"
+            )
         if self._cache_strategy is not None:
             fn2 = with_cache_strategy(fn2, self._cache_strategy)
         if self._is_async and self._retry_strategy is not None:
             fn2 = with_retry_strategy(fn2, self._retry_strategy)
         if self._is_async and self._timeout is not None:
-            inner = fn2
-
-            @functools.wraps(fn)
-            async def timed(*args, **kwargs):
-                return await asyncio.wait_for(
-                    inner(*args, **kwargs), timeout=self._timeout
-                )
-
-            fn2 = timed
+            fn2 = with_timeout(fn2, self._timeout)
+        if isinstance(ex, AsyncExecutor) and ex.capacity is not None:
+            # outermost so the concurrency bound covers retries + timeout
+            fn2 = with_capacity(fn2, ex.capacity)
         self._fn = fn2
         if self._return_type is None:
             try:
@@ -263,18 +327,65 @@ def udf(
     return make
 
 
-# executors façade (reference: internals/udfs/executors.py)
-def auto_executor():
-    return None
+# executors (reference: internals/udfs/executors.py:36-225)
 
 
-def sync_executor():
-    return None
+class Executor:
+    """Execution strategy marker for @pw.udf(executor=...)."""
 
 
-def async_executor(capacity: int | None = None, timeout: float | None = None):
-    return None
+class AutoExecutor(Executor):
+    """Sync for plain functions, async for coroutines (the default)."""
 
 
-def fully_async_executor(**kwargs):
-    return None
+class SyncExecutor(Executor):
+    """Force synchronous in-batch evaluation."""
+
+
+class AsyncExecutor(Executor):
+    """Run the UDF asynchronously (a sync fn is lifted to a coroutine)
+    with optional concurrency capacity, timeout and retries."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        timeout: float | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+    ):
+        self.capacity = capacity
+        self.timeout = timeout
+        self.retry_strategy = retry_strategy
+
+
+class FullyAsyncExecutor(AsyncExecutor):
+    """Results may arrive across ticks in the reference; under the
+    totally-ordered microbatch engine the batch completes within its tick
+    (same stance as AsyncTransformer), so this behaves as AsyncExecutor."""
+
+
+def auto_executor() -> Executor:
+    return AutoExecutor()
+
+
+def sync_executor() -> Executor:
+    return SyncExecutor()
+
+
+def async_executor(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+) -> Executor:
+    return AsyncExecutor(
+        capacity=capacity, timeout=timeout, retry_strategy=retry_strategy
+    )
+
+
+def fully_async_executor(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+) -> Executor:
+    return FullyAsyncExecutor(
+        capacity=capacity, timeout=timeout, retry_strategy=retry_strategy
+    )
