@@ -182,6 +182,7 @@ class Runtime:
         *,
         autocommit_ms: int = 50,
         on_tick: Callable[[int], None] | None = None,
+        worker_threads: bool = True,
     ):
         self.order = collect_nodes(outputs)
         self.execs: dict[int, NodeExec] = {
@@ -211,8 +212,66 @@ class Runtime:
         self._otel_metrics = get_metrics()
         self._otel_on = self._otel_metrics.enabled
         self._node_names = {n.id: type(n).__name__ for n in self.order}
+        # intra-tick worker parallelism (reference: PATHWAY_THREADS timely
+        # workers, src/engine/dataflow/config.rs:63-86): independent nodes
+        # of one topo level process concurrently on a thread pool. Each
+        # exec is touched by exactly one thread per tick; the win comes
+        # from branches whose hot work releases the GIL (numpy/jax/IO).
+        import os as _os
+
+        n_threads = (
+            int(_os.environ.get("PATHWAY_THREADS", "1") or 1)
+            if worker_threads
+            else 1
+        )
+        self._pool = None
+        self._levels: list[list[Any]] | None = None
+        if n_threads > 1:
+            level_of: dict[int, int] = {}
+            levels: list[list[Any]] = []
+            for node in self.order:
+                lvl = (
+                    max((level_of[i.id] for i in node.inputs), default=-1) + 1
+                )
+                level_of[node.id] = lvl
+                while len(levels) <= lvl:
+                    levels.append([])
+                levels[lvl].append(node)
+            if any(len(lv) > 1 for lv in levels):
+                import concurrent.futures as _cf
+
+                self._levels = levels
+                self._pool = _cf.ThreadPoolExecutor(
+                    max_workers=min(n_threads, 16),
+                    thread_name_prefix="pathway-worker",
+                )
 
     # --- core tick ------------------------------------------------------------
+
+    def _process_node(self, node, t, produced, injected, final, stats):
+        ex = self.execs[node.id]
+        if isinstance(ex, InputExec) and injected and node.id in injected:
+            for b in injected[node.id]:
+                ex.inject(b)
+        inputs = [produced.get(inp.id, []) for inp in node.inputs]
+        t0 = _time.perf_counter_ns()
+        out = ex.process(t, inputs)
+        if final:
+            out = list(out) + list(ex.on_end())
+        produced[node.id] = out
+        nrows = sum(len(b) for b in out)
+        if nrows:
+            stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
+        node_ns = _time.perf_counter_ns() - t0
+        stats.node_ns[node.id] = stats.node_ns.get(node.id, 0) + node_ns
+        if self._otel_on and (nrows or any(inputs)):
+            # only ticks that did work: idle 50 ms autocommit ticks
+            # would swamp the latency distribution with ~0 samples
+            self._otel_metrics.record_operator_latency(
+                self._node_names[node.id], node_ns
+            )
+        if isinstance(ex, InputExec) and nrows:
+            stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
 
     def tick(self, t: int, injected: dict[int, list[DiffBatch]] | None = None) -> None:
         """Process one logical time: push diffs through all nodes in topo
@@ -222,30 +281,32 @@ class Runtime:
         final = t >= END_OF_TIME
         stats = self.stats
         tick_start = _time.perf_counter_ns()
-        for node in self.order:
-            ex = self.execs[node.id]
-            if isinstance(ex, InputExec) and injected and node.id in injected:
-                for b in injected[node.id]:
-                    ex.inject(b)
-            inputs = [produced.get(inp.id, []) for inp in node.inputs]
-            t0 = _time.perf_counter_ns()
-            out = ex.process(t, inputs)
-            if final:
-                out = list(out) + list(ex.on_end())
-            produced[node.id] = out
-            nrows = sum(len(b) for b in out)
-            if nrows:
-                stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
-            node_ns = _time.perf_counter_ns() - t0
-            stats.node_ns[node.id] = stats.node_ns.get(node.id, 0) + node_ns
-            if self._otel_on and (nrows or any(inputs)):
-                # only ticks that did work: idle 50 ms autocommit ticks
-                # would swamp the latency distribution with ~0 samples
-                self._otel_metrics.record_operator_latency(
-                    self._node_names[node.id], node_ns
-                )
-            if isinstance(ex, InputExec) and nrows:
-                stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
+        if self._pool is not None and self._levels is not None:
+            for level in self._levels:
+                if len(level) == 1:
+                    self._process_node(
+                        level[0], t, produced, injected, final, stats
+                    )
+                    continue
+                import concurrent.futures as _cf
+
+                futures = [
+                    self._pool.submit(
+                        self._process_node,
+                        node, t, produced, injected, final, stats,
+                    )
+                    for node in level
+                ]
+                # fail-stop: wait for the WHOLE level first so no sibling
+                # keeps producing side effects after the error propagates
+                _cf.wait(futures)
+                for f in futures:
+                    exc = f.exception()
+                    if exc is not None:
+                        raise exc
+        else:
+            for node in self.order:
+                self._process_node(node, t, produced, injected, final, stats)
         for node in self._sinks:
             consumed = sum(
                 len(b) for inp in node.inputs for b in produced.get(inp.id, [])
@@ -381,7 +442,13 @@ class Runtime:
             and isinstance(node.source, StreamingSource)
             for node in self.order
         )
-        if has_streaming:
-            self.run_streaming()
-        else:
-            self.run_static()
+        try:
+            if has_streaming:
+                self.run_streaming()
+            else:
+                self.run_static()
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True, cancel_futures=True)
+                self._pool = None
+                self._levels = None  # reused Runtime runs sequentially

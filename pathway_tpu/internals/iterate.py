@@ -85,7 +85,9 @@ class IterateExec(NodeExec):
 
         for name, rnode in node.result_nodes.items():
             outputs.append(OutputNode(rnode, make_cb(name)))
-        rt = Runtime(outputs)
+        # nested per-iteration runtimes are driven via tick() directly and
+        # would leak one thread pool per fixpoint iteration
+        rt = Runtime(outputs, worker_threads=False)
         injected: dict[int, list[DiffBatch]] = {}
         for ph, name in zip(node.placeholder_nodes, node.iterated_names):
             rows = [(k, 1, v) for k, v in current[name].items()]
