@@ -72,7 +72,13 @@ from pathway_tpu.internals.custom_reducers import BaseCustomAccumulator
 from pathway_tpu.internals.iterate import iterate, iterate_universe
 from pathway_tpu.internals.yaml_loader import load_yaml
 import pathway_tpu.persistence as persistence
+import pathway_tpu.universes as universes
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
+from pathway_tpu.internals.joins import OuterJoinResult
+from pathway_tpu.stdlib.temporal._interval_join import IntervalJoinResult
+from pathway_tpu.stdlib.temporal._window_join import WindowJoinResult
+from pathway_tpu.stdlib.temporal._asof_join import AsofJoinResult
 from pathway_tpu.internals.row_transformer import (
     ClassArg,
     attribute,
@@ -166,6 +172,12 @@ __all__ = [
     "JoinResult",
     "JoinMode",
     "AsyncTransformer",
+    "AsofJoinResult",
+    "IntervalJoinResult",
+    "OuterJoinResult",
+    "WindowJoinResult",
+    "pandas_transformer",
+    "universes",
     "ClassArg",
     "attribute",
     "input_attribute",

@@ -19,7 +19,15 @@ from typing import Any, Callable, Iterable, Sequence
 import numpy as np
 
 from pathway_tpu.engine.batch import END_OF_TIME, DiffBatch
-from pathway_tpu.engine.nodes import InputExec, InputNode, Node, NodeExec
+import concurrent.futures as _cf
+
+from pathway_tpu.engine.nodes import (
+    InputExec,
+    InputNode,
+    Node,
+    NodeExec,
+    OutputNode,
+)
 
 
 def collect_nodes(outputs: Sequence[Node]) -> list[Node]:
@@ -217,13 +225,12 @@ class Runtime:
         # of one topo level process concurrently on a thread pool. Each
         # exec is touched by exactly one thread per tick; the win comes
         # from branches whose hot work releases the GIL (numpy/jax/IO).
-        import os as _os
+        if worker_threads:
+            from pathway_tpu.internals.config import engine_threads
 
-        n_threads = (
-            int(_os.environ.get("PATHWAY_THREADS", "1") or 1)
-            if worker_threads
-            else 1
-        )
+            n_threads = engine_threads()
+        else:
+            n_threads = 1
         self._pool = None
         self._levels: list[list[Any]] | None = None
         if n_threads > 1:
@@ -237,9 +244,18 @@ class Runtime:
                 while len(levels) <= lvl:
                     levels.append([])
                 levels[lvl].append(node)
+            # sinks run user callbacks — keep them serialized on their own
+            # levels so pre-existing callbacks need not be thread-safe
+            split: list[list[Any]] = []
+            for lv in levels:
+                sinks = [n for n in lv if isinstance(n, OutputNode)]
+                rest = [n for n in lv if not isinstance(n, OutputNode)]
+                if rest:
+                    split.append(rest)
+                for s in sinks:
+                    split.append([s])
+            levels = split
             if any(len(lv) > 1 for lv in levels):
-                import concurrent.futures as _cf
-
                 self._levels = levels
                 self._pool = _cf.ThreadPoolExecutor(
                     max_workers=min(n_threads, 16),
@@ -288,8 +304,6 @@ class Runtime:
                         level[0], t, produced, injected, final, stats
                     )
                     continue
-                import concurrent.futures as _cf
-
                 futures = [
                     self._pool.submit(
                         self._process_node,

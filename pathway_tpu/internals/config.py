@@ -60,3 +60,14 @@ pathway_config = PathwayConfig()
 
 def get_pathway_config() -> PathwayConfig:
     return pathway_config
+
+
+def engine_threads() -> int:
+    """Worker-thread count at RUN start. The reference re-reads the env
+    per run (Config::from_env, src/engine/dataflow/config.rs:88), unlike
+    the import-time PathwayConfig snapshot; the env wins when set."""
+    raw = os.environ.get("PATHWAY_THREADS", "")
+    try:
+        return max(1, int(raw)) if raw else max(1, pathway_config.threads)
+    except ValueError:
+        return max(1, pathway_config.threads)
