@@ -307,6 +307,157 @@ def table_to_dicts(table: Table):
     return keys, columns
 
 
+def table_from_parquet(
+    path: Any,
+    id_from: Sequence[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+    **kwargs: Any,
+) -> Table:
+    """Parquet file -> table via pandas (reference: debug/__init__.py:458)."""
+    import pandas as pd
+
+    return table_from_pandas(
+        pd.read_parquet(path),
+        id_from=id_from,
+        unsafe_trusted_ids=unsafe_trusted_ids,
+    )
+
+
+def table_to_parquet(table: Table, filename: Any) -> None:
+    """Run the graph, write the table to a Parquet file (reference:
+    debug/__init__.py:475)."""
+    df = table_to_pandas(table, include_id=False)
+    df.to_parquet(filename)
+
+
+class StreamGenerator:
+    """Explicitly-timestamped test streams (reference: debug/__init__.py:
+    490). The reference routes events through persistence replay; the
+    microbatch engine's sources take timestamped events directly, so
+    persistence_config() returns None and the tables stream on pw.run."""
+
+    def _table_from_dict(self, batches: dict, schema: Any) -> Table:
+        """batches: {time: {worker: [(diff, key, [values...]), ...]}} —
+        worker ids collapse onto the single logical worker."""
+        col_names = list(schema.column_names())
+        return self._from_batches(batches, col_names, dict(schema.dtypes()))
+
+    @staticmethod
+    def _from_batches(batches: dict, col_names: list, dtypes: dict) -> Table:
+        # reference semantics (debug/__init__.py:536-541): if ANY
+        # timestamp is odd, ALL are doubled, preserving relative order
+        if any(int(t) % 2 == 1 for t in batches):
+            import warnings
+
+            warnings.warn(
+                "timestamps are required to be even; all timestamps will "
+                "be doubled"
+            )
+            batches = {2 * int(t): v for t, v in batches.items()}
+        events: dict[int, list] = {}
+        for t, by_worker in batches.items():
+            for _worker, changes in by_worker.items():
+                for diff, key, values in changes:
+                    events.setdefault(int(t), []).append(
+                        (int(key), int(diff), tuple(values))
+                    )
+        source = _RowsSource(col_names, sorted(events.items()))
+        node = InputNode(source, col_names)
+        return Table._from_node(node, dtypes, Universe())
+
+    def table_from_list_of_batches_by_workers(
+        self, batches: list[dict[int, list[dict]]], schema: Any, **kw: Any
+    ) -> Table:
+        counter = iter(range(10**9))
+        as_dict: dict[int, dict[int, list]] = {}
+        for i, batch in enumerate(batches):
+            t = 2 * (i + 1)
+            as_dict[t] = {
+                w: [
+                    (
+                        1,
+                        int(sequential_key(next(counter))),
+                        [row[n] for n in schema.column_names()],
+                    )
+                    for row in rows
+                ]
+                for w, rows in batch.items()
+            }
+        return self._table_from_dict(as_dict, schema)
+
+    def table_from_list_of_batches(
+        self, batches: list[list[dict]], schema: Any, **kw: Any
+    ) -> Table:
+        return self.table_from_list_of_batches_by_workers(
+            [{0: batch} for batch in batches], schema
+        )
+
+    def table_from_pandas(
+        self,
+        df: Any,
+        id_from: list[str] | None = None,
+        unsafe_trusted_ids: bool = False,
+        schema: Any = None,
+        **kw: Any,
+    ) -> Table:
+        """`_time` / `_worker` / `_diff` columns control batching, exactly
+        as in the reference."""
+        df = df.copy()
+        for col, default in (("_time", 2), ("_worker", 0), ("_diff", 1)):
+            if col not in df:
+                df[col] = [default] * len(df)
+        value_cols = [
+            c for c in df.columns if c not in ("_time", "_worker", "_diff")
+        ]
+        if schema is None:
+            dtypes = {
+                n: _dtype_for([_np_unbox(v) for v in df[n]])
+                for n in value_cols
+            }
+        else:
+            dtypes = {n: schema.dtypes()[n] for n in value_cols}
+        batches: dict[int, dict[int, list]] = {}
+        for i in range(len(df)):
+            row = df.iloc[i]
+            vals = [_np_unbox(row[c]) for c in value_cols]
+            if id_from:
+                key = int(
+                    ref_scalar(*[vals[value_cols.index(c)] for c in id_from])
+                )
+            else:
+                key = int(sequential_key(i))
+            t = int(row["_time"])
+            batches.setdefault(t, {}).setdefault(int(row["_worker"]), []).append(
+                (int(row["_diff"]), key, vals)
+            )
+        return self._from_batches(batches, value_cols, dtypes)
+
+    def table_from_markdown(
+        self,
+        table: str,
+        id_from: list[str] | None = None,
+        unsafe_trusted_ids: bool = False,
+        schema: Any = None,
+        **kw: Any,
+    ) -> Table:
+        # rename the special columns in the HEADER LINE ONLY (a blanket
+        # replace would corrupt column names like event_time and cell
+        # values); `\b` won't match after a word char, so x_time survives
+        lines = table.strip().splitlines()
+        header = re.sub(r"\b_time\b", "__time__", lines[0])
+        header = re.sub(r"\b_diff\b", "__diff__", header)
+        md = "\n".join([header] + lines[1:])
+        t = table_from_markdown(md, id_from=id_from, schema=schema)
+        if "_worker" in t.column_names():
+            t = t.without("_worker")  # worker ids collapse in this engine
+        return t
+
+    def persistence_config(self):
+        """The microbatch engine feeds StreamGenerator tables directly —
+        no persistence replay needed; safe to pass to pw.run."""
+        return None
+
+
 def table_to_pandas(table: Table, include_id: bool = True):
     import pandas as pd
 

@@ -7,7 +7,16 @@ from __future__ import annotations
 from pathway_tpu.io import csv, fs, jsonlines, plaintext, python
 from pathway_tpu.io._subscribe import subscribe
 
+from pathway_tpu.io._subscribe import (  # noqa: F401
+    OnChangeCallback,
+    OnFinishCallback,
+)
+from pathway_tpu.io.csv import CsvParserSettings  # noqa: F401
+
 __all__ = [
+    "CsvParserSettings",
+    "OnChangeCallback",
+    "OnFinishCallback",
     "csv",
     "fs",
     "jsonlines",

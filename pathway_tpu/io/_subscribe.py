@@ -11,6 +11,11 @@ from pathway_tpu.internals.api import Pointer
 from pathway_tpu.internals.table import Table
 
 
+# callback type aliases (reference: io/_subscribe.py)
+OnChangeCallback = Callable[..., None]
+OnFinishCallback = Callable[[], None]
+
+
 def subscribe(
     table: Table,
     on_change: Callable[..., Any],

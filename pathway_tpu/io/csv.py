@@ -20,6 +20,8 @@ class CsvParserSettings:
         self.delimiter = delimiter
         self.quote = quote
         self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.enable_quoting = enable_quoting
         self.comment_character = comment_character
 
 

@@ -1,5 +1,7 @@
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnnMetricKind,
+    USearchMetricKind,
     BruteForceKnn,
     BruteForceKnnFactory,
     LshKnn,
@@ -23,6 +25,8 @@ from pathway_tpu.stdlib.indexing.full_text_document_index import (
 )
 
 __all__ = [
+    "BruteForceKnnMetricKind",
+    "USearchMetricKind",
     "DataIndex",
     "InnerIndex",
     "BruteForceKnn",

@@ -1,0 +1,5 @@
+"""Bundled ML datasets (reference: stdlib/ml/datasets)."""
+
+from . import classification
+
+__all__ = ["classification"]

@@ -1,6 +1,11 @@
 import importlib
 
 __all__ = [
+    "Interval",
+    "IntervalJoinResult",
+    "WindowJoinResult",
+    "AsofJoinResult",
+    "AsofNowJoinResult",
     "windowby",
     "tumbling",
     "sliding",
@@ -89,3 +94,11 @@ def __getattr__(name: str):
         globals()[name] = obj
         return obj
     raise AttributeError(name)
+
+from pathway_tpu.stdlib.temporal._interval_join import (  # noqa: E402
+    Interval,
+    IntervalJoinResult,
+)
+from pathway_tpu.stdlib.temporal._window_join import WindowJoinResult  # noqa: E402
+from pathway_tpu.stdlib.temporal._asof_join import AsofJoinResult  # noqa: E402
+from pathway_tpu.stdlib.temporal._asof_now_join import AsofNowJoinResult  # noqa: E402

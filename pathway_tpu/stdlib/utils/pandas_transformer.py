@@ -75,6 +75,11 @@ class _PandasTransformExec(NodeExec):
                     f"column(s) but output_schema declares "
                     f"{len(out_names)}: {list(out_names)}"
                 )
+            if result.index.has_duplicates:
+                raise ValueError(
+                    "pandas_transformer output index must be unique (it "
+                    "becomes the output universe)"
+                )
             result.columns = list(out_names)
             for key, row in result.iterrows():
                 new_vals[int(key)] = tuple(row[n] for n in out_names)
