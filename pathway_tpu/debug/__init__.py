@@ -401,7 +401,11 @@ class StreamGenerator:
         **kw: Any,
     ) -> Table:
         """`_time` / `_worker` / `_diff` columns control batching, exactly
-        as in the reference."""
+        as in the reference. A non-default DataFrame index provides the
+        row ids (hash of the index value), letting retractions target
+        earlier insertions."""
+        import pandas as pd
+
         df = df.copy()
         for col, default in (("_time", 2), ("_worker", 0), ("_diff", 1)):
             if col not in df:
@@ -416,6 +420,7 @@ class StreamGenerator:
             }
         else:
             dtypes = {n: schema.dtypes()[n] for n in value_cols}
+        explicit_ids = not isinstance(df.index, pd.RangeIndex)
         batches: dict[int, dict[int, list]] = {}
         for i in range(len(df)):
             row = df.iloc[i]
@@ -424,6 +429,8 @@ class StreamGenerator:
                 key = int(
                     ref_scalar(*[vals[value_cols.index(c)] for c in id_from])
                 )
+            elif explicit_ids:
+                key = int(ref_scalar(_np_unbox(df.index[i])))
             else:
                 key = int(sequential_key(i))
             t = int(row["_time"])
@@ -440,17 +447,37 @@ class StreamGenerator:
         schema: Any = None,
         **kw: Any,
     ) -> Table:
-        # rename the special columns in the HEADER LINE ONLY (a blanket
-        # replace would corrupt column names like event_time and cell
-        # values); `\b` won't match after a word char, so x_time survives
-        lines = table.strip().splitlines()
-        header = re.sub(r"\b_time\b", "__time__", lines[0])
-        header = re.sub(r"\b_diff\b", "__diff__", header)
-        md = "\n".join([header] + lines[1:])
-        t = table_from_markdown(md, id_from=id_from, schema=schema)
-        if "_worker" in t.column_names():
-            t = t.without("_worker")  # worker ids collapse in this engine
-        return t
+        # parse into a DataFrame and route through table_from_pandas so
+        # _time/_worker/_diff handling, odd-timestamp doubling and
+        # explicit-id semantics match the reference's single code path
+        import pandas as pd
+
+        lines = [l for l in table.strip().splitlines() if l.strip()]
+        lines = [l for l in lines if not re.fullmatch(r"[\s|:+-]+", l)]
+        if "|" in lines[0]:
+            split = [
+                [c.strip() for c in re.split(r"(?<!\\)\|", l)] for l in lines
+            ]
+            if all(r and r[0] == "" for r in split):
+                split = [r[1:] for r in split]
+            if all(r and r[-1] == "" for r in split):
+                split = [r[:-1] for r in split]
+        else:
+            split = [l.split() for l in lines]
+        header = split[0]
+        data = split[1:]
+        ids = None
+        if header and header[0] in ("", "id"):
+            header = header[1:]
+            ids = [_parse_value(r[0]) for r in data]
+            data = [r[1:] for r in data]
+        parsed = [[_parse_value(c) for c in row] for row in data]
+        df = pd.DataFrame(parsed, columns=header)
+        if ids is not None:
+            df.index = ids
+        return self.table_from_pandas(
+            df, id_from, unsafe_trusted_ids, schema
+        )
 
     def persistence_config(self):
         """The microbatch engine feeds StreamGenerator tables directly —

@@ -59,4 +59,3 @@ def require(module_name: str, connector: str, hint: str | None = None):
         if hint:
             msg += " " + hint
         raise ImportError(msg) from e
-

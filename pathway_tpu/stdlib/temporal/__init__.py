@@ -42,6 +42,11 @@ __all__ = [
 ]
 
 _locations = {
+    "Interval": "_interval_join",
+    "IntervalJoinResult": "_interval_join",
+    "WindowJoinResult": "_window_join",
+    "AsofJoinResult": "_asof_join",
+    "AsofNowJoinResult": "_asof_now_join",
     "windowby": "_window",
     "tumbling": "_window",
     "sliding": "_window",
@@ -94,11 +99,3 @@ def __getattr__(name: str):
         globals()[name] = obj
         return obj
     raise AttributeError(name)
-
-from pathway_tpu.stdlib.temporal._interval_join import (  # noqa: E402
-    Interval,
-    IntervalJoinResult,
-)
-from pathway_tpu.stdlib.temporal._window_join import WindowJoinResult  # noqa: E402
-from pathway_tpu.stdlib.temporal._asof_join import AsofJoinResult  # noqa: E402
-from pathway_tpu.stdlib.temporal._asof_now_join import AsofNowJoinResult  # noqa: E402

@@ -218,3 +218,37 @@ def test_pandas_transformer_duplicate_index_raises():
 
     with pytest.raises(ValueError, match="unique"):
         pw.debug.table_to_dicts(dup(t))
+
+
+def test_stream_generator_markdown_schema_and_worker():
+    """schema= plus a _worker column must work (reference supports it),
+    and odd markdown timestamps double like every other entry point."""
+    import warnings
+
+    sg = pw.debug.StreamGenerator()
+
+    class S(pw.Schema):
+        v: int
+
+    t = sg.table_from_markdown(
+        """
+        v  | _worker | _time
+        1  | 0       | 2
+        2  | 1       | 2
+        """,
+        schema=S,
+    )
+    _k, cols = pw.debug.table_to_dicts(t)
+    assert sorted(cols["v"].values()) == [1, 2]
+    assert t.column_names() == ["v"]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t2 = sg.table_from_markdown(
+            """
+            v | _time
+            5 | 3
+            """
+        )
+        pw.debug.table_to_dicts(t2)
+    assert any("doubled" in str(x.message) for x in w)
