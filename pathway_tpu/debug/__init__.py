@@ -89,22 +89,13 @@ def _dtype_for(values: list[Any]) -> dt.DType:
     return out
 
 
-def table_from_markdown(
-    table_def: str,
-    id_from: Sequence[str] | None = None,
-    unsafe_trusted_ids: bool = False,
-    schema: Any = None,
-    _stream: bool = False,
-) -> Table:
-    """Parse a markdown / whitespace table. Special columns: ``__time__``
-    (logical time), ``__diff__`` (+1/-1)."""
+def _split_markdown(table_def: str):
+    """Shared markdown tokenizer: (header, data_rows, raw_ids|None) —
+    separator-row filtering, escaped-pipe splitting, edge-cell stripping
+    and leading-id-column detection used by table_from_markdown and
+    StreamGenerator.table_from_markdown."""
     lines = [l for l in table_def.strip().splitlines() if l.strip()]
-    # drop markdown separator rows like |---|---|
-    lines = [
-        l
-        for l in lines
-        if not re.fullmatch(r"[\s|:+-]+", l)
-    ]
+    lines = [l for l in lines if not re.fullmatch(r"[\s|:+-]+", l)]
     if "|" in lines[0]:
         split = [
             [c.strip() for c in re.split(r"(?<!\\)\|", l)] for l in lines
@@ -116,15 +107,7 @@ def table_from_markdown(
             split = [r[:-1] for r in split]
         header = split[0]
         data = split[1:]
-        # leading unnamed column = explicit row ids (reference style:
-        # "  | a | __time__" header with "9 | 0 | 2" rows)
         has_id_col = header[0] in ("", "id")
-        if has_id_col:
-            header = header[1:]
-            ids = [r[0] for r in data]
-            data = [r[1:] for r in data]
-        else:
-            ids = None
     else:
         header = lines[0].split()
         if len(header) == 1:
@@ -134,12 +117,25 @@ def table_from_markdown(
         else:
             data = [l.split() for l in lines[1:]]
         has_id_col = header[0] == "id"
-        if has_id_col:
-            header = header[1:]
-            ids = [r[0] for r in data]
-            data = [r[1:] for r in data]
-        else:
-            ids = None
+    ids = None
+    if has_id_col:
+        # leading unnamed column = explicit row ids (reference style)
+        header = header[1:]
+        ids = [r[0] for r in data]
+        data = [r[1:] for r in data]
+    return header, data, ids
+
+
+def table_from_markdown(
+    table_def: str,
+    id_from: Sequence[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+    schema: Any = None,
+    _stream: bool = False,
+) -> Table:
+    """Parse a markdown / whitespace table. Special columns: ``__time__``
+    (logical time), ``__diff__`` (+1/-1)."""
+    header, data, ids = _split_markdown(table_def)
     col_names = [h for h in header if h not in ("__time__", "__diff__")]
     time_idx = header.index("__time__") if "__time__" in header else None
     diff_idx = header.index("__diff__") if "__diff__" in header else None
@@ -413,6 +409,8 @@ class StreamGenerator:
         value_cols = [
             c for c in df.columns if c not in ("_time", "_worker", "_diff")
         ]
+        if id_from is None and schema is not None:
+            id_from = schema.primary_key_columns()
         if schema is None:
             dtypes = {
                 n: _dtype_for([_np_unbox(v) for v in df[n]])
@@ -452,27 +450,12 @@ class StreamGenerator:
         # explicit-id semantics match the reference's single code path
         import pandas as pd
 
-        lines = [l for l in table.strip().splitlines() if l.strip()]
-        lines = [l for l in lines if not re.fullmatch(r"[\s|:+-]+", l)]
-        if "|" in lines[0]:
-            split = [
-                [c.strip() for c in re.split(r"(?<!\\)\|", l)] for l in lines
-            ]
-            if all(r and r[0] == "" for r in split):
-                split = [r[1:] for r in split]
-            if all(r and r[-1] == "" for r in split):
-                split = [r[:-1] for r in split]
-        else:
-            split = [l.split() for l in lines]
-        header = split[0]
-        data = split[1:]
-        ids = None
-        if header and header[0] in ("", "id"):
-            header = header[1:]
-            ids = [_parse_value(r[0]) for r in data]
-            data = [r[1:] for r in data]
+        header, data, raw_ids = _split_markdown(table)
+        ids = (
+            [_parse_value(x) for x in raw_ids] if raw_ids is not None else None
+        )
         parsed = [[_parse_value(c) for c in row] for row in data]
-        df = pd.DataFrame(parsed, columns=header)
+        df = pd.DataFrame(parsed, columns=header, dtype=object)
         if ids is not None:
             df.index = ids
         return self.table_from_pandas(

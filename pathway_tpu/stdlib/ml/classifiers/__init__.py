@@ -50,6 +50,7 @@ def knn_lsh_classifier_train(
             predicted_label=apply_with_type(majority, Any, matches.label)
         )
 
+    label_query._train_args = (data, L, type, dict(kwargs))
     return label_query
 
 
@@ -59,3 +60,46 @@ def knn_lsh_train(*args, **kwargs):
 
 def knn_lsh_generic_classifier_train(*args, **kwargs):
     return knn_lsh_classifier_train(*args, **kwargs)
+
+
+def knn_lsh_euclidean_classifier_train(data, d, M, L, A, **kwargs):
+    """Euclidean-LSH-parameterized trainer (reference: _knn_lsh.py:293).
+    The TPU build's candidate search is exact dense top-k, so the LSH
+    parameters select the distance metric; d doubles as the dimension
+    hint."""
+    return knn_lsh_classifier_train(data, L=L, type="euclidean", d=d, **kwargs)
+
+
+def knn_lsh_classify(knn_model, data_labels, queries, k):
+    """Classify queries by majority vote over the k nearest training rows
+    (reference: _knn_lsh.py:306). ``data_labels`` must share the training
+    table's universe (one label per training row); its labels override any
+    label column the model was trained with."""
+    data, L, type_, kwargs = knn_model._train_args
+    labels = data_labels.restrict(data)
+    enriched = data.with_columns(label=labels.label)
+    relabeled = knn_lsh_classifier_train(enriched, L=L, type=type_, **kwargs)
+    return relabeled(queries, k=k)
+
+
+from pathway_tpu.stdlib.ml.classifiers._lsh import (  # noqa: E402
+    generate_cosine_lsh_bucketer,
+    generate_euclidean_lsh_bucketer,
+    lsh,
+)
+from pathway_tpu.stdlib.ml.classifiers._clustering_via_lsh import (  # noqa: E402
+    clustering_via_lsh,
+)
+
+__all__ = [
+    "DistanceTypes",
+    "clustering_via_lsh",
+    "generate_cosine_lsh_bucketer",
+    "generate_euclidean_lsh_bucketer",
+    "knn_lsh_classifier_train",
+    "knn_lsh_classify",
+    "knn_lsh_euclidean_classifier_train",
+    "knn_lsh_generic_classifier_train",
+    "knn_lsh_train",
+    "lsh",
+]

@@ -24,23 +24,68 @@ def unpack_col(column, *unpacked_columns: str, schema: Any = None) -> Table:
     return table.select(**exprs)
 
 
-def multiapply_all_rows(*args, **kwargs):
-    raise NotImplementedError
+def multiapply_all_rows(
+    *cols: Any,
+    fun: Any,
+    result_col_names: Any,
+) -> Table:
+    """Apply ``fun`` to whole columns at once: it receives one list per
+    input column (aligned by row) and returns one list per output column
+    (reference: stdlib/utils/col.py:194). The result table shares the
+    input universe."""
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.schema import schema_from_types
+    from pathway_tpu.stdlib.utils.pandas_transformer import (
+        pandas_transformer,
+    )
+
+    table = cols[0].table
+    in_names = [f"_c{i}" for i in range(len(cols))]
+    sel = table.select(**dict(zip(in_names, cols)))
+    out_names = [
+        c if isinstance(c, str) else c.name for c in result_col_names
+    ]
+    out_schema = schema_from_types(**{n: dt.ANY for n in out_names})
+
+    @pandas_transformer(output_schema=out_schema, output_universe=0)
+    def inner(df):
+        import pandas as pd
+
+        results = fun(*[df[n].tolist() for n in in_names])
+        return pd.DataFrame(
+            dict(zip(out_names, results)), index=df.index
+        )
+
+    return inner(sel)
 
 
-def apply_all_rows(*args, **kwargs):
-    raise NotImplementedError
+def apply_all_rows(
+    *cols: Any, fun: Any, result_col_name: Any
+) -> Table:
+    """Single-output variant of multiapply_all_rows (reference:
+    stdlib/utils/col.py:241)."""
+    return multiapply_all_rows(
+        *cols,
+        fun=lambda *lists: (fun(*lists),),
+        result_col_names=[result_col_name],
+    )
 
 
 def groupby_reduce_majority(column, value_column):
+    """Per group, the MOST FREQUENT value (a real majority vote — count
+    per (group, value), then argmax; reference: col.py
+    groupby_reduce_majority)."""
     import pathway_tpu as pw
 
-    table = None
-    for ref in column._dependencies():
-        table = ref.table
-        break
-    return table.groupby(column).reduce(
-        column, majority=pw.reducers.any(value_column)
+    table = column.table
+    name = column.name
+    sel = table.select(_g=column, _v=value_column)
+    counted = sel.groupby(sel._g, sel._v).reduce(
+        sel._g, sel._v, _c=pw.reducers.count()
+    )
+    return counted.groupby(counted._g).reduce(
+        **{name: counted._g},
+        majority=pw.reducers.argmax(counted._c, counted._v),
     )
 
 

@@ -91,3 +91,115 @@ def test_telemetry_span_timings():
     with tel.span("test.block"):
         pass
     assert "test.block" in tel.timings
+
+
+def test_lsh_bucketers_and_flatten():
+    """LSH bucketers are deterministic and locality-sensitive; lsh()
+    expands rows into (band, bucket) candidates (reference:
+    classifiers/_lsh.py)."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.ml.classifiers import (
+        generate_cosine_lsh_bucketer,
+        generate_euclidean_lsh_bucketer,
+        lsh,
+    )
+
+    buck = generate_euclidean_lsh_bucketer(d=8, M=4, L=5, A=2.0)
+    x = np.ones(8)
+    assert (buck(x) == buck(x.copy())).all()  # deterministic
+    assert len(buck(x)) == 5  # one bucket per band
+    # near points collide in at least one band far more often than far ones
+    near = buck(x + 0.01)
+    far = buck(x + 100.0)
+    assert (buck(x) == near).sum() >= (buck(x) == far).sum()
+
+    cos = generate_cosine_lsh_bucketer(d=8, M=6, L=3)
+    assert (cos(x) == cos(2 * x)).all()  # scale-invariant
+
+    class V(pw.Schema):
+        data: pw.internals.dtype.ANY  # type: ignore[valid-type]
+
+    import pathway_tpu as _pw
+
+    t = _pw.debug.table_from_rows(
+        V, [(np.ones(8),), (np.zeros(8) + 5,)]
+    )
+    flat = lsh(t, buck, origin_id="oid", include_data=True)
+    _k, cols = _pw.debug.table_to_dicts(flat)
+    assert len(cols["band"]) == 2 * 5  # rows x bands
+    assert set(cols.keys()) == {"oid", "bucketing", "band", "data"}
+
+
+def test_clustering_via_lsh():
+    import numpy as np
+
+    import pathway_tpu as pw2
+    from pathway_tpu.stdlib.ml.classifiers import (
+        clustering_via_lsh,
+        generate_euclidean_lsh_bucketer,
+    )
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(0, 0.1, size=(10, 4)) + np.array([10, 0, 0, 0])
+    b = rng.normal(0, 0.1, size=(10, 4)) + np.array([-10, 0, 0, 0])
+
+    class V(pw2.Schema):
+        data: pw2.internals.dtype.ANY  # type: ignore[valid-type]
+
+    t = pw2.debug.table_from_rows(V, [(v,) for v in np.vstack([a, b])])
+    buck = generate_euclidean_lsh_bucketer(d=4, M=3, L=4, A=4.0)
+    res = clustering_via_lsh(t, buck, k=2)
+    _k, cols = pw2.debug.table_to_dicts(res)
+    labels = list(cols["label"].values())
+    assert len(labels) == 20 and set(labels) <= {0, 1}
+    # the two blobs separate: each cluster has 10 members
+    assert sorted([labels.count(0), labels.count(1)]) == [10, 10]
+
+
+def test_knn_lsh_classify_with_separate_labels():
+    """Reference pattern: train on vectors only, provide labels separately
+    (reference: _knn_lsh.py:306 knn_lsh_classify)."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.ml.classifiers import (
+        knn_lsh_classify,
+        knn_lsh_train,
+    )
+
+    class V(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        data: pw.internals.dtype.ANY  # type: ignore[valid-type]
+
+    class L(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        label: str
+
+    vecs = [np.array([10.0, 0]), np.array([11.0, 0]),
+            np.array([-10.0, 0]), np.array([-11.0, 0])]
+    data = pw.debug.table_from_rows(V, [(i, v) for i, v in enumerate(vecs)])
+    labels = pw.debug.table_from_rows(
+        L, [(0, "right"), (1, "right"), (2, "left"), (3, "left")]
+    )
+    model = knn_lsh_train(data, d=2)
+    queries = pw.debug.table_from_rows(
+        V, [(100, np.array([9.0, 0])), (101, np.array([-9.0, 0]))]
+    )
+    res = knn_lsh_classify(model, labels, queries, k=2)
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert sorted(cols["predicted_label"].values()) == ["left", "right"]
+
+
+def test_groupby_reduce_majority_is_a_real_majority():
+    from pathway_tpu.stdlib.utils.col import groupby_reduce_majority
+
+    class S(pw.Schema):
+        g: int
+        v: str
+
+    rows = [(1, "a"), (1, "a"), (1, "b"), (2, "x")]
+    t = pw.debug.table_from_rows(S, rows)
+    res = groupby_reduce_majority(t.g, t.v)
+    _k, cols = pw.debug.table_to_dicts(res)
+    got = dict(zip(cols["g"].values(), cols["majority"].values()))
+    assert got == {1: "a", 2: "x"}
