@@ -35,7 +35,7 @@ class _RowsSource(StaticSource):
 def _parse_value(s: str) -> Any:
     s = s.strip()
     if s == "":
-        return ""
+        return None  # empty markdown cell = None (reference semantics)
     if s in ("None", "null"):
         return None
     if s == "True" or s == "true":
@@ -409,7 +409,10 @@ class StreamGenerator:
         value_cols = [
             c for c in df.columns if c not in ("_time", "_worker", "_diff")
         ]
-        if id_from is None and schema is not None:
+        explicit_ids = not isinstance(df.index, pd.RangeIndex)
+        if id_from is None and schema is not None and not explicit_ids:
+            # schema primary keys fill in only when the index carries no
+            # explicit ids (explicit ids win, like table_from_markdown)
             id_from = schema.primary_key_columns()
         if schema is None:
             dtypes = {
@@ -418,7 +421,6 @@ class StreamGenerator:
             }
         else:
             dtypes = {n: schema.dtypes()[n] for n in value_cols}
-        explicit_ids = not isinstance(df.index, pd.RangeIndex)
         batches: dict[int, dict[int, list]] = {}
         for i in range(len(df)):
             row = df.iloc[i]

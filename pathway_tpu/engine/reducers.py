@@ -171,27 +171,30 @@ class MaxAcc(_MultisetAcc):
 
 
 class ArgMinAcc(_MultisetAcc):
-    # args = (value, arg)
+    # args = (value, arg); ties on the value break on the SMALLEST arg by
+    # its stable sort key — never hash(), which is PYTHONHASHSEED-salted
+    # and would make results differ between process runs
     def value(self):
         if not self.items:
             return ERROR
-        best = min(self.items, key=lambda kv: (_sort_key(kv[0]), kv[1]))
-        return best[1]
+        best_val = min((k[0] for k in self.items), key=_sort_key)
+        bk = _sort_key(best_val)
+        return min(
+            (k[1] for k in self.items if _sort_key(k[0]) == bk),
+            key=_sort_key,
+        )
 
 
 class ArgMaxAcc(_MultisetAcc):
     def value(self):
         if not self.items:
             return ERROR
-        best = max(self.items, key=lambda kv: (_sort_key(kv[0]), -_hash_order(kv[1])))
-        return best[1]
-
-
-def _hash_order(v: Any) -> int:
-    try:
-        return int(v)
-    except (TypeError, ValueError):
-        return hash(v)
+        best_val = max((k[0] for k in self.items), key=_sort_key)
+        bk = _sort_key(best_val)
+        return min(
+            (k[1] for k in self.items if _sort_key(k[0]) == bk),
+            key=_sort_key,
+        )
 
 
 class UniqueAcc(_MultisetAcc):

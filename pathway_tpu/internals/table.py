@@ -54,11 +54,62 @@ def desugar(e: Any, mapping: Mapping[ThisPlaceholder, "Table"]) -> ColumnExpress
             if ref.name == "id":
                 return ColumnReference(target, "id")
             return target[ref.name]
+        if isinstance(tbl, _DeferredIxTable):
+            caller = mapping.get(this)
+            if caller is None:
+                raise ValueError(
+                    "ix_ref() without table-bound arguments can only be "
+                    "used inside a table operation (select/filter/...)"
+                )
+            return tbl._materialize(caller)[ref.name]
         if isinstance(tbl, ThisPlaceholder.__mro__[0]):
             return None
         return None
 
     return e._substitute(sub)
+
+
+class _DeferredIxTable:
+    """`table.ix_ref(...)` whose indexer universe isn't known yet — the
+    args reference no concrete table (constants or pw.this). Column
+    accesses return references that desugar() materializes against the
+    CALLING operation's table (reference: ix expressions resolve in the
+    select's context), enabling e.g. the singleton-broadcast pattern
+    ``t.select(v=t.reduce(v=1).ix_ref().v)``."""
+
+    def __init__(self, source: "Table", args: tuple, optional: bool, instance):
+        self._source = source
+        self._args = args
+        self._optional = optional
+        self._instance = instance
+        self._cache: dict[int, "Table"] = {}
+
+    def _materialize(self, caller: "Table") -> "Table":
+        key = id(caller)
+        if key not in self._cache:
+            self._keepalive = getattr(self, "_keepalive", [])
+            self._keepalive.append(caller)  # pin: id() reuse after GC
+                                            # would alias a dead table
+            ptr = caller.pointer_from(
+                *[caller._desugar(a) for a in self._args],
+                instance=(
+                    caller._desugar(self._instance)
+                    if self._instance is not None
+                    else None
+                ),
+            )
+            self._cache[key] = self._source.ix(
+                ptr, optional=self._optional, context=caller
+            )
+        return self._cache[key]
+
+    def __getitem__(self, name: str) -> ColumnReference:
+        return ColumnReference(self, name)
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ColumnReference(self, name)
 
 
 def _collect_tables(exprs: Iterable[ColumnExpression]) -> list["Table"]:
@@ -607,9 +658,17 @@ class Table(Joinable):
         optional: bool = False,
         context=None,
         instance: Any = None,
-    ) -> "Table":
+    ):
         if context is None:
-            context = self
+            arg_tables = _collect_tables(
+                [wrap_expr(a) for a in args]
+            ) if args else []
+            if arg_tables:
+                context = arg_tables[0]
+        if context is None:
+            # constants / pw.this args: the indexer universe is the CALLER's
+            # — defer until the expression is used in a table operation
+            return _DeferredIxTable(self, args, optional, instance)
         ptr = context.pointer_from(*args, instance=instance)
         return self.ix(ptr, optional=optional, context=context)
 

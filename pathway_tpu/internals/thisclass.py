@@ -41,7 +41,10 @@ class ThisPlaceholder:
         return f"pw.{self._kind}"
 
     def __iter__(self):
-        raise TypeError(f"pw.{self._kind} is not iterable")
+        # `*pw.this` in select(...) expands to all columns (the positional
+        # ThisPlaceholder handler does the expansion; iteration just hands
+        # the placeholder through)
+        return iter([self])
 
 
 class ThisSlice:

@@ -75,11 +75,22 @@ def knn_lsh_classify(knn_model, data_labels, queries, k):
     (reference: _knn_lsh.py:306). ``data_labels`` must share the training
     table's universe (one label per training row); its labels override any
     label column the model was trained with."""
-    data, L, type_, kwargs = knn_model._train_args
-    labels = data_labels.restrict(data)
-    enriched = data.with_columns(label=labels.label)
-    relabeled = knn_lsh_classifier_train(enriched, L=L, type=type_, **kwargs)
-    return relabeled(queries, k=k)
+    cache = getattr(knn_model, "_classify_cache", None)
+    if cache is None:
+        cache = knn_model._classify_cache = {}
+    entry = cache.get(id(data_labels))
+    if entry is None or entry[0] is not data_labels:
+        data, L, type_, kwargs = knn_model._train_args
+        labels = data_labels.restrict(data)
+        enriched = data.with_columns(label=labels.label)
+        relabeled = knn_lsh_classifier_train(
+            enriched, L=L, type=type_, **kwargs
+        )
+        # hold data_labels so id() can't alias a collected table, and so
+        # repeated classify calls reuse one index build
+        cache[id(data_labels)] = (data_labels, relabeled)
+        entry = cache[id(data_labels)]
+    return entry[1](queries, k=k)
 
 
 from pathway_tpu.stdlib.ml.classifiers._lsh import (  # noqa: E402

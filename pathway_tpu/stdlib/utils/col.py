@@ -79,6 +79,11 @@ def groupby_reduce_majority(column, value_column):
 
     table = column.table
     name = column.name
+    if name == "majority":
+        raise ValueError(
+            "groupby_reduce_majority: the grouping column cannot be named "
+            "'majority' (it collides with the result column)"
+        )
     sel = table.select(_g=column, _v=value_column)
     counted = sel.groupby(sel._g, sel._v).reduce(
         sel._g, sel._v, _c=pw.reducers.count()

@@ -1,0 +1,63 @@
+"""Reference-test harness shims (reference: python/pathway/tests/utils.py):
+assert_table_equality / _wo_index compare the captured final state of two
+tables, with or without row-key identity."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+
+
+def _capture(table) -> dict[int, tuple]:
+    from pathway_tpu.debug import _run_capture
+
+    return _run_capture([table])[0].rows
+
+
+def _both(t1, t2):
+    from pathway_tpu.debug import _run_capture
+
+    c1, c2 = _run_capture([t1, t2])
+    return c1.rows, c2.rows
+
+
+def _norm(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return ("__ndarray__", v.dtype.kind, tuple(np.ravel(v).tolist()))
+    if isinstance(v, float) and v != v:
+        return "__nan__"
+    return v
+
+
+def assert_table_equality(t1, t2) -> None:
+    """Same keys AND same values per key."""
+    r1, r2 = _both(t1, t2)
+    n1 = {k: tuple(_norm(x) for x in v) for k, v in r1.items()}
+    n2 = {k: tuple(_norm(x) for x in v) for k, v in r2.items()}
+    assert n1 == n2, f"\nleft:  {sorted(n1.items())}\nright: {sorted(n2.items())}"
+
+
+def assert_table_equality_wo_index(t1, t2) -> None:
+    """Same multiset of rows, ignoring keys."""
+    r1, r2 = _both(t1, t2)
+
+    def multiset(rows):
+        out: dict = {}
+        for v in rows.values():
+            key = tuple(_norm(x) for x in v)
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    m1, m2 = multiset(r1), multiset(r2)
+    assert m1 == m2, f"\nleft:  {sorted(m1)}\nright: {sorted(m2)}"
+
+
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+assert_table_equality_wo_types = assert_table_equality
+
+
+def run_all(**kwargs) -> None:
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
