@@ -95,7 +95,13 @@ def _split_markdown(table_def: str):
     and leading-id-column detection used by table_from_markdown and
     StreamGenerator.table_from_markdown."""
     lines = [l for l in table_def.strip().splitlines() if l.strip()]
-    lines = [l for l in lines if not re.fullmatch(r"[\s|:+-]+", l)]
+    # separator rows (|---|:--|) need a dash: a dashless all-empty row
+    # like "   |   " is DATA — a row of Nones (reference semantics)
+    lines = [
+        l
+        for l in lines
+        if not (re.fullmatch(r"[\s|:+-]+", l) and "-" in l)
+    ]
     if "|" in lines[0]:
         split = [
             [c.strip() for c in re.split(r"(?<!\\)\|", l)] for l in lines

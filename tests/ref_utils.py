@@ -37,7 +37,10 @@ def assert_table_equality(t1, t2) -> None:
     r1, r2 = _both(t1, t2)
     n1 = {k: tuple(_norm(x) for x in v) for k, v in r1.items()}
     n2 = {k: tuple(_norm(x) for x in v) for k, v in r2.items()}
-    assert n1 == n2, f"\nleft:  {sorted(n1.items())}\nright: {sorted(n2.items())}"
+    assert n1 == n2, (
+        f"\nleft:  {sorted(n1.items(), key=str)}"
+        f"\nright: {sorted(n2.items(), key=str)}"
+    )
 
 
 def assert_table_equality_wo_index(t1, t2) -> None:
@@ -52,7 +55,9 @@ def assert_table_equality_wo_index(t1, t2) -> None:
         return out
 
     m1, m2 = multiset(r1), multiset(r2)
-    assert m1 == m2, f"\nleft:  {sorted(m1)}\nright: {sorted(m2)}"
+    assert m1 == m2, (
+        f"\nleft:  {sorted(m1, key=str)}\nright: {sorted(m2, key=str)}"
+    )
 
 
 assert_table_equality_wo_index_types = assert_table_equality_wo_index

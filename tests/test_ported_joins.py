@@ -1,0 +1,251 @@
+"""Ported join-semantics tests (reference:
+python/pathway/tests/test_joins.py) — left/right/outer behavior with
+duplicates, missing sides, require-guards, set-id joins, and pw.left /
+pw.right desugaring."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from tests.ref_utils import assert_table_equality_wo_index
+
+
+def _t1():
+    return T(
+        """
+            | a  | b
+          1 | 11 | 111
+          2 | 12 | 112
+          3 | 13 | 113
+          4 | 14 | 114
+        """
+    )
+
+
+def test_left_join_01():
+    t1 = _t1()
+    t2 = T(
+        """
+            | a  | d
+          1 | 11 | 211
+          2 | 12 | 212
+          3 | 13 | 213
+          4 | 14 | 214
+        """
+    )
+    expected = T(
+        """
+        a   | t2_a  | s
+        11  | 11    | 322
+        12  | 12    | 324
+        13  | 13    | 326
+        14  | 14    | 328
+        """
+    )
+    res = t1.join_left(t2, t1.a == t2.a).select(
+        t1.a,
+        t2_a=t2.a,
+        s=pw.require(t1.b + t2.d, t1.id, t2.id),
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_left_join_empty_duplicates():
+    t1 = _t1()
+    t2 = T(
+        """
+            | c  | d
+          1 | 11 | 211
+          2 | 13 | 212
+          3 | 13 | 213
+          4 | 13 | 214
+        """
+    )
+    expected = T(
+        """
+        t2_c2  | s
+        121    | 322
+        169    | 325
+        169    | 326
+        169    | 327
+               |
+               |
+        """
+    )
+    res = t1.join_left(t2, t1.a == t2.c).select(
+        t2_c2=pw.require(t2.c * t2.c, t2.id),
+        s=pw.require(t1.b + t2.d, t2.id),
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_right_join_duplicates():
+    t1 = _t1()
+    t2 = T(
+        """
+            | c  | d
+          1 | 11 | 211
+          2 | 13 | 212
+          3 | 13 | 213
+          4 | 15 | 214
+        """
+    )
+    res = t1.join_right(t2, t1.a == t2.c).select(
+        b=pw.require(t1.b, t1.id),
+        d=t2.d,
+    )
+    expected = T(
+        """
+        b    | d
+        111  | 211
+        113  | 212
+        113  | 213
+             | 214
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_outer_join():
+    t1 = T(
+        """
+        a  | b
+        11 | 111
+        12 | 112
+        """
+    )
+    t2 = T(
+        """
+        c  | d
+        12 | 212
+        13 | 213
+        """
+    )
+    res = t1.join_outer(t2, t1.a == t2.c).select(
+        a=pw.require(t1.a, t1.id),
+        c=pw.require(t2.c, t2.id),
+    )
+    expected = T(
+        """
+        a  | c
+        11 |
+        12 | 12
+           | 13
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_left_join_this_desugaring():
+    t1 = _t1()
+    t2 = T(
+        """
+            | a  | d
+          1 | 11 | 211
+          2 | 12 | 212
+          3 | 13 | 213
+          4 | 14 | 214
+        """
+    )
+    res = t1.join_left(t2, pw.left.a == pw.right.a).select(
+        pw.left.b, d=pw.right.d
+    )
+    expected = T(
+        """
+        b   | d
+        111 | 211
+        112 | 212
+        113 | 213
+        114 | 214
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_left_join_set_id():
+    """id=pw.left.id: output universe reuses the left row ids."""
+    t1 = _t1()
+    t2 = T(
+        """
+            | a  | d
+          1 | 11 | 211
+          2 | 12 | 212
+        """
+    )
+    res = t1.join_left(t2, t1.a == t2.a, id=t1.id).select(
+        t1.b, d=pw.require(t2.d, t2.id)
+    )
+    _k, cols = pw.debug.table_to_dicts(res)
+    _k1, cols1 = pw.debug.table_to_dicts(_t1())
+    assert set(_k) == set(_k1)  # left universe preserved
+
+
+def test_join_inner_chained_conditions():
+    t1 = T(
+        """
+        a | b | v
+        1 | x | 10
+        1 | y | 20
+        2 | x | 30
+        """
+    )
+    t2 = T(
+        """
+        a | b | w
+        1 | x | 7
+        2 | x | 8
+        2 | y | 9
+        """
+    )
+    res = t1.join(t2, t1.a == t2.a, t1.b == t2.b).select(
+        t1.v, w=t2.w
+    )
+    expected = T(
+        """
+        v  | w
+        10 | 7
+        30 | 8
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_cross_no_condition():
+    """Join with no conditions = cross product (reference join semantics)."""
+    t1 = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    t2 = T(
+        """
+        b
+        x
+        y
+        """
+    )
+    res = t1.join(t2).select(t1.a, t2.b)
+    _k, cols = pw.debug.table_to_dicts(res)
+    got = sorted(zip(cols["a"].values(), cols["b"].values()))
+    assert got == [(1, "x"), (1, "y"), (2, "x"), (2, "y")]
+
+
+def test_join_select_star_left_right():
+    t1 = T(
+        """
+        a | b
+        1 | 10
+        """
+    )
+    t2 = T(
+        """
+        c | d
+        1 | 20
+        """
+    )
+    res = t1.join(t2, t1.a == t2.c).select(*pw.left, *pw.right)
+    assert sorted(res.column_names()) == ["a", "b", "c", "d"]
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["d"].values()) == [20]
