@@ -1,0 +1,137 @@
+"""Ported expression-namespace tests (reference:
+python/pathway/tests/expressions/{test_string,test_numerical,
+test_datetimes}.py) — the .str/.num/.dt method surface."""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+
+
+def _col(expr_builder, rows, colname="a"):
+    import pathway_tpu as _pw
+
+    class S(_pw.Schema):
+        a: _pw.internals.dtype.ANY  # type: ignore[valid-type]
+
+    t = _pw.debug.table_from_rows(S, [(r,) for r in rows])
+    res = t.select(out=expr_builder(t))
+    _k, cols = _pw.debug.table_to_dicts(res)
+    return list(cols["out"].values())
+
+
+def test_str_strip():
+    vals = _col(
+        lambda t: t.a.str.strip(),
+        ["   abc", "   def   ", "ab   cd  ", "xy  zt", "zy  "],
+    )
+    assert sorted(vals) == sorted(["abc", "def", "ab   cd", "xy  zt", "zy"])
+
+
+def test_str_count_find():
+    vals = _col(lambda t: t.a.str.count("o"), ["Zoo", "World", "Hello"])
+    assert sorted(vals) == [1, 1, 2]
+    vals = _col(lambda t: t.a.str.find("l"), ["Hello", "World", "abc"])
+    assert sorted(vals) == [-1, 2, 3]
+
+
+def test_str_parse_int():
+    vals = _col(
+        lambda t: t.a.str.parse_int(),
+        ["10", "0", "-1", "-2", "4294967297", "35184372088833"],
+    )
+    assert sorted(vals) == sorted([10, 0, -1, -2, 2**32 + 1, 2**45 + 1])
+    assert all(isinstance(v, int) for v in vals)
+
+
+def test_str_parse_float():
+    vals = _col(
+        lambda t: t.a.str.parse_float(), ["10.345", "-1.99", "4294967297"]
+    )
+    assert sorted(vals) == sorted([10.345, -1.99, float(2**32 + 1)])
+
+
+def test_str_parse_bool():
+    vals = _col(
+        lambda t: t.a.str.parse_bool(),
+        ["On", "true", "1", "Yes", "off", "False", "0", "no"],
+    )
+    assert vals.count(True) == 4 and vals.count(False) == 4
+
+
+def test_str_upper_lower_swap_title():
+    assert _col(lambda t: t.a.str.upper(), ["aBc"]) == ["ABC"]
+    assert _col(lambda t: t.a.str.lower(), ["aBc"]) == ["abc"]
+    assert _col(lambda t: t.a.str.swapcase(), ["aBc"]) == ["AbC"]
+    assert _col(lambda t: t.a.str.title(), ["hello world"]) == [
+        "Hello World"
+    ]
+
+
+def test_str_slice_replace_split():
+    assert _col(lambda t: t.a.str.slice(1, 3), ["abcde"]) == ["bc"]
+    assert _col(lambda t: t.a.str.replace("a", "z"), ["banana"]) == [
+        "bznznz"
+    ]
+    out = _col(lambda t: t.a.str.split(","), ["x,y,z"])
+    assert list(out[0]) == ["x", "y", "z"]
+
+
+def test_str_starts_ends_len():
+    assert _col(lambda t: t.a.str.startswith("ab"), ["abc", "xbc"]) == [
+        True,
+        False,
+    ]
+    assert _col(lambda t: t.a.str.endswith("bc"), ["abc", "abx"]) == [
+        True,
+        False,
+    ]
+    assert _col(lambda t: t.a.str.len(), ["abc", ""]) == [3, 0]
+
+
+def test_num_round_abs():
+    assert _col(lambda t: t.a.num.round(1), [1.26, -2.34]) == [1.3, -2.3]
+    assert _col(lambda t: t.a.num.abs(), [-5, 3]) == [5, 3]
+
+
+def test_num_fill_na():
+    vals = _col(lambda t: t.a.num.fill_na(0.0), [1.5, None, 2.5])
+    assert sorted(v for v in vals) == [0.0, 1.5, 2.5]
+
+
+def test_dt_accessors():
+    d = datetime.datetime(2023, 5, 15, 10, 13, 23)
+    assert _col(lambda t: t.a.dt.year(), [d]) == [2023]
+    assert _col(lambda t: t.a.dt.month(), [d]) == [5]
+    assert _col(lambda t: t.a.dt.day(), [d]) == [15]
+    assert _col(lambda t: t.a.dt.hour(), [d]) == [10]
+    assert _col(lambda t: t.a.dt.minute(), [d]) == [13]
+    assert _col(lambda t: t.a.dt.second(), [d]) == [23]
+
+
+def test_dt_strptime_strftime():
+    vals = _col(
+        lambda t: t.a.dt.strptime("%Y-%m-%d %H:%M:%S"),
+        ["2023-03-25 12:00:00"],
+    )
+    assert vals == [datetime.datetime(2023, 3, 25, 12, 0, 0)]
+    d = datetime.datetime(2023, 3, 25, 12, 0, 0)
+    assert _col(lambda t: t.a.dt.strftime("%Y/%m/%d"), [d]) == [
+        "2023/03/25"
+    ]
+
+
+def test_dt_timedelta_arithmetic():
+    d1 = datetime.datetime(2023, 1, 2)
+    d2 = datetime.datetime(2023, 1, 1)
+
+    class S(pw.Schema):
+        a: pw.internals.dtype.ANY  # type: ignore[valid-type]
+        b: pw.internals.dtype.ANY  # type: ignore[valid-type]
+
+    t = pw.debug.table_from_rows(S, [(d1, d2)])
+    res = t.select(diff=t.a - t.b)
+    _k, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["diff"].values()) == [datetime.timedelta(days=1)]
