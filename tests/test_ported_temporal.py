@@ -1,0 +1,149 @@
+"""Ported temporal-window tests (reference:
+python/pathway/tests/temporal/test_windows.py) — exact expected outputs
+for session-with-predicate and sliding windows with instances."""
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from tests.ref_utils import assert_table_equality_wo_index
+
+
+def test_session_simple():
+    t = T(
+        """
+            | instance |  t |  v
+        1   | 0        |  1 |  10
+        2   | 0        |  2 |  1
+        3   | 0        |  4 |  3
+        4   | 0        |  8 |  2
+        5   | 0        |  9 |  4
+        6   | 0        |  10|  8
+        7   | 1        |  1 |  9
+        8   | 1        |  2 |  16
+    """
+    )
+
+    def should_merge(a, b):
+        return abs(a - b) <= 1
+
+    gb = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=should_merge),
+        instance=t.instance,
+    )
+    result = gb.reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_v=pw.reducers.max(pw.this.v),
+        count=pw.reducers.count(),
+    )
+    res = T(
+        """
+        _pw_instance | _pw_window_start | _pw_window_end | min_t | max_v | count
+        0            | 1                | 2              | 1     | 10    | 2
+        0            | 4                | 4              | 4     | 3     | 1
+        0            | 8                | 10             | 8     | 8     | 3
+        1            | 1                | 2              | 1     | 16    | 2
+    """
+    )
+    assert_table_equality_wo_index(result, res)
+
+
+def test_sliding():
+    t = T(
+        """
+            | instance | t
+        1   | 0        |  12
+        2   | 0        |  13
+        3   | 0        |  14
+        4   | 0        |  15
+        5   | 0        |  16
+        6   | 0        |  17
+        7   | 1        |  10
+        8   | 1        |  11
+    """
+    )
+    gb = t.windowby(
+        t.t,
+        window=pw.temporal.sliding(duration=10, hop=3),
+        instance=t.instance,
+    )
+    result = gb.reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_t=pw.reducers.max(pw.this.t),
+        count=pw.reducers.count(),
+    )
+    res = T(
+        """
+        _pw_instance | _pw_window_start | _pw_window_end | min_t | max_t | count
+            0        |     3            |     13         | 12    | 12    | 1
+            0        |     6            |     16         | 12    | 15    | 4
+            0        |     9            |     19         | 12    | 17    | 6
+            0        |     12           |     22         | 12    | 17    | 6
+            0        |     15           |     25         | 15    | 17    | 3
+            1        |     3            |     13         | 10    | 11    | 2
+            1        |     6            |     16         | 10    | 11    | 2
+            1        |     9            |     19         | 10    | 11    | 2
+            """
+    )
+    assert_table_equality_wo_index(result, res)
+
+
+def test_session_max_gap():
+    t = T(
+        """
+            | t
+        1   | 1
+        2   | 2
+        3   | 10
+        4   | 11
+        5   | 30
+    """
+    )
+    gb = t.windowby(t.t, window=pw.temporal.session(max_gap=5))
+    result = gb.reduce(
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        count=pw.reducers.count(),
+    )
+    res = T(
+        """
+        _pw_window_start | _pw_window_end | count
+        1                | 2              | 2
+        10               | 11             | 2
+        30               | 30             | 1
+    """
+    )
+    assert_table_equality_wo_index(result, res)
+
+
+def test_tumbling_with_origin():
+    t = T(
+        """
+            | t
+        1   | 1
+        2   | 5
+        3   | 6
+        4   | 11
+    """
+    )
+    gb = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5, origin=1)
+    )
+    result = gb.reduce(
+        pw.this._pw_window_start,
+        count=pw.reducers.count(),
+    )
+    res = T(
+        """
+        _pw_window_start | count
+        1                | 2
+        6                | 1
+        11               | 1
+    """
+    )
+    assert_table_equality_wo_index(result, res)
