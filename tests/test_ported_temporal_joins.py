@@ -1,0 +1,200 @@
+"""Ported temporal-join tests (reference:
+python/pathway/tests/temporal/{test_interval_joins,test_asof_joins}.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from tests.ref_utils import assert_table_equality_wo_index
+
+
+def _interval_tables():
+    t1 = T(
+        """
+      | a | t
+    0 | 1 | -1
+    1 | 2 | 0
+    2 | 3 | 2
+    3 | 4 | 3
+    4 | 5 | 7
+    5 | 6 | 13
+    """
+    )
+    t2 = T(
+        """
+      | b | t
+    0 | 1 | 2
+    1 | 2 | 5
+    2 | 3 | 6
+    3 | 4 | 10
+    4 | 5 | 15
+    """
+    )
+    return t1, t2
+
+
+def test_interval_join_inner_maxdiff_1():
+    t1, t2 = _interval_tables()
+    res = t1.interval_join_inner(
+        t2, t1.t, t2.t, pw.temporal.interval(-1, 1)
+    ).select(t1.a, b=t2.b)
+    expected = T(
+        """
+        a | b
+        3 | 1
+        4 | 1
+        5 | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_left_maxdiff_1():
+    t1, t2 = _interval_tables()
+    res = t1.interval_join_left(
+        t2, t1.t, t2.t, pw.temporal.interval(-1, 1)
+    ).select(t1.a, b=pw.require(t2.b, t2.id))
+    expected = T(
+        """
+        a | b
+        3 | 1
+        4 | 1
+        5 | 3
+        1 |
+        2 |
+        6 |
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_inner_maxdiff_2():
+    t1, t2 = _interval_tables()
+    res = t1.interval_join_inner(
+        t2, t1.t, t2.t, pw.temporal.interval(-2, 2)
+    ).select(t1.a, b=t2.b)
+    expected = T(
+        """
+        a | b
+        2 | 1
+        3 | 1
+        4 | 1
+        4 | 2
+        5 | 2
+        5 | 3
+        6 | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_non_symmetric():
+    t1, t2 = _interval_tables()
+    res = t1.interval_join_inner(
+        t2, t1.t, t2.t, pw.temporal.interval(0, 3)
+    ).select(t1.a, b=t2.b)
+    # pairs where 0 <= t2.t - t1.t <= 3
+    expected = T(
+        """
+        a | b
+        1 | 1
+        2 | 1
+        3 | 1
+        3 | 2
+        4 | 2
+        4 | 3
+        5 | 4
+        6 | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_left():
+    t1 = T(
+        """
+            | K | val |  t
+        1   | 0 | 1   |  1
+        2   | 0 | 2   |  4
+        3   | 0 | 3   |  5
+        4   | 0 | 4   |  6
+        5   | 0 | 5   |  7
+        6   | 0 | 6   |  11
+        7   | 0 | 7   |  12
+        8   | 1 | 8   |  5
+        9   | 1 | 9   |  7
+    """
+    )
+    t2 = T(
+        """
+            | K | val | t
+        21   | 1 | 7  | 2
+        22   | 1 | 3  | 8
+        23   | 0 | 0  | 2
+        24   | 0 | 6  | 3
+        25   | 0 | 2  | 7
+        26   | 0 | 3  | 8
+        27   | 0 | 9  | 9
+        28   | 0 | 7  | 13
+        29   | 0 | 4  | 14
+        """
+    )
+    res = t1.asof_join(
+        t2,
+        t1.t,
+        t2.t,
+        t1.K == t2.K,
+        how=pw.JoinMode.LEFT,
+        defaults={t2.val: -1},
+    ).select(
+        t=t1.t,
+        val_right=t2.val,
+        combo=t1.val * 2 + t2.val,
+    )
+    # backward asof: latest t2 row with t2.t <= t1.t per key
+    expected = T(
+        """
+ t  | val_right | combo
+  1 | -1        | 1
+  4 | 6         | 10
+  5 | 6         | 12
+  6 | 6         | 14
+  7 | 2         | 12
+ 11 | 9         | 21
+ 12 | 9         | 23
+  5 | 7         | 23
+  7 | 7         | 25
+          """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_window_join_inner():
+    t1 = T(
+        """
+        a | t
+        1 | 1
+        2 | 5
+        3 | 12
+        """
+    )
+    t2 = T(
+        """
+        b | t
+        7 | 2
+        8 | 6
+        9 | 15
+        """
+    )
+    res = t1.window_join_inner(
+        t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(t1.a, b=t2.b)
+    # windows [0,5): (1,7); [5,10): (2,8); [10,15): none; [15,20): none
+    expected = T(
+        """
+        a | b
+        1 | 7
+        2 | 8
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
