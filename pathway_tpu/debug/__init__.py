@@ -195,12 +195,14 @@ def table_from_rows(
     is_stream: bool = False,
 ) -> Table:
     col_names = list(schema.column_names())
+    coercers = _schema_coercers(schema, col_names)
     events: dict[int, list] = {}
     for i, row in enumerate(rows):
         if is_stream:
             *vals, t, d = row
         else:
             vals, t, d = list(row), 0, 1
+        vals = [c(v) for c, v in zip(coercers, vals)]
         pk = schema.primary_key_columns()
         if pk:
             key = int(ref_scalar(*[vals[col_names.index(c)] for c in pk]))
@@ -219,11 +221,21 @@ def table_from_pandas(
     schema: Any = None,
 ) -> Table:
     col_names = [c for c in df.columns if c not in ("__time__", "__diff__")]
+    if id_from is None and schema is not None:
+        # schema primary keys drive row identity, as in table_from_rows
+        pk = schema.primary_key_columns()
+        if pk:
+            id_from = list(pk)
+    coercers = (
+        _schema_coercers(schema, col_names) if schema is not None else None
+    )
     events: dict[int, list] = {}
     for i, (idx, row) in enumerate(df.iterrows()):
         t = int(row["__time__"]) if "__time__" in df.columns else 0
         d = int(row["__diff__"]) if "__diff__" in df.columns else 1
         vals = tuple(_np_unbox(row[c]) for c in col_names)
+        if coercers is not None:
+            vals = tuple(c(v) for c, v in zip(coercers, vals))
         if id_from:
             key = int(ref_scalar(*[vals[col_names.index(c)] for c in id_from]))
         else:
@@ -245,6 +257,54 @@ def _np_unbox(v: Any) -> Any:
     if isinstance(v, np.generic):
         return v.item()
     return v
+
+
+def _schema_coercers(schema: Any, col_names: Sequence[str]) -> list:
+    """Per-column input coercion to the declared dtype: raw dicts/lists
+    (and any datetimes inside them) become normalized Json, ints promote
+    to float — the engine-boundary conversions the reference performs in
+    value extraction (python_api.rs extract_value)."""
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.json import Json, normalize_json
+
+    def _denan(v, sd):
+        # pandas upcasts int columns with missing values to float-NaN;
+        # undo that per the declared dtype
+        if isinstance(v, float) and v != v and sd != dt.FLOAT:
+            return None
+        return v
+
+    def for_dtype(d):
+        sd = d.strip_optional()
+        if sd == dt.JSON:
+            return lambda v: v if v is None else normalize_json(v)
+        if sd == dt.FLOAT:
+            opt = d.is_optional()
+
+            def to_float(v):
+                if opt and isinstance(v, float) and v != v:
+                    return None  # NaN marks a missing optional value
+                if isinstance(v, int) and not isinstance(v, bool):
+                    return float(v)
+                return v
+
+            return to_float
+        if sd == dt.INT:
+            def to_int(v):
+                v = _denan(v, sd)
+                if (
+                    isinstance(v, float)
+                    and v == v
+                    and float(v).is_integer()
+                ):
+                    return int(v)
+                return v
+
+            return to_int
+        return lambda v: _denan(v, sd)
+
+    dtypes = schema.dtypes()
+    return [for_dtype(dtypes[n]) for n in col_names]
 
 
 # ---------------------------------------------------------------------------

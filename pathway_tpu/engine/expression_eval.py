@@ -318,7 +318,7 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
         if a.dtype == object:
             for v in a:
                 if v is None:
-                    raise ValueError("cannot unwrap, column contains None")
+                    raise ValueError("cannot unwrap if there is None value")
         return a
     if isinstance(e, expr.CastExpression):
         return _cast(e._target, eval_expr(e._expr, ctx))
@@ -495,6 +495,17 @@ def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndar
 
 
 def _coerce_to_dtype(out: np.ndarray, target: dt.DType) -> np.ndarray:
+    if target.strip_optional() == dt.JSON:
+        # engine-boundary Json serialization (reference: python Json ->
+        # serde on the PyO3 crossing): datetimes become ISO strings etc.
+        from pathway_tpu.internals.json import normalize_json
+
+        def norm(v):
+            if v is None or isinstance(v, Error):
+                return v
+            return normalize_json(v)
+
+        return _elementwise(norm, out)
     storage = target.np_dtype
     if storage != np.dtype(object) and out.dtype == object:
         try:
@@ -512,9 +523,38 @@ def _to_string(v: Any) -> str:
     return str(v)
 
 
+def _json_access(value: Any, index: Any):
+    """(found, item) for JSON-pointer-style access: str key into an object,
+    non-negative in-range int index into an array; anything else is a miss
+    (reference: src/engine/expression.rs JsonGetItem — no Python negative
+    indexing, no wraparound)."""
+    if isinstance(value, dict):
+        if isinstance(index, str) and index in value:
+            return True, value[index]
+        return False, None
+    if isinstance(value, list):
+        if (
+            isinstance(index, int)
+            and not isinstance(index, bool)
+            and 0 <= index < len(value)
+        ):
+            return True, value[index]
+        return False, None
+    return False, None
+
+
 def _get_with_default(container: Any, index: Any, default: Any) -> Any:
+    if isinstance(index, np.integer):
+        index = int(index)
+    if isinstance(container, Json):
+        found, item = _json_access(container.value, index)
+        if not found:
+            if default is None or isinstance(default, Json):
+                return default
+            return Json(default)  # raw dict/list default coerces to Json
+        return Json(item)
     try:
-        return _get_strict(container, index)
+        return container[index]
     except Exception:
         return default
 
@@ -522,6 +562,11 @@ def _get_with_default(container: Any, index: Any, default: Any) -> Any:
 def _get_strict(container: Any, index: Any) -> Any:
     if isinstance(index, np.integer):
         index = int(index)
+    if isinstance(container, Json):
+        # total access: a miss yields JSON null so chains like
+        # data["a"]["b"] propagate (reference test_json.py get_item tests)
+        found, item = _json_access(container.value, index)
+        return Json(item) if found else Json.NULL
     return container[index]
 
 
@@ -548,17 +593,33 @@ def _convert(target: dt.DType, a: np.ndarray, unwrap: bool) -> np.ndarray:
     def fn(v):
         if v is None:
             if unwrap:
-                raise ValueError("cannot unwrap None")
+                raise ValueError("cannot unwrap if there is None value")
             return None
         if isinstance(v, Json):
+            # engine-strict (unlike the isinstance-based UDF-level Json.as_*):
+            # bools never convert to int/float, floats never to int
+            # (reference test_json.py as_int/as_float wrong-value tests)
+            jv = v.value
+            if jv is None:
+                if unwrap:
+                    raise ValueError("cannot unwrap if there is None value")
+                return None
             if target == dt.INT:
-                return v.as_int()
+                if isinstance(jv, bool) or not isinstance(jv, int):
+                    raise ValueError(f"Cannot convert Json {jv!r} to int")
+                return jv
             if target == dt.FLOAT:
-                return v.as_float()
+                if isinstance(jv, bool) or not isinstance(jv, (int, float)):
+                    raise ValueError(f"Cannot convert Json {jv!r} to float")
+                return float(jv)
             if target == dt.STR:
-                return v.as_str()
+                if not isinstance(jv, str):
+                    raise ValueError(f"Cannot convert Json {jv!r} to str")
+                return jv
             if target == dt.BOOL:
-                return v.as_bool()
+                if not isinstance(jv, bool):
+                    raise ValueError(f"Cannot convert Json {jv!r} to bool")
+                return jv
         if target == dt.INT:
             if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
                 raise ValueError(f"{v!r} is not an int")

@@ -35,8 +35,14 @@ from pathway_tpu.engine.reducers import ReducerSpec
 from pathway_tpu.internals import expression as expr_mod
 from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
 from pathway_tpu.internals.errors import record_error
+from pathway_tpu.internals.json import Json
 
 _node_counter = itertools.count()
+
+
+ALL_NODES: list["Node"] = []  # every node built since the last G.clear()
+# (run_all executes the WHOLE declared graph, outputs or not — reference:
+# GraphRunner.run_all vs run_outputs, internals/graph_runner/__init__.py)
 
 
 class Node:
@@ -47,6 +53,7 @@ class Node:
         self.inputs = list(inputs)
         self.column_names = list(column_names)
         self.name = type(self).__name__
+        ALL_NODES.append(self)
 
     def make_exec(self) -> "NodeExec":
         raise NotImplementedError
@@ -1016,13 +1023,27 @@ class FlattenExec(NodeExec):
             for i, container in enumerate(cols[fidx].tolist()):
                 if container is None:
                     continue
-                try:
-                    items = list(container)
-                except TypeError:
-                    record_error(
-                        TypeError(f"cannot flatten {container!r}"), str(node)
-                    )
-                    continue
+                if isinstance(container, Json):
+                    # only JSON arrays flatten (reference test_json.py
+                    # test_json_flatten_wrong_values)
+                    if not isinstance(container.value, list):
+                        record_error(
+                            ValueError(
+                                f"Pathway can't flatten this Json: {container}"
+                            ),
+                            str(node),
+                        )
+                        continue
+                    items = [Json(x) for x in container.value]
+                else:
+                    try:
+                        items = list(container)
+                    except TypeError:
+                        record_error(
+                            TypeError(f"cannot flatten {container!r}"),
+                            str(node),
+                        )
+                        continue
                 counts[i] = len(items)
                 items_all.extend(items)
             total = int(counts.sum())

@@ -160,13 +160,17 @@ def keys_array(keys: Iterable[Any]) -> np.ndarray:
 
 class PyObjectWrapper:
     """Opaque python object carried through the graph
-    (reference: src/engine/value.rs PyObjectWrapper)."""
+    (reference: src/engine/value.rs PyObjectWrapper). Subscriptable as a
+    generic in annotations: ``pw.PyObjectWrapper[dict]``."""
 
     __slots__ = ("value", "_serializer")
 
     def __init__(self, value: Any, serializer: Any = None):
         self.value = value
         self._serializer = serializer
+
+    def __class_getitem__(cls, item: Any) -> Any:
+        return cls
 
     def __repr__(self) -> str:
         return f"PyObjectWrapper({self.value!r})"

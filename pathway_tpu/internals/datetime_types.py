@@ -1,9 +1,16 @@
-"""DateTimeNaive / DateTimeUtc / Duration — thin subclasses of stdlib datetime
-(reference: src/engine/time.rs; python: pathway.DateTimeNaive etc.).
+"""DateTimeNaive / DateTimeUtc / Duration (reference:
+python/pathway/internals/datetime_types.py; engine side: src/engine/time.rs
+over chrono).
 
-The reference implements these natively in Rust over chrono; here they subclass
-`datetime` so all stdlib arithmetic works, while `.dt` column namespaces do the
-columnar work.
+The reference subclasses pandas Timestamp/Timedelta. pandas 3 ignores the
+subclass in ``Timestamp.__new__`` (every construction path returns a plain
+``Timestamp``), so the datetime types here are *virtual*: calling
+``DateTimeNaive(...)`` validates and returns a ``pd.Timestamp``, and
+``isinstance(value, DateTimeNaive)`` is metaclass-routed (naive ⇔ no tzinfo).
+Values therefore interoperate with everything pandas/stdlib, carry nanosecond
+precision, and still satisfy the type checks user code writes against the
+reference API. ``Duration`` genuinely subclasses ``pd.Timedelta`` (which does
+honor subclasses); ``.value`` is nanoseconds everywhere.
 """
 
 from __future__ import annotations
@@ -12,70 +19,121 @@ import datetime
 from typing import Any
 
 import numpy as np
+import pandas as pd
 
 
-class DateTimeNaive(datetime.datetime):
-    """Timezone-unaware datetime."""
+class _TimestampTypeMeta(type):
+    """Virtual-type metaclass: instances are pd.Timestamps of the matching
+    tz-awareness."""
+
+    _tz_aware: bool
+
+    def __instancecheck__(cls, obj: Any) -> bool:
+        return isinstance(obj, pd.Timestamp) and (
+            obj.tzinfo is not None
+        ) == cls._tz_aware
+
+
+class DateTimeNaive(metaclass=_TimestampTypeMeta):
+    """Timezone-unaware datetime (nanosecond precision). Constructing one
+    returns a naive ``pd.Timestamp``."""
+
+    _tz_aware = False
+
+    def __new__(cls, *args: Any, **kwargs: Any):
+        ts = pd.Timestamp(*args, **kwargs)
+        if ts.tzinfo is not None:
+            raise ValueError("DateTimeNaive cannot hold an aware datetime")
+        return ts
 
     @classmethod
-    def from_datetime(cls, dt: datetime.datetime) -> "DateTimeNaive":
+    def from_datetime(cls, dt: datetime.datetime) -> pd.Timestamp:
         if dt.tzinfo is not None:
             raise ValueError("DateTimeNaive cannot hold an aware datetime")
-        return cls(
-            dt.year, dt.month, dt.day, dt.hour, dt.minute, dt.second, dt.microsecond
-        )
+        return pd.Timestamp(dt)
 
     @classmethod
-    def strptime(cls, s: str, fmt: str) -> "DateTimeNaive":  # type: ignore[override]
-        return cls.from_datetime(datetime.datetime.strptime(s, fmt))
-
-    def timestamp_ns(self) -> int:
-        epoch = datetime.datetime(1970, 1, 1)
-        return int((self - epoch).total_seconds() * 1e9)
+    def strptime(cls, s: str, fmt: str) -> pd.Timestamp:
+        return cls(_strptime(s, fmt, utc=False))
 
 
-class DateTimeUtc(datetime.datetime):
-    """Timezone-aware datetime (stored as UTC)."""
+class DateTimeUtc(metaclass=_TimestampTypeMeta):
+    """Timezone-aware datetime (stored as UTC, nanosecond precision).
+    Constructing one returns an aware ``pd.Timestamp``."""
+
+    _tz_aware = True
+
+    def __new__(cls, *args: Any, **kwargs: Any):
+        ts = pd.Timestamp(*args, **kwargs)
+        if ts.tzinfo is None:
+            raise ValueError("DateTimeUtc requires an aware datetime")
+        return ts.tz_convert("UTC")
 
     @classmethod
-    def from_datetime(cls, dt: datetime.datetime) -> "DateTimeUtc":
+    def from_datetime(cls, dt: datetime.datetime) -> pd.Timestamp:
         if dt.tzinfo is None:
             raise ValueError("DateTimeUtc requires an aware datetime")
-        dt = dt.astimezone(datetime.timezone.utc)
-        return cls(
-            dt.year,
-            dt.month,
-            dt.day,
-            dt.hour,
-            dt.minute,
-            dt.second,
-            dt.microsecond,
-            tzinfo=datetime.timezone.utc,
-        )
+        return pd.Timestamp(dt).tz_convert("UTC")
 
-    def timestamp_ns(self) -> int:
-        return int(self.timestamp() * 1e9)
+    @classmethod
+    def strptime(cls, s: str, fmt: str) -> pd.Timestamp:
+        return cls(_strptime(s, fmt, utc=True))
 
 
-class Duration(datetime.timedelta):
-    """Time difference."""
+class Duration(pd.Timedelta):
+    """Time difference (nanosecond precision)."""
 
     @classmethod
     def from_timedelta(cls, td: datetime.timedelta) -> "Duration":
-        return cls(days=td.days, seconds=td.seconds, microseconds=td.microseconds)
+        return cls(td)
 
     def nanoseconds(self) -> int:
-        return int(self.total_seconds() * 1e9)
+        return int(self.value)
 
 
-def to_naive(v: Any) -> DateTimeNaive:
-    if isinstance(v, DateTimeNaive):
+def timestamp_ns(v: pd.Timestamp | datetime.datetime) -> int:
+    """Nanoseconds since epoch (UTC for aware values)."""
+    if isinstance(v, pd.Timestamp):
+        return int(v.value)
+    return int(pd.Timestamp(v).value)
+
+
+def _strptime(s: str, fmt: str, utc: bool):
+    """strptime that, unlike Python's, accepts nanosecond fractions for %f
+    (the reference's chrono %f parses up to 9 digits). The given format is
+    always honored: on a %f overflow the fraction is truncated to
+    microseconds for stdlib parsing and the sub-microsecond remainder is
+    re-attached, so a non-conforming string still raises ValueError."""
+    import re
+
+    try:
+        return datetime.datetime.strptime(s, fmt)
+    except ValueError:
+        if "%f" not in fmt:
+            raise
+    m = re.search(r"\.(\d{7,9})(?!\d)", s)
+    if not m:
+        raise ValueError(f"time data {s!r} does not match format {fmt!r}")
+    digits = m.group(1)
+    micro, rest = digits[:6], digits[6:]
+    truncated = s[: m.start(1)] + micro + s[m.end(1):]
+    parsed = datetime.datetime.strptime(truncated, fmt)
+    extra_ns = int(rest) * 10 ** (3 - len(rest))
+    ts = pd.Timestamp(parsed) + pd.Timedelta(extra_ns, unit="ns")
+    if utc and ts.tzinfo is not None:
+        ts = ts.tz_convert("UTC")
+    return ts
+
+
+def to_naive(v: Any) -> pd.Timestamp:
+    if isinstance(v, pd.Timestamp):
+        if v.tzinfo is not None:
+            raise ValueError("DateTimeNaive cannot hold an aware datetime")
         return v
     if isinstance(v, datetime.datetime):
-        return DateTimeNaive.from_datetime(v)
+        if v.tzinfo is not None:
+            raise ValueError("DateTimeNaive cannot hold an aware datetime")
+        return pd.Timestamp(v)
     if isinstance(v, np.datetime64):
-        us = v.astype("datetime64[us]").astype("int64")
-        return DateTimeNaive.from_datetime(
-            datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(us))
-        )
+        return pd.Timestamp(v)
     raise TypeError(f"cannot convert {v!r} to DateTimeNaive")

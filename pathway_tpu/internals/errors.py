@@ -19,15 +19,44 @@ _ERROR_LOG: list[dict[str, Any]] = []
 
 
 def record_error(exc: Exception | str, operator: str | None = None) -> None:
+    if isinstance(exc, BaseException):
+        # drop traceback frames before retaining: each frame pins the
+        # whole evaluation batch (arrays in _elementwise locals), and a
+        # UDF failing per-row would otherwise hold every failed batch
+        # alive until drain_errors()
+        import traceback as _tb
+
+        _tb.clear_frames(exc.__traceback__)
+        kept: BaseException | None = exc
+    else:
+        kept = None
     with _lock:
         _ERROR_LOG.append(
             {
                 "message": str(exc),
                 "operator_id": operator or "",
                 "trace": "",
+                # original exception object so terminate_on_error re-raises
+                # with its real type (reference: engine propagates DataError
+                # as the user's exception when terminate_on_error=true)
+                "exc": kept,
             }
         )
     logger.debug("recorded error: %s", exc)
+
+
+def error_count() -> int:
+    with _lock:
+        return len(_ERROR_LOG)
+
+
+def first_exception_since(n0: int) -> BaseException | str | None:
+    """First error recorded after position ``n0`` — the original exception
+    object when available, else its message string."""
+    with _lock:
+        for entry in _ERROR_LOG[n0:]:
+            return entry["exc"] if entry["exc"] is not None else entry["message"]
+    return None
 
 
 def drain_errors() -> list[dict[str, Any]]:

@@ -114,7 +114,10 @@ class DateTimeNamespace:
 
     def strptime(self, fmt, contains_timezone: bool | None = None):
         def fn(s, f):
-            parsed = datetime.datetime.strptime(s, f)
+            from pathway_tpu.internals.datetime_types import _strptime
+
+            # %f accepts nanosecond fractions (reference chrono semantics)
+            parsed = _strptime(s, f, utc=False)
             if parsed.tzinfo is not None:
                 return DateTimeUtc.from_datetime(parsed)
             return DateTimeNaive.from_datetime(parsed)

@@ -22,11 +22,14 @@ class ParseGraph:
         self.outputs.append(node)
 
     def clear(self) -> None:
+        from pathway_tpu.engine.nodes import ALL_NODES
+
         self.outputs.clear()
         self.streaming_sources.clear()
         self.post_run_hooks.clear()
         self.runtime = None
         self.last_runtime = None
+        ALL_NODES.clear()
 
 
 G = ParseGraph()

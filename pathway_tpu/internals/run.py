@@ -33,14 +33,19 @@ def run(
 ) -> None:
     """Execute the dataflow declared so far (all registered outputs)."""
     G = parse_graph.G
-    if not G.outputs:
+    seeds = list(G.outputs)
+    if kwargs.pop("_all_nodes", False):
+        from pathway_tpu.engine import nodes as _nodes
+
+        seeds += _nodes.ALL_NODES
+    if not seeds:
         return
     # join the process group when `pathway spawn -n N` launched us
     # (reference env contract PATHWAY_PROCESSES/PROCESS_ID, config.rs:88)
     from pathway_tpu.parallel.distributed import maybe_initialize
 
     maybe_initialize()
-    runtime = Runtime(G.outputs, autocommit_ms=autocommit_duration_ms)
+    runtime = Runtime(seeds, autocommit_ms=autocommit_duration_ms)
     G.runtime = runtime
     G.last_runtime = runtime
     if persistence_config is None:
@@ -87,11 +92,23 @@ def run(
             monitor = None
     from pathway_tpu.internals.telemetry import get_telemetry
 
+    from pathway_tpu.internals import errors as _errors
+
+    err_pos = _errors.error_count()
     try:
         with get_telemetry().span(
             "pathway.run", nodes=len(runtime.order)
         ):
             runtime.run()
+        if terminate_on_error:
+            first = _errors.first_exception_since(err_pos)
+            if first is not None:
+                # surface the first runtime error with its original type
+                # (reference: terminate_on_error=true run semantics,
+                # python_api.rs:3329)
+                if isinstance(first, BaseException):
+                    raise first
+                raise RuntimeError(first)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -104,4 +121,6 @@ def run(
 
 
 def run_all(**kwargs: Any) -> None:
-    run(**kwargs)
+    """Execute the ENTIRE declared graph, including nodes with no
+    registered output (reference: GraphRunner run_all vs run_outputs)."""
+    run(_all_nodes=True, **kwargs)

@@ -196,8 +196,28 @@ def infer_dtype(e: ColumnExpression, env) -> dt.DType:
     if isinstance(e, expr_mod.MakeTupleExpression):
         return dt.TupleDType(tuple(infer_dtype(a, env) for a in e._args))
     if isinstance(e, expr_mod.GetExpression):
-        inner = infer_dtype(e._expr, env).strip_optional()
+        from pathway_tpu.internals.json import Json as _Json
+
+        outer = infer_dtype(e._expr, env)
+        inner = outer.strip_optional()
         if inner == dt.JSON:
+            if outer.is_optional():
+                # .get()/[] on Json|None is a build-time error
+                # (reference type_interpreter: test_json_get_none)
+                raise TypeError(f"Cannot get from {_Json | None}.")
+            if e._check_if_exists:
+                ddt = infer_dtype(e._default, env)
+                if ddt not in (
+                    dt.JSON,
+                    dt.Optional_(dt.JSON),
+                    dt.NONE,
+                    dt.ANY,
+                    dt.ANY_TUPLE,
+                ):
+                    raise TypeError(
+                        f"Default must be of type {_Json | None}, "
+                        f"found {ddt.typehint}."
+                    )
             return dt.Optional_(dt.JSON) if e._check_if_exists else dt.JSON
         return dt.ANY
     if isinstance(e, PointerExpression):

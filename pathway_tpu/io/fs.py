@@ -24,6 +24,7 @@ from pathway_tpu.internals.universe import Universe
 
 
 def _list_files(path: str, with_metadata_glob: str | None = None) -> list[str]:
+    path = os.fspath(path)
     if os.path.isdir(path):
         out = []
         for root, _dirs, files in os.walk(path):
@@ -136,9 +137,28 @@ def _safe(fn, v):
 def _coerce_json_one(d):
     """Column coercer for already-typed (json) input values. Non-JSON
     dtypes wrap stray list/dict values into Json (matching the historical
-    fs behavior the s3 scanner shares)."""
+    fs behavior the s3 scanner shares). Datetime/duration columns parse
+    the Json serde format back (nanosecond ISO strings / ns ints)."""
+    from pathway_tpu.internals.datetime_types import (
+        DateTimeNaive,
+        DateTimeUtc,
+        Duration,
+    )
+
     if d == dt.JSON:
         return lambda v: v if isinstance(v, Json) else Json(v)
+    if d == dt.DATE_TIME_NAIVE:
+        return lambda v: (
+            DateTimeNaive(v) if isinstance(v, str) else v
+        )
+    if d == dt.DATE_TIME_UTC:
+        return lambda v: DateTimeUtc(v) if isinstance(v, str) else v
+    if d == dt.DURATION:
+        return lambda v: (
+            Duration(nanoseconds=v)
+            if isinstance(v, int) and not isinstance(v, bool)
+            else v
+        )
     if d == dt.FLOAT:
 
         def as_float(v):
@@ -365,7 +385,9 @@ class _FileWriter:
                 obj = dict(zip(self.column_names, [_jsonable(v) for v in vals]))
                 obj["time"] = t
                 obj["diff"] = d
-                self._file.write(_json.dumps(obj) + "\n")
+                # Json.dumps: datetimes as nanosecond ISO strings, durations
+                # as nanosecond ints (reference JsonLinesFormatter serde)
+                self._file.write(Json.dumps(obj) + "\n")
         self._file.flush()
 
     def close(self) -> None:

@@ -62,8 +62,10 @@ class ConnectorSubject:
             if name in out:
                 v = out[name]
                 sd = d.strip_optional()
-                if sd == dt.JSON and not isinstance(v, Json):
-                    out[name] = Json(v)
+                if sd == dt.JSON and not (v is None and d.is_optional()):
+                    from pathway_tpu.internals.json import normalize_json
+
+                    out[name] = normalize_json(v)
                 elif sd == dt.FLOAT and isinstance(v, int):
                     out[name] = float(v)
         return out

@@ -102,3 +102,74 @@ def flatten_column(column, origin_id: str | None = "origin_id"):
     assert table is not None
     flat = table.flatten(column)
     return flat
+
+
+def unpack_col_dict(column, schema) -> Table:
+    """Unpack a Json-object column into typed columns per ``schema``
+    (reference: stdlib/utils/col.py:97-188). Non-optional target dtypes
+    unwrap (a JSON null raises at runtime); optional ones map null→None.
+    Datetimes round-trip via nanosecond ISO strings, durations via
+    nanosecond ints (the Json serialization format)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import dtype as dt
+
+    table = None
+    for ref in column._dependencies():
+        table = ref.table
+        break
+    assert table is not None
+
+    dtypes = {
+        name: schema.__columns__[name].dtype for name in schema.column_names()
+    }
+
+    def convert(name, col):
+        target = dtypes[name]
+        inner = target.strip_optional()
+        is_opt = target.is_optional()
+
+        def optional(col, op):
+            if is_opt:
+                return pw.if_else(col == pw.Json.NULL, None, op(col))
+            return op(col)
+
+        if inner == dt.JSON:
+            result = col
+        elif inner == dt.BOOL:
+            result = col.as_bool()
+        elif inner == dt.FLOAT:
+            result = col.as_float()
+        elif inner == dt.INT:
+            result = col.as_int()
+        elif inner == dt.STR:
+            result = col.as_str()
+        elif inner == dt.DATE_TIME_NAIVE:
+            result = optional(
+                col,
+                lambda c: pw.unwrap(c.as_str()).dt.strptime(
+                    "%Y-%m-%dT%H:%M:%S.%f"
+                ),
+            )
+        elif inner == dt.DATE_TIME_UTC:
+            result = optional(
+                col,
+                lambda c: pw.unwrap(c.as_str()).dt.strptime(
+                    "%Y-%m-%dT%H:%M:%S.%f%z"
+                ),
+            )
+        elif inner == dt.DURATION:
+            result = optional(
+                col, lambda c: pw.unwrap(c.as_int()).dt.to_duration("ns")
+            )
+        else:
+            raise TypeError(
+                f"Unsupported conversion from pw.Json to {target.typehint}"
+            )
+        return result if is_opt else pw.unwrap(result)
+
+    kw = {
+        name: convert(name, column.get(name)) for name in schema.column_names()
+    }
+    return table.select(**kw).update_types(
+        **{n: dtypes[n].typehint for n in dtypes}
+    )

@@ -65,4 +65,4 @@ assert_table_equality_wo_types = assert_table_equality
 
 
 def run_all(**kwargs) -> None:
-    pw.run(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
+    pw.run_all(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
