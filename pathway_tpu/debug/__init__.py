@@ -256,6 +256,12 @@ def table_from_pandas(
 def _np_unbox(v: Any) -> Any:
     if isinstance(v, np.generic):
         return v.item()
+    import pandas as pd
+
+    if isinstance(v, pd.Timestamp) and v.tzinfo is not None:
+        # aware values are stored normalized to UTC (reference: DateTimeUtc
+        # is chrono Utc; offsets survive only in formatting)
+        return v.tz_convert("UTC")
     return v
 
 

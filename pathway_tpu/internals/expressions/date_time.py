@@ -1,10 +1,22 @@
 """`.dt` column namespace
 (reference surface: python/pathway/internals/expressions/date_time.py; the
-reference implements these in Rust over chrono, src/engine/time.rs)."""
+reference implements these in Rust over chrono, src/engine/time.rs).
+
+Values are pandas Timestamps/Timedeltas (nanosecond precision), so every
+method here computes on the exact `.value` nanosecond integers — matching
+the reference's chrono i64-nanosecond arithmetic, including the chrono
+format extensions (`%f` = 9-digit nanoseconds, `%3f`/`%6f`/`%9f` widths,
+`%:z` offsets) and truncation-toward-zero duration components. Methods are
+dtype-gated: calling a datetime method on an int column raises
+AttributeError at build time when the static dtype is known (reference:
+the type_interpreter rejects mistyped namespace calls)."""
 
 from __future__ import annotations
 
 import datetime
+import warnings
+
+import pandas as pd
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.datetime_types import (
@@ -26,39 +38,165 @@ _UNIT_NS = {
     "s": 1_000_000_000,
 }
 
+# to_duration unit multipliers in ns (reference: get_unit_multiplier,
+# src/engine/time.rs:124-140)
+_DURATION_UNIT_NS = {}
+for _aliases, _mul in (
+    (("W",), 7 * 24 * 3600 * 10**9),
+    (("D", "day", "days"), 24 * 3600 * 10**9),
+    (("h", "hr", "hour", "hours"), 3600 * 10**9),
+    (("m", "min", "minute", "minutes"), 60 * 10**9),
+    (("s", "sec", "second", "seconds"), 10**9),
+    (("ms", "milli", "millis", "millisecond", "milliseconds"), 10**6),
+    (("us", "micro", "micros", "microsecond", "microseconds"), 10**3),
+    (("ns", "nano", "nanos", "nanosecond", "nanoseconds"), 1),
+):
+    for _a in _aliases:
+        _DURATION_UNIT_NS[_a] = _mul
 
-def _dt_ns(d: datetime.datetime) -> int:
-    if d.tzinfo is None:
-        epoch = datetime.datetime(1970, 1, 1)
-        return int((d - epoch) / datetime.timedelta(microseconds=1)) * 1000
-    return int(d.timestamp() * 1_000_000) * 1000
+
+def _period_ns(p) -> int:
+    """Round/floor period in exact nanoseconds: Timedelta, a composite
+    duration string ('2h3min'), or a bare offset alias ('D', 'min')."""
+    if isinstance(p, str):
+        try:
+            return int(pd.Timedelta(p).value)
+        except ValueError:
+            return int(pd.tseries.frequencies.to_offset(p).nanos)
+    return int(_td(p).value)
+
+# DST policy for anchoring naive wall-clock times (matches chrono's
+# LocalResult handling in the reference): nonexistent times shift forward
+# past the gap, ambiguous times resolve to the second (non-DST) occurrence.
+_LOCALIZE = dict(nonexistent="shift_forward", ambiguous=False)
 
 
-def _parse_duration_str(freq: str) -> datetime.timedelta:
-    import re
+def _ts(d) -> pd.Timestamp:
+    return d if isinstance(d, pd.Timestamp) else pd.Timestamp(d)
 
-    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]+)\s*", freq)
-    if not m:
-        raise ValueError(f"cannot parse duration {freq!r}")
-    qty = float(m.group(1))
-    unit = m.group(2).lower()
-    table = {
-        "ns": 1e-9,
-        "us": 1e-6,
-        "ms": 1e-3,
-        "s": 1.0,
-        "sec": 1.0,
-        "min": 60.0,
-        "t": 60.0,
-        "h": 3600.0,
-        "hr": 3600.0,
-        "d": 86400.0,
-        "day": 86400.0,
-        "w": 604800.0,
-    }
-    if unit not in table:
-        raise ValueError(f"unknown duration unit {unit!r}")
-    return datetime.timedelta(seconds=qty * table[unit])
+
+def _td(x) -> pd.Timedelta:
+    return x if isinstance(x, pd.Timedelta) else pd.Timedelta(x)
+
+
+def _dt_ns(d) -> int:
+    """Exact nanoseconds since epoch (UTC for aware values)."""
+    return int(_ts(d).value)
+
+
+def _ns_of_second(d) -> int:
+    return _dt_ns(d) % 1_000_000_000
+
+
+def _trunc_div(v: int, unit: int) -> int:
+    """Integer division truncating toward zero (chrono num_* semantics)."""
+    q = abs(v) // unit
+    return -q if v < 0 else q
+
+
+def _strftime_one(d, fmt: str) -> str:
+    """strftime with the chrono fraction extensions: %f renders 9-digit
+    nanoseconds, %3f/%6f/%9f fixed widths; %% stays an escape."""
+    ts = _ts(d)
+    nano = f"{_ns_of_second(ts):09d}"
+    out = []
+    i = 0
+    n = len(fmt)
+    while i < n:
+        c = fmt[i]
+        if c == "%" and i + 1 < n:
+            nxt = fmt[i + 1]
+            if nxt == "%":
+                out.append("%%")
+                i += 2
+                continue
+            if nxt == "f":
+                out.append(nano)
+                i += 2
+                continue
+            if nxt in "369" and i + 2 < n and fmt[i + 2] == "f":
+                out.append(nano[: int(nxt)])
+                i += 3
+                continue
+        out.append(c)
+        i += 1
+    return ts.strftime("".join(out))
+
+
+def _sanitize_format(fmt: str) -> str:
+    """Exact port of the reference's sanitize_format_string
+    (src/engine/time.rs:107): '.%f' becomes chrono's '%.f'; any remaining
+    bare '%f' (not part of a '%%f' escape) is rejected. Fixed-width
+    '%3f'/'%6f'/'%9f' contain no '%f' substring and pass."""
+    sanitized = fmt.replace(".%f", "%.f")
+    if sanitized.count("%f") != sanitized.count("%%f"):
+        raise ValueError(
+            f'parse error: cannot use format "{sanitized}": using '
+            '"%f" without the leading dot is not supported'
+        )
+    return sanitized
+
+
+def _strptime_one(s: str, fmt: str):
+    from pathway_tpu.internals.datetime_types import _strptime
+
+    display = _sanitize_format(fmt)
+    # chrono fixed-width fractions and %:z offsets map onto python's forms
+    py_fmt = (
+        fmt.replace("%9f", "%f")
+        .replace("%6f", "%f")
+        .replace("%3f", "%f")
+        .replace("%:z", "%z")
+    )
+    try:
+        return _strptime(s, py_fmt, utc=False)
+    except ValueError:
+        raise ValueError(
+            f'parse error: cannot parse date "{s}" using format "{display}"'
+        ) from None
+
+
+# --- dtype gating ----------------------------------------------------------
+
+
+def _static_dtype(expr) -> dt.DType | None:
+    """Best-effort dtype of an expression without an environment: direct
+    column references read the table schema; typed expressions carry their
+    target. None = unknown (no gating)."""
+    from pathway_tpu.internals.expression import (
+        CastExpression,
+        ColumnReference,
+        ConvertExpression,
+        DeclareTypeExpression,
+    )
+
+    if isinstance(expr, ColumnReference):
+        try:
+            return expr.table.schema.__columns__[expr.name].dtype
+        except Exception:
+            return None
+    if isinstance(expr, MethodCallExpression):
+        rt = expr._return_type
+        return rt if isinstance(rt, dt.DType) else None
+    if isinstance(expr, (CastExpression, ConvertExpression, DeclareTypeExpression)):
+        return expr._target
+    return None
+
+
+_DATETIME_KINDS = (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC)
+_NUMERIC_KINDS = (dt.INT, dt.FLOAT)
+
+
+def _require(expr, kinds, method: str) -> None:
+    d = _static_dtype(expr)
+    if d is None:
+        return
+    if d.strip_optional() in kinds or d.strip_optional() in (dt.ANY,):
+        return
+    raise AttributeError(
+        f"dt.{method} cannot be applied to a column of type "
+        f"{d.strip_optional().name}"
+    )
 
 
 class DateTimeNamespace:
@@ -68,131 +206,161 @@ class DateTimeNamespace:
     # --- field extraction ----------------------------------------------------
 
     def nanosecond(self):
-        return _m("dt.nanosecond", lambda d: (_dt_ns(d)) % 1_000_000_000, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "nanosecond")
+        return _m("dt.nanosecond", _ns_of_second, dt.INT, self._expr)
 
     def microsecond(self):
-        return _m("dt.microsecond", lambda d: d.microsecond, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "microsecond")
+        return _m(
+            "dt.microsecond",
+            lambda d: _ns_of_second(d) // 1_000,
+            dt.INT,
+            self._expr,
+        )
 
     def millisecond(self):
-        return _m("dt.millisecond", lambda d: d.microsecond // 1000, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "millisecond")
+        return _m(
+            "dt.millisecond",
+            lambda d: _ns_of_second(d) // 1_000_000,
+            dt.INT,
+            self._expr,
+        )
 
     def second(self):
-        return _m("dt.second", lambda d: d.second, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "second")
+        return _m("dt.second", lambda d: _ts(d).second, dt.INT, self._expr)
 
     def minute(self):
-        return _m("dt.minute", lambda d: d.minute, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "minute")
+        return _m("dt.minute", lambda d: _ts(d).minute, dt.INT, self._expr)
 
     def hour(self):
-        return _m("dt.hour", lambda d: d.hour, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "hour")
+        return _m("dt.hour", lambda d: _ts(d).hour, dt.INT, self._expr)
 
     def day(self):
-        return _m("dt.day", lambda d: d.day, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "day")
+        return _m("dt.day", lambda d: _ts(d).day, dt.INT, self._expr)
 
     def month(self):
-        return _m("dt.month", lambda d: d.month, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "month")
+        return _m("dt.month", lambda d: _ts(d).month, dt.INT, self._expr)
 
     def year(self):
-        return _m("dt.year", lambda d: d.year, dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "year")
+        return _m("dt.year", lambda d: _ts(d).year, dt.INT, self._expr)
 
     def weekday(self):
-        return _m("dt.weekday", lambda d: d.weekday(), dt.INT, self._expr)
+        _require(self._expr, _DATETIME_KINDS, "weekday")
+        return _m("dt.weekday", lambda d: _ts(d).weekday(), dt.INT, self._expr)
 
     def timestamp(self, unit: str | None = None):
+        _require(self._expr, _DATETIME_KINDS, "timestamp")
         if unit is None:
+            warnings.warn(
+                "Not specyfying the `unit` argument of the `timestamp()` "
+                "method is deprecated. Please specify its value. Without "
+                "specifying, it will default to 'ns'.",
+                DeprecationWarning,
+                stacklevel=2,
+            )
             return _m("dt.timestamp", _dt_ns, dt.INT, self._expr)
         div = _UNIT_NS[unit]
+        # float-cast BEFORE dividing, matching the engine's int/int `/`
+        # (int64 -> f64 conversion happens first there too)
         return _m(
-            "dt.timestamp", lambda d: _dt_ns(d) / div, dt.FLOAT, self._expr
+            "dt.timestamp",
+            lambda d: float(_dt_ns(d)) / float(div),
+            dt.FLOAT,
+            self._expr,
         )
 
     # --- formatting ----------------------------------------------------------
 
     def strftime(self, fmt):
-        return _m(
-            "dt.strftime", lambda d, f: d.strftime(f), dt.STR, self._expr, fmt
-        )
+        _require(self._expr, _DATETIME_KINDS, "strftime")
+        return _m("dt.strftime", _strftime_one, dt.STR, self._expr, fmt)
 
     def strptime(self, fmt, contains_timezone: bool | None = None):
-        def fn(s, f):
-            from pathway_tpu.internals.datetime_types import _strptime
+        _require(self._expr, (dt.STR,), "strptime")
 
-            # %f accepts nanosecond fractions (reference chrono semantics)
-            parsed = _strptime(s, f, utc=False)
+        def fn(s, f):
+            parsed = _strptime_one(s, f)
             if parsed.tzinfo is not None:
                 return DateTimeUtc.from_datetime(parsed)
             return DateTimeNaive.from_datetime(parsed)
 
+        if contains_timezone is None and isinstance(fmt, str):
+            contains_timezone = "%z" in fmt or "%Z" in fmt or "%:z" in fmt
         ret = dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE
         return _m("dt.strptime", fn, ret, self._expr, fmt)
 
     # --- timezone ------------------------------------------------------------
 
     def to_utc(self, from_timezone: str):
-        from zoneinfo import ZoneInfo
+        _require(self._expr, (dt.DATE_TIME_NAIVE,), "to_utc")
 
         def fn(d, tz):
-            return DateTimeUtc.from_datetime(d.replace(tzinfo=ZoneInfo(tz)))
+            return _ts(d).tz_localize(tz, **_LOCALIZE).tz_convert("UTC")
 
         return _m("dt.to_utc", fn, dt.DATE_TIME_UTC, self._expr, from_timezone)
 
     def to_naive_in_timezone(self, timezone: str):
-        from zoneinfo import ZoneInfo
+        _require(self._expr, (dt.DATE_TIME_UTC,), "to_naive_in_timezone")
 
         def fn(d, tz):
-            return DateTimeNaive.from_datetime(
-                d.astimezone(ZoneInfo(tz)).replace(tzinfo=None)
-            )
+            return _ts(d).tz_convert(tz).tz_localize(None)
 
         return _m(
             "dt.to_naive_in_timezone", fn, dt.DATE_TIME_NAIVE, self._expr, timezone
         )
 
     def add_duration_in_timezone(self, duration, timezone: str):
-        from zoneinfo import ZoneInfo
+        _require(self._expr, (dt.DATE_TIME_NAIVE,), "add_duration_in_timezone")
 
         def fn(d, dur, tz):
-            zone = ZoneInfo(tz)
-            local = d.astimezone(zone)
-            return DateTimeUtc.from_datetime(
-                (local.replace(tzinfo=None) + dur).replace(tzinfo=zone)
-            )
+            # anchor the wall-clock time in tz, shift in absolute time,
+            # read the wall clock back
+            anchored = _ts(d).tz_localize(tz, **_LOCALIZE)
+            return (anchored + _td(dur)).tz_convert(tz).tz_localize(None)
 
         return _m(
             "dt.add_duration_in_timezone",
             fn,
-            dt.DATE_TIME_UTC,
+            dt.DATE_TIME_NAIVE,
             self._expr,
             duration,
             timezone,
         )
 
     def subtract_duration_in_timezone(self, duration, timezone: str):
-        from zoneinfo import ZoneInfo
+        _require(
+            self._expr, (dt.DATE_TIME_NAIVE,), "subtract_duration_in_timezone"
+        )
 
         def fn(d, dur, tz):
-            zone = ZoneInfo(tz)
-            local = d.astimezone(zone)
-            return DateTimeUtc.from_datetime(
-                (local.replace(tzinfo=None) - dur).replace(tzinfo=zone)
-            )
+            anchored = _ts(d).tz_localize(tz, **_LOCALIZE)
+            return (anchored - _td(dur)).tz_convert(tz).tz_localize(None)
 
         return _m(
             "dt.subtract_duration_in_timezone",
             fn,
-            dt.DATE_TIME_UTC,
+            dt.DATE_TIME_NAIVE,
             self._expr,
             duration,
             timezone,
         )
 
     def subtract_date_time_in_timezone(self, other, timezone: str):
-        from zoneinfo import ZoneInfo
+        _require(
+            self._expr, (dt.DATE_TIME_NAIVE,), "subtract_date_time_in_timezone"
+        )
 
         def fn(a, b, tz):
-            zone = ZoneInfo(tz)
-            la = a.astimezone(zone).replace(tzinfo=None)
-            lb = b.astimezone(zone).replace(tzinfo=None)
-            return Duration.from_timedelta(la - lb)
+            la = _ts(a).tz_localize(tz, **_LOCALIZE)
+            lb = _ts(b).tz_localize(tz, **_LOCALIZE)
+            return Duration(la - lb)
 
         return _m(
             "dt.subtract_date_time_in_timezone",
@@ -206,112 +374,139 @@ class DateTimeNamespace:
     # --- rounding ------------------------------------------------------------
 
     def round(self, period):
+        _require(self._expr, _DATETIME_KINDS, "round")
+
         def fn(d, p):
-            if isinstance(p, str):
-                p = _parse_duration_str(p)
-            ns = _dt_ns(d)
-            pns = int(p.total_seconds() * 1e9)
-            rounded = ((ns + pns // 2) // pns) * pns
-            return _from_ns(rounded, aware=d.tzinfo is not None)
+            ts = _ts(d)
+            pns = _period_ns(p)
+            ns = int(ts.value)
+            # chrono duration_round: nearest multiple, ties toward +inf
+            # (floor division makes (ns + pns//2)//pns match for both signs)
+            return pd.Timestamp(
+                ((ns + pns // 2) // pns) * pns, unit="ns", tz=ts.tzinfo
+            )
 
         return _m("dt.round", fn, dt.ANY, self._expr, period)
 
     def floor(self, period):
+        _require(self._expr, _DATETIME_KINDS, "floor")
+
         def fn(d, p):
-            if isinstance(p, str):
-                p = _parse_duration_str(p)
-            ns = _dt_ns(d)
-            pns = int(p.total_seconds() * 1e9)
-            return _from_ns((ns // pns) * pns, aware=d.tzinfo is not None)
+            ts = _ts(d)
+            pns = _period_ns(p)
+            # chrono duration_trunc: truncate toward zero (pre-epoch times
+            # truncate up, unlike pandas' floor toward -inf)
+            return pd.Timestamp(
+                _trunc_div(int(ts.value), pns) * pns, unit="ns", tz=ts.tzinfo
+            )
 
         return _m("dt.floor", fn, dt.ANY, self._expr, period)
 
     # --- duration fields -----------------------------------------------------
 
     def to_duration(self, unit):
+        _require(self._expr, _NUMERIC_KINDS, "to_duration")
+
         def fn(x, u):
-            return Duration.from_timedelta(
-                datetime.timedelta(seconds=x * _UNIT_NS[u] / 1e9)
-                if u in _UNIT_NS
-                else _parse_duration_str(f"{x}{u}")
-            )
+            mul = _DURATION_UNIT_NS.get(u)
+            if mul is None:
+                raise ValueError(
+                    f'unit has to be a valid time unit but is "{u}"'
+                )
+            # exact i64 multiply for ints (reference get_unit_multiplier)
+            if isinstance(x, float):
+                return Duration(int(x * mul), unit="ns")
+            return Duration(int(x) * mul, unit="ns")
 
         return _m("dt.to_duration", fn, dt.DURATION, self._expr, unit)
 
     def nanoseconds(self):
+        _require(self._expr, (dt.DURATION,), "nanoseconds")
         return _m(
-            "dt.nanoseconds",
-            lambda td: int(td.total_seconds() * 1e9),
-            dt.INT,
-            self._expr,
+            "dt.nanoseconds", lambda td: int(_td(td).value), dt.INT, self._expr
         )
 
     def microseconds(self):
+        _require(self._expr, (dt.DURATION,), "microseconds")
         return _m(
             "dt.microseconds",
-            lambda td: int(td.total_seconds() * 1e6),
+            lambda td: _trunc_div(int(_td(td).value), 1_000),
             dt.INT,
             self._expr,
         )
 
     def milliseconds(self):
+        _require(self._expr, (dt.DURATION,), "milliseconds")
         return _m(
             "dt.milliseconds",
-            lambda td: int(td.total_seconds() * 1e3),
+            lambda td: _trunc_div(int(_td(td).value), 1_000_000),
             dt.INT,
             self._expr,
         )
 
     def seconds(self):
+        _require(self._expr, (dt.DURATION,), "seconds")
         return _m(
-            "dt.seconds", lambda td: int(td.total_seconds()), dt.INT, self._expr
+            "dt.seconds",
+            lambda td: _trunc_div(int(_td(td).value), 1_000_000_000),
+            dt.INT,
+            self._expr,
         )
 
     def minutes(self):
+        _require(self._expr, (dt.DURATION,), "minutes")
         return _m(
-            "dt.minutes", lambda td: int(td.total_seconds() // 60), dt.INT, self._expr
+            "dt.minutes",
+            lambda td: _trunc_div(int(_td(td).value), 60 * 1_000_000_000),
+            dt.INT,
+            self._expr,
         )
 
     def hours(self):
+        _require(self._expr, (dt.DURATION,), "hours")
         return _m(
-            "dt.hours", lambda td: int(td.total_seconds() // 3600), dt.INT, self._expr
+            "dt.hours",
+            lambda td: _trunc_div(int(_td(td).value), 3600 * 1_000_000_000),
+            dt.INT,
+            self._expr,
         )
 
     def days(self):
+        _require(self._expr, (dt.DURATION,), "days")
         return _m(
-            "dt.days", lambda td: int(td.total_seconds() // 86400), dt.INT, self._expr
+            "dt.days",
+            lambda td: _trunc_div(int(_td(td).value), 86400 * 1_000_000_000),
+            dt.INT,
+            self._expr,
         )
 
     def weeks(self):
+        _require(self._expr, (dt.DURATION,), "weeks")
         return _m(
-            "dt.weeks", lambda td: int(td.total_seconds() // 604800), dt.INT, self._expr
+            "dt.weeks",
+            lambda td: _trunc_div(int(_td(td).value), 7 * 86400 * 1_000_000_000),
+            dt.INT,
+            self._expr,
         )
 
     # --- from timestamp ------------------------------------------------------
 
     def from_timestamp(self, unit: str):
+        _require(self._expr, _NUMERIC_KINDS, "from_timestamp")
         mul = _UNIT_NS[unit]
         return _m(
             "dt.from_timestamp",
-            lambda x: _from_ns(int(x * mul), aware=False),
+            lambda x: pd.Timestamp(int(x * mul), unit="ns"),
             dt.DATE_TIME_NAIVE,
             self._expr,
         )
 
     def utc_from_timestamp(self, unit: str):
+        _require(self._expr, _NUMERIC_KINDS, "utc_from_timestamp")
         mul = _UNIT_NS[unit]
         return _m(
             "dt.utc_from_timestamp",
-            lambda x: _from_ns(int(x * mul), aware=True),
+            lambda x: pd.Timestamp(int(x * mul), unit="ns", tz="UTC"),
             dt.DATE_TIME_UTC,
             self._expr,
         )
-
-
-def _from_ns(ns: int, aware: bool):
-    base = datetime.datetime(
-        1970, 1, 1, tzinfo=datetime.timezone.utc if aware else None
-    ) + datetime.timedelta(microseconds=ns // 1000)
-    if aware:
-        return DateTimeUtc.from_datetime(base)
-    return DateTimeNaive.from_datetime(base)
