@@ -8,6 +8,7 @@ import re
 from typing import Any, Iterable, Mapping, Sequence
 
 import numpy as np
+import pandas as _pd
 
 from pathway_tpu.engine.batch import DiffBatch
 from pathway_tpu.engine.nodes import InputNode, OutputNode
@@ -256,9 +257,7 @@ def table_from_pandas(
 def _np_unbox(v: Any) -> Any:
     if isinstance(v, np.generic):
         return v.item()
-    import pandas as pd
-
-    if isinstance(v, pd.Timestamp) and v.tzinfo is not None:
+    if isinstance(v, _pd.Timestamp) and v.tzinfo is not None:
         # aware values are stored normalized to UTC (reference: DateTimeUtc
         # is chrono Utc; offsets survive only in formatting)
         return v.tz_convert("UTC")

@@ -19,6 +19,7 @@ import operator
 from typing import Any, Callable, Sequence
 
 import numpy as np
+import pandas as pd
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as expr
@@ -520,6 +521,15 @@ def _to_string(v: Any) -> str:
         return v.to_string()
     if isinstance(v, bool):
         return "True" if v else "False"
+    if isinstance(v, pd.Timestamp):
+        # reference rendering (src/engine/time.rs Display): T-separated,
+        # 9-digit nanoseconds, colonless +0000 offset for aware values
+        from pathway_tpu.internals.expressions.date_time import _strftime_one
+
+        fmt = "%Y-%m-%dT%H:%M:%S.%f"
+        if v.tzinfo is not None:
+            fmt += "%z"
+        return _strftime_one(v, fmt)
     return str(v)
 
 

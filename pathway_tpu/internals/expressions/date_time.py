@@ -285,14 +285,32 @@ class DateTimeNamespace:
     def strptime(self, fmt, contains_timezone: bool | None = None):
         _require(self._expr, (dt.STR,), "strptime")
 
-        def fn(s, f):
+        if contains_timezone is None:
+            if isinstance(fmt, str):
+                contains_timezone = any(
+                    code in fmt for code in ["%z", "%:z", "%Z"]
+                )
+            else:
+                raise ValueError(
+                    "If fmt is not a string, you need to specify whether"
+                    " objects contain a timezone using `contains_timezone`"
+                    " parameter."
+                )
+
+        def fn(s, f, _aware=contains_timezone):
             parsed = _strptime_one(s, f)
             if parsed.tzinfo is not None:
                 return DateTimeUtc.from_datetime(parsed)
+            if _aware:
+                # the declared dtype is UTC but the parse produced no
+                # offset (e.g. %Z, which python parses without attaching
+                # tzinfo) — erroring beats silently mis-typing the column
+                raise ValueError(
+                    f'parse error: cannot parse date "{s}" using format '
+                    f'"{_sanitize_format(f)}"'
+                )
             return DateTimeNaive.from_datetime(parsed)
 
-        if contains_timezone is None and isinstance(fmt, str):
-            contains_timezone = "%z" in fmt or "%Z" in fmt or "%:z" in fmt
         ret = dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE
         return _m("dt.strptime", fn, ret, self._expr, fmt)
 
@@ -394,10 +412,10 @@ class DateTimeNamespace:
         def fn(d, p):
             ts = _ts(d)
             pns = _period_ns(p)
-            # chrono duration_trunc: truncate toward zero (pre-epoch times
-            # truncate up, unlike pandas' floor toward -inf)
+            # chrono duration_trunc floors toward -inf (pre-epoch included,
+            # fixed in chrono 0.4.25) — python floor division matches
             return pd.Timestamp(
-                _trunc_div(int(ts.value), pns) * pns, unit="ns", tz=ts.tzinfo
+                (int(ts.value) // pns) * pns, unit="ns", tz=ts.tzinfo
             )
 
         return _m("dt.floor", fn, dt.ANY, self._expr, period)

@@ -772,22 +772,24 @@ def test_sort_incremental_o_changes():
     import gc
 
     gc.disable()
-    t0 = _time.thread_time()
-    out0 = ex.process(0, [[load]])
-    t_load = _time.thread_time() - t0
-    assert sum(len(b) for b in out0) == n
+    try:
+        t0 = _time.thread_time()
+        out0 = ex.process(0, [[load]])
+        t_load = _time.thread_time() - t0
+        assert sum(len(b) for b in out0) == n
 
-    # 100 value updates (retract + reinsert with new sortval)
-    upd_rows = []
-    for i in range(100):
-        k = i * 997 + 1
-        upd_rows.append((k, -1, (int(vals[k - 1]),)))
-        upd_rows.append((k, 1, (int(vals[k - 1]) + n,)))
-    upd = DiffBatch.from_rows(upd_rows, ["v"])
-    t0 = _time.thread_time()
-    out1 = ex.process(2, [[upd]])
-    t_upd = _time.thread_time() - t0
-    gc.enable()
+        # 100 value updates (retract + reinsert with new sortval)
+        upd_rows = []
+        for i in range(100):
+            k = i * 997 + 1
+            upd_rows.append((k, -1, (int(vals[k - 1]),)))
+            upd_rows.append((k, 1, (int(vals[k - 1]) + n,)))
+        upd = DiffBatch.from_rows(upd_rows, ["v"])
+        t0 = _time.thread_time()
+        out1 = ex.process(2, [[upd]])
+        t_upd = _time.thread_time() - t0
+    finally:
+        gc.enable()
 
     n_changed = sum(len(b) for b in out1)
     # each moved row touches itself + up to 2 old and 2 new neighbors,
