@@ -21,6 +21,11 @@ from pathway_tpu.internals.universe import Universe
 
 
 class _RowsSource(StaticSource):
+    # debug fixtures are not persistable connectors: re-read fresh on every
+    # run instead of being offset-suppressed/logged (reference: persistence
+    # applies to sources with persistent ids only)
+    transient = True
+
     def __init__(self, column_names, events):
         super().__init__(column_names)
         # columnarize at declare time — ingestion-to-columnar conversion is

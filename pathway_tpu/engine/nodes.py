@@ -1382,6 +1382,11 @@ class DeduplicateNode(Node):
 
 
 class DeduplicateExec(NodeExec):
+    # persisted under its own identity even when inputs re-feed every run
+    # (reference: deduplicate keeps state via its persistent id,
+    # operators/stateful_reduce.rs non-retractable accumulators)
+    persist_standalone = True
+
     def __init__(self, node: DeduplicateNode):
         super().__init__(node)
         in_cols = node.inputs[0].column_names
@@ -1392,8 +1397,28 @@ class DeduplicateExec(NodeExec):
         # instance key -> (accepted value, emitted row vals, out key)
         self.state: dict[int, tuple] = {}
 
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        # restored accumulator output re-emits on the first tick of the new
+        # run so downstream consumers rebuild (reference: a restored
+        # arrangement feeds its consolidated contents to consumers at the
+        # initial time)
+        self._restore_emit = [
+            (ik, 1, vals) for (_value, vals, ik) in self.state.values()
+        ]
+
+    def state_dict(self) -> dict | None:
+        state = super().state_dict()
+        if state is not None:
+            state.pop("_restore_emit", None)
+        return state
+
     def process(self, t, inputs):
         out_rows = []
+        pending = getattr(self, "_restore_emit", None)
+        if pending:
+            out_rows.extend(pending)
+            self._restore_emit = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 if d < 0:
@@ -1404,7 +1429,11 @@ class DeduplicateExec(NodeExec):
                 prev = self.state.get(ik)
                 prev_value = prev[0] if prev else None
                 accept = True
-                if self.node.acceptor is not None:
+                if self.node.acceptor is not None and prev is not None:
+                    # the first value per instance is accepted without
+                    # consulting the acceptor (reference: stateful_reduce
+                    # passes None state only to the combine_fn, and the
+                    # deduplicate acceptor never sees old_value=None)
                     try:
                         accept = bool(self.node.acceptor(value, prev_value))
                     except Exception as exc:

@@ -223,6 +223,8 @@ class PersistenceMode(Enum):
     REALTIME_REPLAY = "realtime_replay"
     PERSISTING = "persisting"
     OPERATOR_PERSISTING = "operator_persisting"
+    # only operators with an explicit name persist; inputs are not logged
+    SELECTIVE_PERSISTING = "selective_persisting"
     UDF_CACHING = "udf_caching"
 
 

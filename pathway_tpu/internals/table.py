@@ -529,6 +529,8 @@ class Table(Joinable):
             acceptor,
             value_col,
         )
+        # explicit name = persistent identity for SELECTIVE_PERSISTING
+        node.persistent_name = name or persistent_id
         out = Table._from_node(
             node,
             {n: prep._schema[n].dtype for n in prep.column_names()},

@@ -62,6 +62,9 @@ class PersistenceMode:
     SPEEDRUN = "speedrun"
     PERSISTING = "persisting"
     OPERATOR_PERSISTING = "operator_persisting"
+    # only operators with an explicit name persist; inputs are not logged
+    # (reference: SELECTIVE_PERSISTING in src/connectors/mod.rs:108)
+    SELECTIVE_PERSISTING = "selective_persisting"
 
 
 class SnapshotAccess:

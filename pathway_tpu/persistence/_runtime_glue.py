@@ -69,12 +69,29 @@ class PersistenceDriver:
                 f"invalid snapshot_access {mode!r}: expected 'record', "
                 "'replay' or 'full' (reference: PATHWAY_SNAPSHOT_ACCESS)"
             )
-        self.record = mode in (None, "record", "full")
+        # SELECTIVE_PERSISTING: only explicitly-named operators persist;
+        # inputs are neither logged nor offset-tracked
+        pmode = getattr(config, "persistence_mode", None)
+        pmode = getattr(pmode, "value", pmode)  # enum member or raw string
+        self.selective = pmode == "selective_persisting"
+        self.record = mode in (None, "record", "full") and not self.selective
         self.replay_allowed = mode in (None, "replay", "full")
+        # explicit snapshot_access = record/replay DEBUGGING (reference:
+        # PATHWAY_REPLAY_STORAGE + `pathway spawn --record`): the input log
+        # is the artifact, so it is never compacted away by operator
+        # snapshots and replay reproduces the run in full
+        self.debug_mode = mode in ("record", "replay", "full")
         self.inputs: dict[str, InputNode] = {}
         ordinal = 0
         for node in runtime.order:
             if isinstance(node, InputNode):
+                if getattr(node.source, "transient", False):
+                    # debug/markdown fixtures are not persistable connectors
+                    # (reference: only sources with persistent ids log and
+                    # seek); they re-read fresh on every run and rely on
+                    # operator snapshots (e.g. deduplicate state) to merge
+                    ordinal += 1
+                    continue
                 self.inputs[effective_persistent_id(node, ordinal)] = node
                 ordinal += 1
         self._node_to_pid = {n.id: pid for pid, n in self.inputs.items()}
@@ -88,14 +105,45 @@ class PersistenceDriver:
         self._last_real_time = 0
         self._orig_tick = runtime.tick
         # operator snapshots: on by default; every snapshot_every-th commit
-        # dumps all exec states and truncates the covered log
-        self.snapshot_operators = bool(
-            getattr(config, "snapshot_operators", True)
+        # dumps all exec states and truncates the covered log. Disabled in
+        # record/replay debugging where the log must survive verbatim.
+        self.snapshot_operators = (
+            bool(getattr(config, "snapshot_operators", True))
+            and not self.debug_mode
         )
         self.snapshot_every = max(
             int(getattr(config, "snapshot_every", 8) or 8), 1
         )
         self._commits_since_snapshot = 0
+        # mixed dependency: a node fed by BOTH a transient source and a
+        # logged one is excluded from snapshots (its transient rows re-feed)
+        # yet needs the logged rows to rebuild — truncating the log would
+        # lose them, so operator snapshots are disabled for such graphs
+        # (log-only persistence, the pre-compaction behavior)
+        if self.snapshot_operators and not self.selective:
+            tainted: set[int] = set()
+            logged: set[int] = set()
+            logged_input_ids = {n.id for n in self.inputs.values()}
+            for node in runtime.order:
+                if isinstance(node, InputNode):
+                    if getattr(node.source, "transient", False):
+                        tainted.add(node.id)
+                    elif node.id in logged_input_ids:
+                        logged.add(node.id)
+                    continue
+                if any(inp.id in tainted for inp in node.inputs):
+                    tainted.add(node.id)
+                if any(inp.id in logged for inp in node.inputs):
+                    logged.add(node.id)
+            if tainted & logged:
+                import logging
+
+                logging.getLogger("pathway_tpu").info(
+                    "operator snapshots disabled: graph mixes transient "
+                    "fixtures with persisted connectors; falling back to "
+                    "input-log persistence"
+                )
+                self.snapshot_operators = False
         self.replayed_events = 0  # observability: bounded-replay assertions
         self.restored_from_snapshot = False
         # set when the latest snapshot attempt aborted on an unpicklable
@@ -111,17 +159,78 @@ class PersistenceDriver:
         return json.loads(raw.decode())
 
     def _node_ordinals(self) -> list[tuple[int, str, Any]]:
-        """(ordinal, class name, exec) for every node, ordinal = topo
-        position — the stable cross-restart identity (same role as
-        effective_persistent_id for inputs)."""
+        """(ordinal, class name, exec) for every snapshot-eligible node,
+        ordinal = topo position — the stable cross-restart identity (same
+        role as effective_persistent_id for inputs).
+
+        Nodes fed (transitively) by a transient source re-process that
+        source's rows on every run, so restoring their state would double
+        -count; they are excluded — EXCEPT standalone accumulators
+        (deduplicate), which the reference persists under their own
+        persistent id precisely because their inputs re-feed
+        (non-retractable stateful_reduce, operators/stateful_reduce.rs).
+
+        SELECTIVE_PERSISTING keeps ONLY operators with an explicit
+        `persistent_name`, keyed by that name (graph position is free to
+        change between runs)."""
+        if self.selective:
+            out = []
+            for node in self.runtime.order:
+                name = getattr(node, "persistent_name", None)
+                if name:
+                    out.append(
+                        (
+                            f"name:{name}",
+                            type(node).__name__,
+                            self.runtime.execs[node.id],
+                        )
+                    )
+            return out
+        tainted: set[int] = set()
+        for node in self.runtime.order:
+            if isinstance(node, InputNode) and getattr(
+                node.source, "transient", False
+            ):
+                tainted.add(node.id)
+            elif any(inp.id in tainted for inp in node.inputs):
+                tainted.add(node.id)
         out = []
         for i, node in enumerate(self.runtime.order):
-            out.append((i, type(node).__name__, self.runtime.execs[node.id]))
+            ex = self.runtime.execs[node.id]
+            if node.id in tainted and not getattr(
+                ex, "persist_standalone", False
+            ):
+                continue
+            out.append((i, type(node).__name__, ex))
         return out
 
     def on_tick(self, t: int, injected: dict[int, list[DiffBatch]] | None = None):
         self._orig_tick(t, injected)
         if not self.record:
+            # selective mode snapshots named operators on shutdown AND on
+            # the regular commit interval — a killed process must not lose
+            # the one thing this mode promises to persist
+            if self.selective:
+                if t >= END_OF_TIME:
+                    self.commit(final=True)
+                    return
+                import time as _time
+
+                now = _time.monotonic()
+                if (
+                    now - self._last_commit_wall
+                ) * 1000.0 >= self.snapshot_interval_ms:
+                    self._last_commit_wall = now
+                    self._last_real_time = max(self._last_real_time, t)
+                    meta = self._load_meta()
+                    snap = self._snapshot_operators(meta)
+                    if snap:
+                        meta["state"] = snap
+                        meta["last_time"] = max(
+                            meta.get("last_time", 0), t
+                        )
+                        self.store.put(_META_KEY, json.dumps(meta).encode())
+                        self._gc(meta, snap)
             return
         if injected:
             for nid, batches in injected.items():
@@ -167,7 +276,10 @@ class PersistenceDriver:
             self._pending[pid] = []
             wrote = True
         offsets_changed = False
-        for pid, node in self.inputs.items():
+        # selective mode: inputs are neither logged nor offset-tracked —
+        # writing __static_done__ here would suppress sources on restart
+        # with no log to reproduce them
+        for pid, node in () if self.selective else self.inputs.items():
             state = None
             src = node.source
             session = getattr(src, "session", None)
@@ -183,10 +295,10 @@ class PersistenceDriver:
                 offsets_changed = True
         snap = None
         self._commits_since_snapshot += 1
-        if (
-            self.snapshot_operators
-            and (wrote or final)
-            and self._commits_since_snapshot >= self.snapshot_every
+        if self.snapshot_operators and (
+            final  # clean shutdown always snapshots: restarts restore
+            # accumulator state (deduplicate) even for short runs
+            or (wrote and self._commits_since_snapshot >= self.snapshot_every)
         ):
             snap = self._snapshot_operators(meta)
         if wrote or offsets_changed or final or snap:
@@ -206,15 +318,26 @@ class PersistenceDriver:
                 self._commits_since_snapshot = 0
                 self._gc(meta, snap)
 
+    @staticmethod
+    def _state_key(gen: int, ident) -> str:
+        if str(ident).isdigit():
+            return f"states/gen-{gen:06d}/{int(ident):05d}.pkl"
+        import urllib.parse
+
+        return (
+            f"states/gen-{gen:06d}/"
+            f"{urllib.parse.quote(str(ident), safe='')}.pkl"
+        )
+
     def _snapshot_operators(self, meta: dict) -> dict | None:
-        """Dump every exec's state under a fresh generation. Returns the
-        state descriptor, or None if ANY node failed to serialize — a
-        partial snapshot must not truncate the log (correctness over
-        compaction)."""
+        """Dump every eligible exec's state under a fresh generation.
+        Returns the state descriptor, or None if ANY node failed to
+        serialize — a partial snapshot must not truncate the log
+        (correctness over compaction)."""
         gen = int(meta.get("state", {}).get("gen", 0)) + 1
         nodes: dict[str, str] = {}
         written: list[str] = []
-        for ordinal, cls, ex in self._node_ordinals():
+        for ident, cls, ex in self._node_ordinals():
             try:
                 state = ex.state_dict()
                 if state is None:
@@ -224,10 +347,10 @@ class PersistenceDriver:
                 import logging
 
                 logging.getLogger("pathway_tpu").warning(
-                    "operator snapshot skipped: node %s (ordinal %d) has "
+                    "operator snapshot skipped: node %s (%s) has "
                     "unpicklable state; log compaction disabled",
                     cls,
-                    ordinal,
+                    ident,
                 )
                 # clean up this aborted generation's files so they don't
                 # orphan until a later successful snapshot, and record the
@@ -235,13 +358,13 @@ class PersistenceDriver:
                 # log keeps growing (ADVICE r2: all-or-nothing snapshot)
                 for key in written:
                     self.store.remove(key)
-                self.degraded_snapshot = f"{cls}#{ordinal}"
+                self.degraded_snapshot = f"{cls}#{ident}"
                 meta["snapshot_degraded"] = self.degraded_snapshot
                 return None
-            key = f"states/gen-{gen:06d}/{ordinal:05d}.pkl"
+            key = self._state_key(gen, ident)
             self.store.put(key, blob)
             written.append(key)
-            nodes[str(ordinal)] = cls
+            nodes[str(ident)] = cls
         self.degraded_snapshot = None
         meta.pop("snapshot_degraded", None)
         # snapshot covers everything up to and including the last processed
@@ -275,6 +398,15 @@ class PersistenceDriver:
         snap = meta.get("state")
         if snap:
             state_time = self._restore_operators(snap)
+            if any(
+                getattr(ex, "_restore_emit", None)
+                for ex in self.runtime.execs.values()
+            ):
+                # flush restored-accumulator re-emissions at the run's
+                # INITIAL time, before any log-tail replay at later times —
+                # otherwise the emission timestamp would be whatever data
+                # tick happens to run first
+                self._orig_tick(0, None)
         events: list[tuple[int, int, DiffBatch]] = []  # (time, node_id, batch)
         for pid, node in self.inputs.items():
             chunk_ids = self._live_chunks.get(pid)
@@ -301,7 +433,7 @@ class PersistenceDriver:
                 i += 1
             self._orig_tick(t, injected)
         # restore offsets so live sources continue past what was replayed
-        for pid, node in self.inputs.items():
+        for pid, node in () if self.selective else self.inputs.items():
             raw = self.store.get(f"offsets/{pid}.pkl")
             if raw is None:
                 continue
@@ -316,21 +448,29 @@ class PersistenceDriver:
     def _restore_operators(self, snap: dict) -> int:
         """Load every node's snapshotted state; on any structural mismatch
         (different graph shape/classes than when snapshotted) fall back to
-        full-log replay by reporting state_time -1."""
+        full-log replay by reporting state_time -1. In selective mode a
+        missing/renamed identity just means that operator starts fresh —
+        there is no log to fall back to."""
         gen = int(snap["gen"])
-        ordinals = {i: (cls, ex) for i, cls, ex in self._node_ordinals()}
+        current = {
+            str(ident): (cls, ex) for ident, cls, ex in self._node_ordinals()
+        }
         loaded: list[tuple[Any, dict]] = []
-        for key, cls in snap.get("nodes", {}).items():
-            ordinal = int(key)
-            if ordinal not in ordinals or ordinals[ordinal][0] != cls:
+        for ident, cls in snap.get("nodes", {}).items():
+            if ident not in current or current[ident][0] != cls:
+                if self.selective:
+                    continue
                 return -1
-            raw = self.store.get(f"states/gen-{gen:06d}/{ordinal:05d}.pkl")
+            raw = self.store.get(self._state_key(gen, ident))
             if raw is None:
+                if self.selective:
+                    continue
                 return -1
-            loaded.append((ordinals[ordinal][1], pickle.loads(raw)))
+            loaded.append((current[ident][1], pickle.loads(raw)))
         for ex, state in loaded:
             ex.load_state(state)
-        self.restored_from_snapshot = True
+        if loaded:
+            self.restored_from_snapshot = True
         return int(snap.get("time", 0))
 
 

@@ -66,3 +66,33 @@ assert_table_equality_wo_types = assert_table_equality
 
 def run_all(**kwargs) -> None:
     pw.run_all(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
+
+
+def _capture_streams(tables, **kwargs):
+    """Capture each table's full update stream [(vals, time, diff)] by
+    running the graph once with subscribers attached
+    (reference: GraphRunner.run_tables + CapturedStream)."""
+    streams: list[list] = [[] for _ in tables]
+
+    for i, t in enumerate(tables):
+        names = list(t.column_names())
+
+        def on_change(key, row, time, is_addition, _acc=streams[i], _names=names):
+            _acc.append(
+                (tuple(row[n] for n in _names), time, 1 if is_addition else -1)
+            )
+
+        pw.io.subscribe(t, on_change)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
+    return streams
+
+
+def assert_stream_equality_wo_index(t1, t2, **kwargs) -> None:
+    """Same multiset of (values, time, diff) updates, ignoring keys
+    (reference: tests/utils.py assert_equal_streams_wo_index)."""
+    from collections import Counter
+
+    s1, s2 = _capture_streams([t1, t2], **kwargs)
+    c1 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s1)
+    c2 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s2)
+    assert c1 == c2, f"\nleft:  {sorted(c1.items(), key=str)}\nright: {sorted(c2.items(), key=str)}"

@@ -79,8 +79,13 @@ def test_streaming_kill_restart_wordcount(tmp_path):
     pdir = tmp_path / "pstorage"
     out_a = tmp_path / "out_a.jsonl"
     out_b = tmp_path / "out_b.jsonl"
+    # snapshot_access="full": record/replay debugging keeps the input log
+    # verbatim, so the restarted run reproduces every output row (the
+    # default mode instead restores operator snapshots and only emits
+    # post-restart deltas, reference recovery semantics)
     cfg = pw.persistence.Config.simple_config(
-        pw.persistence.Backend.filesystem(str(pdir))
+        pw.persistence.Backend.filesystem(str(pdir)),
+        snapshot_access="full",
     )
 
     _write_words(input_dir / "f1.jsonl", ["a", "b", "a", "c", "a"])
@@ -125,7 +130,8 @@ def test_static_finished_source_not_rerun(tmp_path):
     _write_words(input_dir / "f1.jsonl", ["x", "y", "x"])
     pdir = tmp_path / "pstorage"
     cfg = pw.persistence.Config.simple_config(
-        pw.persistence.Backend.filesystem(str(pdir))
+        pw.persistence.Backend.filesystem(str(pdir)),
+        snapshot_access="full",  # keep the log: replay reproduces output
     )
 
     out_a = tmp_path / "out_a.jsonl"
@@ -347,7 +353,8 @@ def test_kill_restart_on_object_store(tmp_path):
     out_a = tmp_path / "out_a.jsonl"
     out_b = tmp_path / "out_b.jsonl"
     cfg = pw.persistence.Config.simple_config(
-        pw.persistence.Backend.s3(f"memory://pwtest-{uuid.uuid4().hex}")
+        pw.persistence.Backend.s3(f"memory://pwtest-{uuid.uuid4().hex}"),
+        snapshot_access="full",  # keep the log: replay reproduces output
     )
 
     _write_words(input_dir / "f1.jsonl", ["a", "b", "a", "c", "a"])
