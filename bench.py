@@ -101,50 +101,25 @@ def _bench_knn(np, on_accel, errors):
     # serial p50.
     device_ms = None
     if on_accel:
+        # run in a SUBPROCESS with a hard join timeout: the scan compile
+        # occasionally HANGS inside jax's C++ rpc when the axon tunnel
+        # drops mid remote_compile, and no in-process guard (incl. SIGALRM,
+        # which can't interrupt a blocked C call) can bound that
         try:
-            import jax
-            import jax.numpy as jnp
-
-            q_dev = jax.device_put(
-                np.ascontiguousarray(queries[:, 0, :])
-            )  # [n_queries, D]
-
-            def scan_topk(qs):
-                def step(carry, q):
-                    s, ix = dense_topk_prepared(
-                        q[None, :], prep, c2, valid, k, metric="cosine"
-                    )
-                    return carry, ix[0]
-
-                _, ids = jax.lax.scan(step, 0, qs)
-                return ids
-
-            jitted = jax.jit(scan_topk)
-
-            def timed(nq):
-                sub = q_dev[:nq]
-                np.asarray(jitted(sub))  # compile
-                t0 = time.perf_counter()
-                np.asarray(jitted(sub))
-                return time.perf_counter() - t0
-
-            # short scans: compiling a 100-step scan over a 1M-row top-k
-            # costs minutes of XLA time through the tunnel; 3 vs 13 still
-            # cancels the link RTT and amortizes per-query noise. One retry:
-            # the tunnel's remote_compile occasionally drops the connection
-            # mid-compile (r3 saw a broken pipe here) and a fresh attempt
-            # usually lands.
-            for attempt in range(2):
-                try:
-                    t_small, t_big = timed(3), timed(13)
-                    device_ms = (t_big - t_small) / 10 * 1000
-                    break
-                except Exception as e:
-                    if attempt == 1:
-                        raise
-                    errors.append(
-                        f"knn-device-retry:{type(e).__name__}:{e}"
-                    )
+            out = subprocess.run(
+                [sys.executable, "-c", _DEVICE_KNN_SCRIPT],
+                capture_output=True,
+                text=True,
+                timeout=600.0,
+            )
+            last = (out.stdout.strip().splitlines() or [""])[-1]
+            if out.returncode == 0 and last.startswith("DEVICE_MS="):
+                device_ms = float(last.split("=", 1)[1])
+            else:
+                tail = (out.stderr or out.stdout).strip()[-300:]
+                errors.append(f"knn-device:subprocess:{tail}")
+        except subprocess.TimeoutExpired:
+            errors.append("knn-device:TimeoutExpired:600s")
         except Exception as e:
             errors.append(f"knn-device:{type(e).__name__}:{e}")
 
@@ -175,6 +150,55 @@ def _bench_knn(np, on_accel, errors):
         except Exception as e:
             errors.append(f"knn-pallas:{type(e).__name__}:{e}")
     return n, dim, p50, pallas_p50, device_ms
+
+
+# Same corpus/seed as _bench_knn; prints DEVICE_MS=<float>. Short scans: a
+# 100-step scan over a 1M-row top-k costs minutes of XLA time through the
+# tunnel; 3 vs 13 still cancels the link RTT and amortizes per-query noise
+# (scan keeps per-query work - vmap would fuse into one batched matmul, a
+# different workload).
+_DEVICE_KNN_SCRIPT = r'''
+import time
+import numpy as np
+import jax
+from pathway_tpu.ops.knn import DeviceCorpus, dense_topk_prepared
+
+n, dim, k = 1_000_000, 384, 10
+rng = np.random.default_rng(0)
+corpus = DeviceCorpus(dim, capacity=n)
+corpus.host[:n] = rng.normal(size=(n, dim)).astype(np.float32)
+corpus.valid_host[:n] = True
+for i in range(n):
+    corpus.slot_of[i] = i
+    corpus.key_of[i] = i
+corpus.free = list(range(corpus.capacity - 1, n - 1, -1))
+corpus._dirty = True
+prep, c2, valid = corpus.prepared_arrays("cosine")
+queries = rng.normal(size=(100, 1, dim)).astype(np.float32)
+q_dev = jax.device_put(np.ascontiguousarray(queries[:, 0, :]))
+
+def scan_topk(qs):
+    def step(carry, q):
+        s, ix = dense_topk_prepared(
+            q[None, :], prep, c2, valid, k, metric="cosine"
+        )
+        return carry, ix[0]
+
+    _, ids = jax.lax.scan(step, 0, qs)
+    return ids
+
+jitted = jax.jit(scan_topk)
+
+def timed(nq):
+    sub = q_dev[:nq]
+    np.asarray(jitted(sub))  # compile
+    t0 = time.perf_counter()
+    np.asarray(jitted(sub))
+    return time.perf_counter() - t0
+
+t_small, t_big = timed(3), timed(13)
+print("DEVICE_MS=%r" % ((t_big - t_small) / 10 * 1000))
+'''
 
 
 def _measure_dispatch_floor(np) -> float:

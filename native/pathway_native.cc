@@ -94,6 +94,50 @@ static void blake2b_compress(Blake2bState* S, const uint8_t* block,
 }
 
 // 64-bit digest of `data` keyed with `key` (kk<=64 bytes).
+// State after absorbing the (zero-padded) key block — the salt never
+// changes between rows, so hash_columns precomputes this once per call and
+// memcpy-restores it per row instead of re-compressing 128 key bytes for
+// every key derived (that compression was ~half the hashing cost).
+static void blake2b64_key_state(const uint8_t* key, size_t kk,
+                                Blake2bState* S) {
+  const uint64_t nn = 8;  // digest bytes
+  for (int i = 0; i < 8; i++) S->h[i] = BLAKE2B_IV[i];
+  S->h[0] ^= 0x01010000ULL ^ ((uint64_t)kk << 8) ^ nn;
+  S->t[0] = 0;
+  S->t[1] = 0;
+  S->buflen = 0;
+  if (kk > 0) {
+    uint8_t keyblock[128];
+    std::memset(keyblock, 0, 128);
+    std::memcpy(keyblock, key, kk);
+    S->t[0] += 128;
+    blake2b_compress(S, keyblock, false);
+  }
+}
+
+// Finish hashing `data` from a precomputed key state (len > 0 assumed —
+// hash_columns rows always carry at least the tuple header bytes).
+static uint64_t blake2b64_from_state(const Blake2bState& KS,
+                                     const uint8_t* data, size_t len) {
+  Blake2bState S = KS;
+  while (len > 128) {
+    S.t[0] += 128;
+    if (S.t[0] < 128) S.t[1]++;
+    blake2b_compress(&S, data, false);
+    data += 128;
+    len -= 128;
+  }
+  uint8_t lastblock[128];
+  std::memset(lastblock, 0, 128);
+  std::memcpy(lastblock, data, len);
+  S.t[0] += len;
+  if (S.t[0] < len) S.t[1]++;
+  blake2b_compress(&S, lastblock, true);
+  uint64_t out;
+  std::memcpy(&out, &S.h[0], 8);
+  return out;
+}
+
 static uint64_t blake2b64_keyed(const uint8_t* key, size_t kk,
                                 const uint8_t* data, size_t len) {
   Blake2bState S;
@@ -303,6 +347,73 @@ static PyObject* py_hash_value(PyObject*, PyObject* v) {
 // hash_columns(columns: tuple[sequence,...], n: int) -> bytes (n * u64 LE)
 // Row i's key = hash of the tuple (col0[i], col1[i], ...) — same bytes as
 // ref_scalar(*row).
+// Per-column serialization strategy for the hash_columns row loop.
+// Buffer-protocol numeric columns (numpy int64/float64, and uint64 arrays
+// marked as pointers via ("__ptr__", arr)) serialize straight from the raw
+// buffer — no per-row PyObject boxing, which dominated the generic path.
+struct ColView {
+  enum Kind { GENERIC, I64, F64, PTR } kind = GENERIC;
+  PyObject* obj = nullptr;       // generic sequence
+  const int64_t* i64 = nullptr;  // I64
+  const double* f64 = nullptr;   // F64
+  const uint64_t* u64 = nullptr; // PTR
+  Py_buffer view{};
+  bool has_view = false;
+};
+
+static bool col_view_init(PyObject* col, Py_ssize_t n, ColView& cv) {
+  // ("__ptr__", uint64-array): raw keys hashed with the Pointer tag
+  if (PyTuple_CheckExact(col) && PyTuple_GET_SIZE(col) == 2) {
+    PyObject* tag = PyTuple_GET_ITEM(col, 0);
+    if (PyUnicode_CheckExact(tag)) {
+      const char* s = PyUnicode_AsUTF8(tag);
+      if (s && strcmp(s, "__ptr__") == 0) {
+        PyObject* arr = PyTuple_GET_ITEM(col, 1);
+        if (PyObject_GetBuffer(arr, &cv.view,
+                               PyBUF_FORMAT | PyBUF_C_CONTIGUOUS) == 0) {
+          if (cv.view.ndim == 1 && cv.view.itemsize == 8 &&
+              cv.view.len >= n * 8) {
+            cv.kind = ColView::PTR;
+            cv.u64 = (const uint64_t*)cv.view.buf;
+            cv.has_view = true;
+            return true;
+          }
+          PyBuffer_Release(&cv.view);
+        } else {
+          PyErr_Clear();
+        }
+        return false;  // malformed __ptr__ marker
+      }
+    }
+  }
+  if (PyObject_GetBuffer(col, &cv.view, PyBUF_FORMAT | PyBUF_C_CONTIGUOUS) ==
+      0) {
+    const char* f = cv.view.format ? cv.view.format : "";
+    // 1-D only: a (n, m) numeric array is a column of VECTOR cells and
+    // must serialize via the generic ndarray path, not element [i]
+    if (cv.view.ndim == 1 && (f[0] == 'l' || f[0] == 'q') && f[1] == 0 &&
+        cv.view.itemsize == 8 && cv.view.len >= n * 8) {
+      cv.kind = ColView::I64;
+      cv.i64 = (const int64_t*)cv.view.buf;
+      cv.has_view = true;
+      return true;
+    }
+    if (cv.view.ndim == 1 && f[0] == 'd' && f[1] == 0 &&
+        cv.view.itemsize == 8 && cv.view.len >= n * 8) {
+      cv.kind = ColView::F64;
+      cv.f64 = (const double*)cv.view.buf;
+      cv.has_view = true;
+      return true;
+    }
+    PyBuffer_Release(&cv.view);
+  } else {
+    PyErr_Clear();
+  }
+  cv.kind = ColView::GENERIC;
+  cv.obj = col;
+  return true;
+}
+
 static PyObject* py_hash_columns(PyObject*, PyObject* args) {
   PyObject* columns;
   Py_ssize_t n;
@@ -310,44 +421,81 @@ static PyObject* py_hash_columns(PyObject*, PyObject* args) {
   PyObject* fast_cols = PySequence_Fast(columns, "expected sequence of columns");
   if (!fast_cols) return nullptr;
   Py_ssize_t ncols = PySequence_Fast_GET_SIZE(fast_cols);
-  std::vector<PyObject*> col_objs(ncols);
-  for (Py_ssize_t c = 0; c < ncols; c++)
-    col_objs[c] = PySequence_Fast_GET_ITEM(fast_cols, c);
-  PyObject* out_bytes = PyBytes_FromStringAndSize(nullptr, n * 8);
-  if (!out_bytes) {
-    Py_DECREF(fast_cols);
-    return nullptr;
+  std::vector<ColView> views((size_t)ncols);
+  bool ok = true;
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    if (!col_view_init(PySequence_Fast_GET_ITEM(fast_cols, c), n, views[c])) {
+      ok = false;
+      break;
+    }
   }
-  uint64_t* out = (uint64_t*)PyBytes_AS_STRING(out_bytes);
+  PyObject* out_bytes = ok ? PyBytes_FromStringAndSize(nullptr, n * 8) : nullptr;
+  if (!out_bytes) ok = false;
+  uint64_t* out = out_bytes ? (uint64_t*)PyBytes_AS_STRING(out_bytes) : nullptr;
   std::string buf;
-  for (Py_ssize_t i = 0; i < n; i++) {
+  Blake2bState key_state;
+  blake2b64_key_state((const uint8_t*)g_state.salt.data(),
+                      g_state.salt.size(), &key_state);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
     buf.clear();
     buf.push_back('\x06');
     put_u32(buf, (uint32_t)ncols);
-    bool ok = true;
     for (Py_ssize_t c = 0; c < ncols; c++) {
-      PyObject* item = PySequence_GetItem(col_objs[c], i);
-      if (!item) {
-        ok = false;
-        break;
+      ColView& cv = views[c];
+      switch (cv.kind) {
+        case ColView::I64:
+          put_u32(buf, 9);
+          buf.push_back('\x02');
+          put_i64(buf, cv.i64[i]);
+          break;
+        case ColView::PTR:
+          put_u32(buf, 9);
+          buf.push_back('\x07');
+          put_u64(buf, cv.u64[i]);
+          break;
+        case ColView::F64: {
+          double f = cv.f64[i];
+          double t = (f < 0) ? -std::floor(-f) : std::floor(f);
+          put_u32(buf, 9);
+          if (f == t && f < 9007199254740992.0 && f > -9007199254740992.0) {
+            buf.push_back('\x02');
+            put_i64(buf, (int64_t)f);
+          } else {
+            buf.push_back('\x03');
+            put_f64(buf, f);
+          }
+          break;
+        }
+        case ColView::GENERIC: {
+          PyObject* item = PySequence_GetItem(cv.obj, i);
+          if (!item) {
+            ok = false;
+            break;
+          }
+          std::string sub;
+          ok = serialize_value(item, sub);
+          Py_DECREF(item);
+          if (!ok) break;
+          put_u32(buf, (uint32_t)sub.size());
+          buf.append(sub);
+          break;
+        }
       }
-      std::string sub;
-      ok = serialize_value(item, sub);
-      Py_DECREF(item);
       if (!ok) break;
-      put_u32(buf, (uint32_t)sub.size());
-      buf.append(sub);
     }
-    if (!ok) {
-      Py_DECREF(out_bytes);
-      Py_DECREF(fast_cols);
-      return nullptr;
-    }
-    out[i] = blake2b64_keyed(
-        (const uint8_t*)g_state.salt.data(), g_state.salt.size(),
-        (const uint8_t*)buf.data(), buf.size());
+    if (!ok) break;
+    out[i] = blake2b64_from_state(key_state, (const uint8_t*)buf.data(),
+                                  buf.size());
   }
+  for (auto& cv : views)
+    if (cv.has_view) PyBuffer_Release(&cv.view);
   Py_DECREF(fast_cols);
+  if (!ok) {
+    Py_XDECREF(out_bytes);
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "hash_columns failed");
+    return nullptr;
+  }
   return out_bytes;
 }
 

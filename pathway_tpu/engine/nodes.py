@@ -829,12 +829,21 @@ class JoinExec(NodeExec):
             ri = order_r[starts + offs]
             lcols = list(lb.columns.values())
             rcols = list(rb.columns.values())
+            from pathway_tpu.internals.api import (
+                ptr_column,
+                ref_scalars_columns,
+            )
+
+            # raw key buffers: no per-row Pointer boxing on the hot path
+            okeys = ref_scalars_columns(
+                [ptr_column(lb.keys[li]), ptr_column(rb.keys[ri])], total
+            )
+            # the source-id columns still need boxed Pointers as VALUES
+            # (only the key hashing above reads raw buffers)
             from pathway_tpu.engine.batch import _obj_column
-            from pathway_tpu.internals.api import ref_scalars_columns
 
             lptr = _obj_column(list(map(Pointer, lb.keys[li].tolist())))
             rptr = _obj_column(list(map(Pointer, rb.keys[ri].tolist())))
-            okeys = ref_scalars_columns([lptr, rptr], total)
             columns = {}
             names = self.node.column_names
             ncol = 0
@@ -1053,13 +1062,10 @@ class FlattenExec(NodeExec):
             idx_within = np.arange(total) - np.repeat(
                 np.cumsum(counts) - counts, counts
             )
-            parent_ptrs = _obj_column(
-                list(map(Pointer, b.keys[rep].tolist()))
-            )
-            # tolist(): the key serializer must see exact PyLongs, not np
-            # scalars (same contract as consolidate's hash path)
+            from pathway_tpu.internals.api import ptr_column
+
             nkeys = ref_scalars_columns(
-                [parent_ptrs, idx_within.tolist()], total
+                [ptr_column(b.keys[rep]), idx_within], total
             )
             new_cols = {}
             for ci, name in enumerate(in_cols):

@@ -126,16 +126,31 @@ def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     return Pointer(_hash_bytes(_value_bytes(tuple(values))))
 
 
+def ptr_column(keys: np.ndarray) -> tuple:
+    """Mark a raw uint64 key array as a Pointer column for
+    ref_scalars_columns — the native kernel serializes it straight from the
+    buffer instead of boxing one Pointer object per row."""
+    return ("__ptr__", np.ascontiguousarray(keys, dtype=np.uint64))
+
+
 def ref_scalars_columns(columns: list, n: int) -> np.ndarray:
     """Batch key derivation: row i keys as ref_scalar(col0[i], col1[i], ...).
-    The native path hashes all rows without re-entering the interpreter."""
+    The native path hashes all rows without re-entering the interpreter;
+    int64/float64 numpy columns and ptr_column-marked key arrays serialize
+    directly from their buffers."""
     nat = _get_native()
     if nat is not None:
         raw = nat.hash_columns(tuple(columns), n)
         return np.frombuffer(raw, dtype=np.uint64).copy()
+    cols = [
+        [Pointer(int(x)) for x in col[1]]
+        if isinstance(col, tuple) and len(col) == 2 and col[0] == "__ptr__"
+        else col
+        for col in columns
+    ]
     out = np.empty(n, dtype=np.uint64)
     for i in range(n):
-        out[i] = int(ref_scalar(*(col[i] for col in columns)))
+        out[i] = int(ref_scalar(*(col[i] for col in cols)))
     return out
 
 
