@@ -174,7 +174,10 @@ def table_from_markdown(
                 ref_scalar(*[vals[col_names.index(c)] for c in id_from])
             )
         else:
-            key = int(sequential_key(counter))
+            # reference derivation: unkeyed debug rows key by row number
+            # through the SAME pointer hash as pointer_from(i)
+            # (ids_from_pandas, reference internals/api.py:116-120)
+            key = int(ref_scalar(counter))
         counter += 1
         for n, v in zip(col_names, vals):
             col_values[n].append(v)
@@ -213,7 +216,7 @@ def table_from_rows(
         if pk:
             key = int(ref_scalar(*[vals[col_names.index(c)] for c in pk]))
         else:
-            key = int(sequential_key(i))
+            key = int(ref_scalar(i))
         events.setdefault(int(t), []).append((key, int(d), tuple(vals)))
     source = _RowsSource(col_names, sorted(events.items()))
     node = InputNode(source, col_names)
@@ -245,7 +248,8 @@ def table_from_pandas(
         if id_from:
             key = int(ref_scalar(*[vals[col_names.index(c)] for c in id_from]))
         else:
-            key = int(sequential_key(i))
+            # reference: keys come from the dataframe INDEX via ref_scalar
+            key = int(ref_scalar(_np_unbox(idx)))
         events.setdefault(t, []).append((key, d, vals))
     if schema is not None:
         dtypes = {n: schema.dtypes()[n] for n in col_names}
@@ -448,7 +452,7 @@ class StreamGenerator:
                 w: [
                     (
                         1,
-                        int(sequential_key(next(counter))),
+                        int(ref_scalar(next(counter))),
                         [row[n] for n in schema.column_names()],
                     )
                     for row in rows
@@ -508,7 +512,7 @@ class StreamGenerator:
             elif explicit_ids:
                 key = int(ref_scalar(_np_unbox(df.index[i])))
             else:
-                key = int(sequential_key(i))
+                key = int(ref_scalar(i))
             t = int(row["_time"])
             batches.setdefault(t, {}).setdefault(int(row["_worker"]), []).append(
                 (int(row["_diff"]), key, vals)

@@ -281,16 +281,22 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
             out[idx] = sub
         return _tighten(out)
     if isinstance(e, expr.RequireExpression):
-        val = eval_expr(e._val, ctx)
+        # deps first; the value evaluates ONLY on rows where every dep is
+        # non-None (lazy like IfElse — an eager evaluation would poison
+        # rows whose dep is legitimately None, e.g. diff's first row)
         missing = np.zeros(n, dtype=bool)
         for arg in e._args:
             a = eval_expr(arg, ctx)
             if a.dtype == object:
                 missing |= np.array([v is None for v in a])
         if not missing.any():
-            return val
-        out = val.astype(object) if val.dtype != object else val.copy()
-        out[missing] = None
+            return eval_expr(e._val, ctx)
+        out = np.empty(n, dtype=object)
+        out[:] = None
+        idx = np.nonzero(~missing)[0]
+        if len(idx):
+            sub = eval_expr(e._val, _subset_ctx(ctx, idx))
+            out[idx] = sub
         return out
     if isinstance(e, expr.FillErrorExpression):
         val = eval_expr(e._expr, ctx)

@@ -999,9 +999,15 @@ class UpdateRowsExec(NodeExec):
 class FlattenNode(Node):
     """(reference: Graph::flatten_table; Table.flatten internals/table.py:2089)"""
 
-    def __init__(self, input: Node, flatten_col: str):
-        super().__init__([input], input.column_names)
+    def __init__(
+        self, input: Node, flatten_col: str, origin_id: str | None = None
+    ):
+        cols = list(input.column_names)
+        if origin_id is not None:
+            cols.append(origin_id)
+        super().__init__([input], cols)
         self.flatten_col = flatten_col
+        self.origin_id = origin_id
 
     def make_exec(self):
         return FlattenExec(self)
@@ -1073,6 +1079,10 @@ class FlattenExec(NodeExec):
                     new_cols[name] = _obj_column(items_all)
                 else:
                     new_cols[name] = cols[ci][rep]
+            if node.origin_id is not None:
+                new_cols[node.origin_id] = _obj_column(
+                    list(map(Pointer, b.keys[rep].tolist()))
+                )
             out.append(DiffBatch(nkeys, b.diffs[rep], new_cols))
         return out
 

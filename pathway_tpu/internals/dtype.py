@@ -155,7 +155,11 @@ def wrap(x: Any) -> DType:
     origin = typing.get_origin(x)
     if origin is not None:
         args = typing.get_args(x)
-        if origin is Union:
+        import types as _types
+
+        # typing.Optional[float] and the PEP-604 spelling float | None
+        # have different origins (typing.Union vs types.UnionType)
+        if origin is Union or origin is _types.UnionType:
             non_none = [a for a in args if a is not type(None)]
             has_none = len(non_none) != len(args)
             if len(non_none) == 1:

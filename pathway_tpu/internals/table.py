@@ -570,6 +570,25 @@ class Table(Joinable):
 
     # --- set ops --------------------------------------------------------------
 
+    @staticmethod
+    def empty(**kwargs: Any) -> "Table":
+        """An empty table whose schema is given by kwargs of column types
+        (reference: Table.empty, internals/table.py:355)."""
+        from pathway_tpu.engine.nodes import InputNode
+        from pathway_tpu.engine.runtime import StaticSource
+
+        class _Empty(StaticSource):
+            transient = True
+
+            def events(self):
+                return iter(())
+
+        names = list(kwargs.keys())
+        node = InputNode(_Empty(names), names)
+        return Table._from_node(
+            node, {n: dt.wrap(t) for n, t in kwargs.items()}, Universe()
+        )
+
     def concat(self, *others: "Table") -> "Table":
         tables = [self] + list(others)
         names = self.column_names()
@@ -696,12 +715,19 @@ class Table(Joinable):
 
     # --- restructuring --------------------------------------------------------
 
-    def flatten(self, *args: ColumnReference, **kwargs) -> "Table":
+    def flatten(
+        self, *args: ColumnReference, origin_id: str | None = None, **kwargs
+    ) -> "Table":
         assert len(args) == 1, "flatten takes exactly one column"
         to_flatten = args[0]
         name = to_flatten.name
+        if origin_id is not None and origin_id in self.column_names():
+            raise ValueError(
+                f"flatten: origin_id {origin_id!r} collides with an "
+                "existing column"
+            )
         prep = self.select(*[self[n] for n in self.column_names()])
-        node = nodes.FlattenNode(prep._node, name)
+        node = nodes.FlattenNode(prep._node, name, origin_id=origin_id)
         inner = prep._schema[name].dtype
         if isinstance(inner, (dt.ListDType,)):
             item_dt = inner.wrapped
@@ -715,6 +741,9 @@ class Table(Joinable):
             n: (item_dt if n == name else prep._schema[n].dtype)
             for n in prep.column_names()
         }
+        if origin_id is not None:
+            # parent-row pointer column (reference: Table.flatten origin_id)
+            dtypes[origin_id] = dt.POINTER
         return Table._from_node(node, dtypes, Universe())
 
     def sort(

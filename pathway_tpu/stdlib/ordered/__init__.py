@@ -16,13 +16,20 @@ def diff(
 ) -> Any:
     """Compute per-row difference vs the previous row in timestamp order
     (reference: stdlib/ordered/diff.py, built on sort prev/next pointers)."""
+    import pathway_tpu as pw
+
     sorted_ptrs = table.sort(key=timestamp, instance=instance)
     with_prev = table.with_columns(_prev=sorted_ptrs.prev)
+    # one indexer shared by every value column (an ix per column would
+    # duplicate the full table state per diffed column)
+    prev_rows = table.ix(with_prev._prev, optional=True)
     out_cols = {}
     for v in values:
         name = f"diff_{v.name}"
-        prev_rows = table.ix(with_prev._prev, optional=True)
-        out_cols[name] = v - prev_rows[v.name]
+        # first row per instance has no predecessor: None, not an error
+        out_cols[name] = pw.require(
+            v - prev_rows[v.name], prev_rows[v.name]
+        )
     return table.select(**out_cols)
 
 

@@ -17,46 +17,64 @@ def interpolate(
     mode: InterpolateMode = InterpolateMode.LINEAR,
 ):
     """Linear interpolation of missing (None) values along a time ordering
-    (reference: stdlib/statistical/_interpolate.py)."""
-    import pathway_tpu as pw
+    (reference: stdlib/statistical/_interpolate.py). Each null cell is
+    filled between the NEAREST NON-NULL neighbors of its own column in
+    timestamp order (nulls between them are skipped over); leading/trailing
+    gaps clamp to the first/last known value."""
+    import bisect
 
-    sorted_ptrs = table.sort(key=timestamp)
-    t = table.with_columns(
-        _prev=sorted_ptrs.prev, _next=sorted_ptrs.next, _ts=timestamp
+    from pathway_tpu.stdlib.utils.col import multiapply_all_rows
+
+    assert mode == InterpolateMode.LINEAR
+    names = [v.name for v in values]
+
+    def _missing(v):
+        # the all-rows bridge goes through pandas, which stores missing
+        # optional floats as NaN
+        return v is None or (isinstance(v, float) and v != v)
+
+    def fn(ts_col, *val_cols):
+        outs = []
+        for vc in val_cols:
+            pts = sorted(
+                (ts_col[i], vc[i])
+                for i in range(len(vc))
+                if not _missing(vc[i])
+            )
+            xs = [p[0] for p in pts]
+            res = []
+            for i in range(len(vc)):
+                if not _missing(vc[i]):
+                    res.append(vc[i])
+                    continue
+                t0 = ts_col[i]
+                j = bisect.bisect_left(xs, t0)
+                left = pts[j - 1] if j > 0 else None
+                right = pts[j] if j < len(pts) else None
+                if left is None and right is None:
+                    res.append(None)
+                elif left is None:
+                    res.append(float(right[1]))
+                elif right is None:
+                    res.append(float(left[1]))
+                else:
+                    w = (t0 - left[0]) / (right[0] - left[0])
+                    res.append(left[1] + w * (right[1] - left[1]))
+            outs.append(res)
+        return outs
+
+    interped = multiapply_all_rows(
+        timestamp, *values, fun=fn, result_col_names=names
     )
-
-    out = {}
-    for v in values:
-        name = v.name
-
-        @pw.udf
-        def interp(val, ts, prev_val, prev_ts, next_val, next_ts):
-            if val is not None:
-                return val
-            if prev_val is None and next_val is None:
-                return None
-            if prev_val is None:
-                return next_val
-            if next_val is None:
-                return prev_val
-            if next_ts == prev_ts:
-                return prev_val
-            w = (ts - prev_ts) / (next_ts - prev_ts)
-            return prev_val + w * (next_val - prev_val)
-
-        prev_rows = table.ix(t._prev, optional=True)
-        next_rows = table.ix(t._next, optional=True)
-        prev_t = t.ix(t._prev, optional=True)
-        next_t = t.ix(t._next, optional=True)
-        out[name] = interp(
-            t[name],
-            t._ts,
-            prev_rows[name],
-            prev_t._ts,
-            next_rows[name],
-            next_t._ts,
-        )
-    return table.select(**out)
+    # full table returned in the ORIGINAL column order, interpolated
+    # columns substituted in place (reference: interpolate returns the
+    # full table)
+    return table.select(
+        **{
+            n: (interped[n] if n in names else table[n])
+            for n in table.column_names()
+        }
+    )
 
 
 __all__ = ["interpolate", "InterpolateMode"]

@@ -1,0 +1,269 @@
+"""Ported reference ordered/statistical/flatten tests
+(reference: python/pathway/tests/ordered/test_diff.py,
+statistical/test_interpolate.py, test_flatten.py) — prev/next-based diff
+with instance partitioning, linear interpolation over a sorted axis,
+flatten with origin ids."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu import Table, this
+from pathway_tpu.debug import table_from_markdown as T
+from pathway_tpu.debug import table_from_pandas
+
+from tests.ref_utils import (
+    assert_table_equality_wo_index,
+    assert_table_equality_wo_index_types,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_diff_single_column():
+    t = T(
+        """
+            | t |  v
+        1   | 1 |  1
+        2   | 2 |  2
+        3   | 3 |  4
+        4   | 4 |  7
+        5   | 5 |  11
+        6   | 6 |  16
+        7   | 7 |  22
+        8   | 8 |  29
+        9   | 9 |  37
+    """
+    )
+    res = t.diff(t.t, t.v)
+
+    expected = T(
+        """
+            | diff_v
+        1   |
+        2   | 1
+        3   | 2
+        4   | 3
+        5   | 4
+        6   | 5
+        7   | 6
+        8   | 7
+        9   | 8
+    """
+    )
+
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_diff_multiple_columns():
+    t = T(
+        """
+            | t |  v1  | v2
+        1   | 1 |  1   | 0
+        2   | 2 |  2   | 10
+        3   | 3 |  4   | 54
+        4   | 4 |  7   | 64
+        5   | 5 |  11  | 12
+        6   | 6 |  16  | 24
+        7   | 7 |  22  | 18
+        8   | 8 |  29  | -45
+        9   | 9 |  37  | 100
+    """
+    )
+    res = t.diff(t.t, t.v1, t.v2)
+
+    expected = T(
+        """
+            | diff_v1 | diff_v2
+        1   |    |
+        2   | 1  | 10
+        3   | 2  | 44
+        4   | 3  | 10
+        5   | 4  | -52
+        6   | 5  | 12
+        7   | 6  | -6
+        8   | 7  | -63
+        9   | 8  | 145
+    """
+    )
+
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_diff_instance():
+    t = T(
+        """
+            | t | i |  v
+        1   | 1 | 0 |  1
+        2   | 2 | 1 |  2
+        3   | 3 | 1 |  4
+        4   | 3 | 0 |  7
+        5   | 5 | 1 |  11
+        6   | 5 | 0 |  16
+        7   | 7 | 0 |  22
+        8   | 8 | 1 |  29
+        9   | 9 | 0 |  37
+    """
+    )
+    res = t.diff(t.t, t.v, instance=t.i)
+
+    expected = T(
+        """
+            | diff_v
+        1   |
+        2   |
+        3   |  2
+        4   |  6
+        5   |  7
+        6   |  9
+        7   |  6
+        8   | 18
+        9   | 15
+    """
+    )
+
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interpolate_already_sorted():
+    t = T(
+        """
+            | t |  v
+        1   | 1 |  1
+        2   | 2 |  2
+        3   | 3 |  3
+        4   | 4 |  4
+        5   | 5 |  5
+        6   | 6 |  6
+        7   | 7 |  7
+        8   | 8 |  8
+        9   | 9 |  9
+    """
+    )
+    res = pw.statistical.interpolate(t, t.t, t.v)
+
+    assert_table_equality_wo_index_types(res, t)
+
+
+def test_interpolate_multiple_columns():
+    t = T(
+        """
+            | t |  v1 | v2
+        1   | 1 |  1  |
+        2   | 2 |     | 10
+        3   | 3 |  3  | 40
+        4   | 4 |     |
+        5   | 5 |  5  | 50
+        6   | 6 |     |
+        7   | 7 |     |
+        8   | 8 |     | 80
+        9   | 9 |  9  |
+    """
+    )
+    res = pw.statistical.interpolate(t, t.t, t.v1, t.v2)
+
+    expected = T(
+        """
+            | t |  v1   | v2
+        1   | 1 |  1    | 10.0
+        2   | 2 |  2.0  | 10
+        3   | 3 |  3    | 40
+        4   | 4 |  4.0  | 45.0
+        5   | 5 |  5    | 50
+        6   | 6 |  6.0  | 60.0
+        7   | 7 |  7.0  | 70.0
+        8   | 8 |  8.0  | 80
+        9   | 9 |  9    | 80.0
+    """
+    )
+
+    assert_table_equality_wo_index_types(res, expected)
+
+
+def test_flatten_simple():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]}))
+
+    assert_table_equality_wo_index(
+        tab.flatten(this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      2 | 0
+      3 | 0
+      4 | 0
+    """,
+        ).with_columns(origin_id=tab.pointer_from(this.origin_id)),
+    )
+
+
+def test_flatten_no_origin():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]}))
+
+    assert_table_equality_wo_index(
+        tab.flatten(this.col),
+        T(
+            """
+    col
+      1
+      2
+      3
+      4
+    """,
+        ),
+    )
+
+
+def test_flatten_inner_repeats():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 1, 1, 3]]}))
+
+    assert_table_equality_wo_index(
+        tab.flatten(this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      1 | 0
+      1 | 0
+      3 | 0
+    """,
+        ).with_columns(origin_id=tab.pointer_from(this.origin_id)),
+    )
+
+
+def test_flatten_more_repeats():
+    tab = table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 1, 1, 3], [1]]})
+    )
+
+    assert_table_equality_wo_index(
+        tab.flatten(this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      1 | 0
+      1 | 0
+      3 | 0
+      1 | 1
+    """,
+        ).with_columns(origin_id=tab.pointer_from(this.origin_id)),
+    )
+
+
+def test_flatten_empty_lists():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[], []]}))
+
+    assert_table_equality_wo_index(
+        tab.flatten(this.col, origin_id="origin_id"),
+        Table.empty(col=Any, origin_id=pw.Pointer),
+    )
