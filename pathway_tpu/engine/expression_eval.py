@@ -149,7 +149,7 @@ def _binary(op: str, left: np.ndarray, right: np.ndarray) -> np.ndarray:
                     from pathway_tpu.internals.errors import record_error
 
                     for _ in range(int(np.sum(bad))):
-                        record_error("division by zero")
+                        record_error(ZeroDivisionError("division by zero"))
                     res = np.where(bad, np.nan, np.divide(l, np.where(bad, 1, r)))
                     out = res.astype(object)
                     out[np.asarray(bad)] = ERROR
@@ -162,7 +162,7 @@ def _binary(op: str, left: np.ndarray, right: np.ndarray) -> np.ndarray:
                     from pathway_tpu.internals.errors import record_error
 
                     for _ in range(int(np.sum(bad))):
-                        record_error("division by zero")
+                        record_error(ZeroDivisionError("division by zero"))
                     res = fn(left, np.where(bad, 1, right))
                     out = res.astype(object)
                     out[np.asarray(bad)] = ERROR
@@ -194,6 +194,8 @@ def _py_eq(a, b):
 
 
 _BINARY_NP: dict[str, Callable] = {
+    "<<": np.left_shift,
+    ">>": np.right_shift,
     "+": np.add,
     "-": np.subtract,
     "*": np.multiply,
@@ -209,6 +211,8 @@ _BINARY_NP: dict[str, Callable] = {
 }
 
 _BINARY_PY: dict[str, Callable] = {
+    "<<": operator.lshift,
+    ">>": operator.rshift,
     "+": operator.add,
     "-": operator.sub,
     "*": operator.mul,
@@ -244,7 +248,14 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
         if e._op == "~":
             if a.dtype == bool:
                 return ~a
-            return _tighten(_elementwise(operator.inv, a))
+            # object columns of bools (optional bool etc.) are logical not;
+            # ints are bitwise (reference: Not on Bool, Neg semantics)
+            def inv(v):
+                if isinstance(v, (bool, np.bool_)):
+                    return not v
+                return operator.inv(v)
+
+            return _tighten(_elementwise(inv, a))
         if e._op == "abs":
             return np.abs(a) if _is_numeric(a) else _elementwise(abs, a)
         raise NotImplementedError(e._op)

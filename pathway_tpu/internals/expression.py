@@ -120,6 +120,18 @@ class ColumnExpression:
     def __rxor__(self, other):
         return ColumnBinaryOpExpression("^", other, self)
 
+    def __lshift__(self, other):
+        return ColumnBinaryOpExpression("<<", self, other)
+
+    def __rlshift__(self, other):
+        return ColumnBinaryOpExpression("<<", other, self)
+
+    def __rshift__(self, other):
+        return ColumnBinaryOpExpression(">>", self, other)
+
+    def __rrshift__(self, other):
+        return ColumnBinaryOpExpression(">>", other, self)
+
     def __invert__(self):
         return ColumnUnaryOpExpression("~", self)
 

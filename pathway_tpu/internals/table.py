@@ -145,6 +145,91 @@ def resolve_to_internal(
 # reference: internals/type_interpreter.py)
 
 
+_CMP_OP_NAMES = {
+    "==": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"
+}
+
+
+def _dtype_hint(d: dt.DType) -> str:
+    """typing-style rendering used in comparison error messages
+    (reference type_interpreter wording: tuple[int, str], int | None)."""
+    if isinstance(d, dt.OptionalDType):
+        return f"{_dtype_hint(d.wrapped)} | None"
+    if isinstance(d, dt.TupleDType) and d.args is not None:
+        return "tuple[" + ", ".join(_dtype_hint(a) for a in d.args) + "]"
+    hint = d.typehint
+    return getattr(hint, "__name__", str(hint))
+
+
+def _elements_comparable(op: str, a: dt.DType, b: dt.DType) -> bool:
+    """Recursive element compatibility for tuple/list comparisons
+    (reference: _eval_binary_op_on_tuples broadcast semantics). Ordering
+    ops reject optional elements at any depth; eq/ne tolerate NONE
+    against anything."""
+    ordering = op in ("<", "<=", ">", ">=")
+    if ordering and (a.is_optional() or b.is_optional()):
+        return False
+    sa, sb = a.strip_optional(), b.strip_optional()
+    if sa == dt.ANY or sb == dt.ANY:
+        return True
+    if not ordering and (sa == dt.NONE or sb == dt.NONE):
+        return True
+    if ordering and (sa == dt.NONE or sb == dt.NONE):
+        return False
+    la = isinstance(sa, dt.TupleDType) and sa.args is not None
+    lb = isinstance(sb, dt.TupleDType) and sb.args is not None
+    if la or lb or isinstance(sa, dt.ListDType) or isinstance(sb, dt.ListDType):
+        return _tuple_like_comparable(op, sa, sb)
+    if sa == sb:
+        return True
+    if sa in (dt.INT, dt.FLOAT) and sb in (dt.INT, dt.FLOAT):
+        return True
+    return False
+
+
+def _tuple_like_comparable(op: str, sl: dt.DType, sr: dt.DType) -> bool:
+    """Pairwise (with list broadcast) compatibility of two tuple-like
+    dtypes."""
+    l_args = sl.args if isinstance(sl, dt.TupleDType) else None
+    r_args = sr.args if isinstance(sr, dt.TupleDType) else None
+    if l_args is not None and r_args is not None:
+        if len(l_args) != len(r_args):
+            return op in ("==", "!=")
+        return all(
+            _elements_comparable(op, a, b) for a, b in zip(l_args, r_args)
+        )
+    l_elt = sl.wrapped if isinstance(sl, dt.ListDType) else None
+    r_elt = sr.wrapped if isinstance(sr, dt.ListDType) else None
+    if l_elt is not None and r_args is not None:
+        return all(_elements_comparable(op, l_elt, b) for b in r_args)
+    if r_elt is not None and l_args is not None:
+        return all(_elements_comparable(op, a, r_elt) for a in l_args)
+    if l_elt is not None and r_elt is not None:
+        return _elements_comparable(op, l_elt, r_elt)
+    return True  # untyped tuple-likes: no static information to gate on
+
+
+def _check_tuple_comparable(op: str, l: dt.DType, r: dt.DType) -> None:
+    """Reject comparisons of tuples/lists with incompatible element types,
+    and orderings over tuples with optional elements (reference:
+    test_operators.py tuple comparison type errors)."""
+
+    def tuple_like(d: dt.DType) -> bool:
+        s = d.strip_optional()
+        return (
+            isinstance(s, dt.TupleDType) and s.args is not None
+        ) or isinstance(s, dt.ListDType)
+
+    if not (tuple_like(l) and tuple_like(r)):
+        return
+    if not _tuple_like_comparable(op, l.strip_optional(), r.strip_optional()):
+        raise TypeError(
+            f"Pathway does not support using binary operator "
+            f"{_CMP_OP_NAMES[op]} on columns of types "
+            f"{_dtype_hint(l)}, {_dtype_hint(r)}."
+        )
+
+
 def infer_dtype(e: ColumnExpression, env) -> dt.DType:
     if isinstance(e, ColumnReference):
         if e.name == "id":
@@ -159,7 +244,22 @@ def infer_dtype(e: ColumnExpression, env) -> dt.DType:
         r = infer_dtype(e._right, env)
         op = e._op
         if op in ("==", "!=", "<", "<=", ">", ">="):
+            _check_tuple_comparable(op, l, r)
             return dt.BOOL
+        if op in ("<<", ">>"):
+            # shifts are defined on (int, int) only (reference
+            # operator_mapping: Lshift/Rshift over INT)
+            if (
+                l.strip_optional() not in (dt.INT, dt.ANY)
+                or r.strip_optional() not in (dt.INT, dt.ANY)
+            ):
+                name = "lshift" if op == "<<" else "rshift"
+                raise TypeError(
+                    f"Pathway does not support using binary operator "
+                    f"{name} on columns of types {_dtype_hint(l)}, "
+                    f"{_dtype_hint(r)}."
+                )
+            return dt.INT
         if op == "/":
             return dt.FLOAT
         if op in ("&", "|", "^") and l == dt.BOOL and r == dt.BOOL:
