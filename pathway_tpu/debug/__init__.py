@@ -143,10 +143,17 @@ def table_from_markdown(
     id_from: Sequence[str] | None = None,
     unsafe_trusted_ids: bool = False,
     schema: Any = None,
+    split_on_whitespace: bool | None = None,
     _stream: bool = False,
 ) -> Table:
     """Parse a markdown / whitespace table. Special columns: ``__time__``
-    (logical time), ``__diff__`` (+1/-1)."""
+    (logical time), ``__diff__`` (+1/-1). ``split_on_whitespace=False``
+    requires pipe delimiters (cells may contain spaces); the default
+    auto-detects."""
+    if split_on_whitespace is False and "|" not in table_def:
+        raise ValueError(
+            "split_on_whitespace=False requires a pipe-delimited table"
+        )
     header, data, ids = _split_markdown(table_def)
     col_names = [h for h in header if h not in ("__time__", "__diff__")]
     time_idx = header.index("__time__") if "__time__" in header else None

@@ -733,6 +733,21 @@ class Table(Joinable):
     def __lshift__(self, other: "Table") -> "Table":
         return self.update_cells(other)
 
+    def __add__(self, other: "Table") -> "Table":
+        """Column union of two same-universe tables: C.columns =
+        self.columns + other.columns, C.id = self.id (reference:
+        Table.__add__, internals/table.py:424). Overlapping names are
+        allowed only when both sides name THE SAME column."""
+        exprs: dict[str, Any] = {n: self[n] for n in self.column_names()}
+        for n in other.column_names():
+            if n in exprs and other is not self:
+                raise ValueError(
+                    f"Table.__add__: column {n!r} exists on both sides; "
+                    "columns must be disjoint"
+                )
+            exprs[n] = other[n]
+        return self._build_rowwise(exprs)
+
     def intersect(self, *tables: "Table") -> "Table":
         node = nodes.UniverseSetOpNode(
             self._node, [t._node for t in tables], "intersect"

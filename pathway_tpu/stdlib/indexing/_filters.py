@@ -122,6 +122,16 @@ class _Parser:
         if k == "str":
             self.eat()
             s = v[1:-1]
+            if v[0] == "`":
+                # JMESPath backticks delimit JSON literals: `4` is the
+                # number 4, `"x"` the string "x"; bare words fall back to
+                # their raw text
+                import json as _json
+
+                try:
+                    return lambda md, val=_json.loads(s): val
+                except ValueError:
+                    pass
             return lambda md, s=s: s
         if k == "num":
             self.eat()

@@ -150,7 +150,12 @@ class TpuDenseKnnIndex:
         else:
             from pathway_tpu.ops.knn import dense_topk_prepared
 
-            prep, c2, valid = self.corpus.prepared_arrays(self.metric)
+            # f32 end to end: the inner-index path serves RAG retrieval on
+            # modest corpora where exact reference-parity scores matter;
+            # the bulk bench path keeps bf16 on the MXU
+            prep, c2, valid = self.corpus.prepared_arrays(
+                self.metric, bf16=False
+            )
             scores = idx = None
             if self.kernel == "pallas" and self.metric in ("cosine", "dot"):
                 from pathway_tpu.ops import pallas_topk as pt
@@ -169,12 +174,20 @@ class TpuDenseKnnIndex:
                     )
             if scores is None:
                 scores, idx = dense_topk_prepared(
-                    qmat, prep, c2, valid, eff_k, metric=self.metric
+                    qmat, prep, c2, valid, eff_k, metric=self.metric,
+                    bf16=False,
                 )
-        scores = np.asarray(scores)
+        scores = np.asarray(scores, dtype=np.float64)
         idx = np.asarray(idx)
+        if self.metric == "cosine":
+            # reference USearch COS scores are -(1 - cos): negative
+            # distances, not raw similarities
+            scores = scores - 1.0
         out = []
         for qi, (_q, k, flt) in enumerate(queries):
+            if int(k) <= 0:
+                out.append(())  # k=0 means no matches, not one
+                continue
             pred = compile_filter(flt) if flt else None
             matches = []
             for j in range(idx.shape[1]):
@@ -354,10 +367,12 @@ class LshKnnIndex:
                     continue
                 v = self.vectors[key]
                 if self.metric == "cosine":
+                    # same convention as the dense backends: negative
+                    # cosine distance (cos - 1), exact match scores 0
                     s = float(
                         np.dot(qv, v)
                         / ((np.linalg.norm(qv) * np.linalg.norm(v)) + 1e-30)
-                    )
+                    ) - 1.0
                 else:
                     s = -float(np.sum((qv - v) ** 2))
                 scored.append((key, s))

@@ -150,10 +150,13 @@ class DataIndex:
             _score=this._reply.get(1),
         )
         data_rows = self.data_table.ix(flat2._ptr, optional=True)
+        # reply columns carry the reference's public names so users can
+        # select pw.right._pw_index_reply_score etc. (reference:
+        # data_index.py _INDEX_REPLY schema)
         combined_exprs: dict[str, Any] = {
             "_qid": flat2._qid,
-            "_score": flat2._score,
-            "_ptr": flat2._ptr,
+            _SCORE: flat2._score,
+            _MATCHED_ID: flat2._ptr,
         }
         for c in self.data_table.column_names():
             combined_exprs[c] = data_rows[c]
@@ -165,13 +168,23 @@ class DataIndex:
         agg: dict[str, Any] = {"_qid": this._qid}
         for c in self.data_table.column_names():
             agg[c] = reducers.tuple(combined[c])
-        agg[_SCORE] = reducers.tuple(combined._score)
-        agg[_MATCHED_ID] = reducers.tuple(combined._ptr)
+        agg[_SCORE] = reducers.tuple(combined[_SCORE])
+        agg[_MATCHED_ID] = reducers.tuple(combined[_MATCHED_ID])
         collapsed = combined.groupby(
-            combined._qid, sort_by=-combined._score
+            combined._qid, sort_by=-combined[_SCORE]
         ).reduce(**agg)
+        # every query gets a row: matchless queries collapse to EMPTY
+        # tuples, not None (reference: test_no_match_is_empty_list)
+        defaults = query_table.select(
+            _qid=query_table.id,
+            **{c: () for c in self.data_table.column_names()},
+            **{_SCORE: (), _MATCHED_ID: ()},
+        )
+        full = defaults.update_rows(
+            collapsed.with_id(collapsed._qid)
+        )
         return query_table.join_left(
-            collapsed, query_table.id == collapsed._qid, id=query_table.id
+            full, query_table.id == full._qid, id=query_table.id
         )
 
     def query(

@@ -191,6 +191,23 @@ class TpuKnnFactory(InnerIndexFactory):
         )
 
 
+def _probe_dimensions(embedder) -> int:
+    """Dimensionality of an embedder by invoking its wrapped function on a
+    sample input (reference factories defer dimensions to the embedder)."""
+    fn = getattr(embedder, "__wrapped__", embedder)
+    return len(fn("."))
+
+
+def _check_factory_args(dimensions, embedder) -> None:
+    # reference rule: embedder-backed indexes can probe their own output
+    # dimension; without one, dimensions must be given explicitly
+    if dimensions is None and embedder is None:
+        raise ValueError(
+            "Either `dimensions` or `embedder` must be provided to index "
+            "factory."
+        )
+
+
 @dataclass(kw_only=True)
 class BruteForceKnnFactory(InnerIndexFactory):
     dimensions: int | None = None
@@ -198,6 +215,9 @@ class BruteForceKnnFactory(InnerIndexFactory):
     auxiliary_space: int = 512
     metric: Any = None
     embedder: Any = None
+
+    def __post_init__(self):
+        _check_factory_args(self.dimensions, self.embedder)
 
     def build_inner_index(self, data_column, metadata_column=None):
         return BruteForceKnn(
@@ -220,6 +240,9 @@ class UsearchKnnFactory(InnerIndexFactory):
     expansion_search: int = 0
     embedder: Any = None
 
+    def __post_init__(self):
+        _check_factory_args(self.dimensions, self.embedder)
+
     def build_inner_index(self, data_column, metadata_column=None):
         return USearchKnn(
             data_column,
@@ -233,14 +256,21 @@ class UsearchKnnFactory(InnerIndexFactory):
 
 @dataclass(kw_only=True)
 class LshKnnFactory(InnerIndexFactory):
-    dimensions: int
+    dimensions: int | None = None
     n_or: int = 20
     n_and: int = 10
     bucket_length: float = 10.0
     distance_type: str = "euclidean"
     embedder: Any = None
 
+    def __post_init__(self):
+        _check_factory_args(self.dimensions, self.embedder)
+
     def build_inner_index(self, data_column, metadata_column=None):
+        if self.dimensions is None:
+            # LSH needs projection dimensionality up front; probe the
+            # embedder (dense indexes infer it lazily instead)
+            self.dimensions = _probe_dimensions(self.embedder)
         return LshKnn(
             data_column,
             metadata_column,

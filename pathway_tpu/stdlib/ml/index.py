@@ -50,9 +50,13 @@ class KNNIndex:
             out = []
             for s in scores:
                 if dt_kind == "cosine":
-                    out.append(1.0 - float(s))
+                    # scores are reference-style negative distances
+                    # (cos - 1), so distance = -score
+                    out.append(-float(s))
                 else:
-                    out.append(math.sqrt(max(0.0, -float(s))))
+                    # reference KNNIndex reports SQUARED euclidean
+                    # distances (stdlib/ml/index.py get_nearest_items)
+                    out.append(max(0.0, -float(s)))
             return tuple(out)
 
         return result.with_columns(

@@ -255,7 +255,8 @@ class VectorStoreServer:
                         {
                             "text": t,
                             "metadata": m.value if isinstance(m, Json) else m,
-                            "dist": 1.0 - float(s),
+                            # scores are negative distances (cos - 1)
+                            "dist": -float(s),
                         }
                     )
             return Json(out)

@@ -29,6 +29,9 @@ def _norm(v: Any) -> Any:
         return ("__ndarray__", v.dtype.kind, tuple(np.ravel(v).tolist()))
     if isinstance(v, float) and v != v:
         return "__nan__"
+    if isinstance(v, (list, tuple)):
+        # lists and tuples compare alike (and hash) in captured rows
+        return tuple(_norm(x) for x in v)
     return v
 
 

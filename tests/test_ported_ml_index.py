@@ -1,0 +1,746 @@
+"""Ported reference ml/index tests
+(reference: python/pathway/tests/ml/test_index.py) — KNNIndex (LSH) and the
+DataIndex family (LshKnn, USearchKnn-equivalent TPU dense index, BM25,
+hybrid): batch and streaming update-old vs as-of-now semantics, variable k,
+metadata filters (JMESPath-style), distances, full-text search, index
+factories, and exact cosine distances."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    DataIndex,
+    HybridIndexFactory,
+    LshKnnFactory,
+    TantivyBM25,
+    TantivyBM25Factory,
+    USearchMetricKind,
+    UsearchKnnFactory,
+    default_lsh_knn_document_index,
+)
+from pathway_tpu.stdlib.indexing.data_index import _SCORE
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnnMetricKind,
+    LshKnn,
+    USearchKnn,
+)
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+from tests.ref_utils import (
+    assert_table_equality_wo_index,
+    assert_table_equality_wo_index_types,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+class PointSchema(pw.Schema):
+    coords: Any
+    is_query: bool
+
+
+def sort_arrays(arrays) -> list[tuple[float, float]]:
+    if arrays is None:
+        return []
+    return sorted([tuple(array) for array in arrays])
+
+
+def get_points() -> list[tuple[tuple[float, ...], bool]]:
+    points = [
+        (2, 2, 0),
+        (3, -2, 0),
+        (0, 0, 1),
+        (-1, 0, 0),
+        (2, -2, 1),
+        (1, 2, 0),
+        (-1, 1, 1),
+        (-3, 1, 0),
+        (-2, -3, 1),
+        (1, -4, 0),
+    ]
+    return [(point[:-1], point[-1] == 1) for point in points]
+
+
+def to_tuple_of_floats(input: Iterable[Any]) -> tuple[float, ...]:
+    return tuple(float(x) for x in input)
+
+
+def nn_as_table(to_table) -> pw.Table:
+    return pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "coords": [
+                    to_tuple_of_floats(point[0]) for point in to_table
+                ],
+                "nn": [
+                    tuple(to_tuple_of_floats(x) for x in point[1])
+                    for point in to_table
+                ],
+            }
+        )
+    )
+
+
+def nn_with_dists_as_table(to_table) -> pw.Table:
+    return pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "coords": [
+                    to_tuple_of_floats(point[0]) for point in to_table
+                ],
+                "dist": [to_tuple_of_floats(point[2]) for point in to_table],
+                "nn": [
+                    tuple(to_tuple_of_floats(x) for x in point[1])
+                    for point in to_table
+                ],
+            }
+        )
+    )
+
+
+def make_usearch_data_index(
+    data_column: pw.ColumnReference,
+    data_table: pw.Table,
+    dimensions: int,
+    *,
+    embedder: Any = None,
+    metadata_column: Any = None,
+):
+    inner_index = USearchKnn(
+        data_column=data_column,
+        metadata_column=metadata_column,
+        dimensions=dimensions,
+        reserved_space=1000,
+        metric=USearchMetricKind.L2SQ,
+        embedder=embedder,
+    )
+
+    return DataIndex(
+        data_table=data_table,
+        inner_index=inner_index,
+    )
+
+
+def test_all_at_once():
+    data = get_points()
+    df = pd.DataFrame(
+        {
+            "coords": [to_tuple_of_floats(point[0]) for point in data],
+            "is_query": [point[1] for point in data],
+        }
+    )
+    table = pw.debug.table_from_pandas(df)
+    points = table.filter(~pw.this.is_query).without(pw.this.is_query)
+    queries = table.filter(pw.this.is_query).without(pw.this.is_query)
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries + index.get_nearest_items(queries.coords, k=2).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+
+    knn_lsh_index = LshKnn(points.coords, None, dimensions=2, n_and=5)
+    index2 = DataIndex(points, knn_lsh_index)
+
+    queries = queries.with_columns(k=2)
+    result2 = index2.query(
+        queries.coords, number_of_matches=queries.k
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    expected = nn_as_table(
+        [
+            ((0, 0), ((-1, 0), (1, 2))),
+            ((2, -2), ((1, -4), (3, -2))),
+            ((-1, 1), ((-3, 1), (-1, 0))),
+            ((-2, -3), ((-1, 0), (1, -4))),
+        ]
+    )
+
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+
+
+def test_all_at_once_metadata_filter():
+    data = get_points()
+
+    class InputSchema(pw.Schema):
+        coords: tuple[float, float]
+        is_query: bool
+        metadata: pw.Json
+
+    df = pd.DataFrame(
+        {
+            "coords": [to_tuple_of_floats(point[0]) for point in data],
+            "is_query": [point[1] for point in data],
+            "metadata": [{"foo": i} for i, _ in enumerate(data)],
+        }
+    )
+    table = pw.debug.table_from_pandas(df, schema=InputSchema)
+    points = table.filter(~pw.this.is_query).without(pw.this.is_query)
+    queries = table.filter(pw.this.is_query).without(
+        pw.this.is_query, pw.this.metadata
+    )
+    index = KNNIndex(
+        points.coords,
+        points,
+        n_dimensions=2,
+        n_and=5,
+        metadata=points.metadata,
+    )
+    queries += queries.select(metadata_filter="foo > `4`")
+    result = queries.without(
+        pw.this.metadata_filter
+    ) + index.get_nearest_items(
+        queries.coords, k=2, metadata_filter=queries.metadata_filter
+    ).select(
+        nn=pw.apply(sort_arrays, pw.this.coords),
+    )
+
+    knn_lsh_index = LshKnn(
+        points.coords,
+        points.metadata,
+        dimensions=2,
+        n_and=5,
+    )
+    index2 = DataIndex(points, knn_lsh_index)
+    queries = queries.with_columns(k=2)
+    result2 = index2.query(
+        queries.coords,
+        number_of_matches=queries.k,
+        metadata_filter=queries.metadata_filter,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    expected = nn_as_table(
+        [
+            ((0, 0), ((-3, 1), (1, 2))),
+            ((2, -2), ((1, -4), (1, 2))),
+            ((-1, 1), ((-3, 1), (1, 2))),
+            ((-2, -3), ((-3, 1), (1, -4))),
+        ]
+    )
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+
+
+def stream_points(with_k: bool = False) -> tuple[pw.Table, pw.Table]:
+    points = (
+        T(
+            """
+         x |  y | __time__
+         2 |  2 |     2
+         3 | -2 |     4
+        -1 |  0 |     8
+         1 |  2 |    12
+        -3 |  1 |    16
+         1 | -4 |    20
+    """
+        )
+        .with_columns(
+            x=pw.cast(float, pw.this.x), y=pw.cast(float, pw.this.y)
+        )
+        .select(coords=pw.make_tuple(pw.this.x, pw.this.y))
+    )
+    queries = (
+        T(
+            """
+         x |  y | k | __time__
+         0 |  0 | 1 |     6
+         2 | -2 | 2 |    10
+        -1 |  1 | 3 |    14
+        -2 | -3 | 0 |    18
+    """
+        )
+        .with_columns(
+            x=pw.cast(float, pw.this.x), y=pw.cast(float, pw.this.y)
+        )
+        .select(coords=pw.make_tuple(pw.this.x, pw.this.y), k=pw.this.k)
+    )
+    if not with_k:
+        queries = queries.without(pw.this.k)
+    return points, queries
+
+
+def test_update_old():
+    points, queries = stream_points()
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries + index.get_nearest_items(queries.coords, k=2).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+    expected = nn_as_table(
+        [
+            ((0, 0), ((-1, 0), (1, 2))),
+            ((2, -2), ((1, -4), (3, -2))),
+            ((-1, 1), ((-3, 1), (-1, 0))),
+            ((-2, -3), ((-1, 0), (1, -4))),
+        ]
+    )
+
+    knn_lsh_index = LshKnn(
+        points.coords,
+        metadata_column=None,
+        dimensions=2,
+        n_and=5,
+    )
+    index2 = DataIndex(points, knn_lsh_index)
+    queries = queries.with_columns(k=2)
+    result2 = index2.query(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    from pathway_tpu.stdlib.indexing import HybridIndex
+
+    index3 = DataIndex(points, HybridIndex([knn_lsh_index, knn_lsh_index]))
+    result3 = index3.query(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+    assert_table_equality_wo_index(result3, expected)
+
+
+def test_asof_now():
+    points, queries = stream_points()
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries + index.get_nearest_items_asof_now(
+        queries.coords, k=2
+    ).select(nn=pw.apply(sort_arrays, pw.this.coords))
+    expected = nn_as_table(
+        [
+            ((0, 0), ((2, 2), (3, -2))),
+            ((2, -2), ((-1, 0), (3, -2))),
+            ((-1, 1), ((-1, 0), (1, 2))),
+            ((-2, -3), ((-3, 1), (-1, 0))),
+        ]
+    )
+
+    knn_lsh_index = LshKnn(
+        points.coords,
+        metadata_column=None,
+        dimensions=2,
+        n_and=5,
+    )
+    index2 = DataIndex(points, knn_lsh_index)
+
+    index3 = make_usearch_data_index(
+        points.coords, data_table=points, dimensions=2, metadata_column=None
+    )
+
+    result2 = index2.query_as_of_now(
+        queries.coords,
+        number_of_matches=2,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    result3 = index3.query_as_of_now(
+        queries.coords,
+        number_of_matches=2,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+    assert_table_equality_wo_index(result3, expected)
+
+
+def test_update_old_with_variable_k():
+    points, queries = stream_points(with_k=True)
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries.without(pw.this.k) + index.get_nearest_items(
+        queries.coords, queries.k
+    ).with_universe_of(queries).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+    expected = nn_as_table(
+        [
+            ((0, 0), ((-1, 0),)),
+            ((2, -2), ((1, -4), (3, -2))),
+            ((-1, 1), ((-3, 1), (-1, 0), (1, 2))),
+            ((-2, -3), ()),
+        ]
+    )
+
+    knn_lsh_index = LshKnn(
+        points.coords,
+        None,
+        dimensions=2,
+        n_and=5,
+    )
+    index2 = DataIndex(points, knn_lsh_index)
+    result2 = index2.query(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+
+
+def test_asof_now_with_variable_k():
+    points, queries = stream_points(with_k=True)
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries.without(pw.this.k) + index.get_nearest_items_asof_now(
+        queries.coords, queries.k
+    ).select(nn=pw.apply(sort_arrays, pw.this.coords))
+    expected = nn_as_table(
+        [
+            ((0, 0), ((2, 2),)),
+            ((2, -2), ((-1, 0), (3, -2))),
+            ((-1, 1), ((-1, 0), (1, 2), (2, 2))),
+            ((-2, -3), ()),
+        ]
+    )
+    knn_lsh_index = LshKnn(
+        points.coords,
+        metadata_column=None,
+        dimensions=2,
+        n_and=5,
+    )
+    index2 = DataIndex(points, knn_lsh_index)
+    result2 = index2.query_as_of_now(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    index3 = make_usearch_data_index(
+        points.coords, data_table=points, dimensions=2, metadata_column=None
+    )
+    result3 = index3.query_as_of_now(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(coords=pw.left.coords, nn=pw.apply(sort_arrays, pw.right.coords))
+
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result2, expected)
+    assert_table_equality_wo_index(result3, expected)
+
+
+def test_get_distances():
+    data = get_points()
+    df = pd.DataFrame(
+        {
+            "coords": [to_tuple_of_floats(point[0]) for point in data],
+            "is_query": [point[1] for point in data],
+        }
+    )
+    table = pw.debug.table_from_pandas(df)
+    points = table.filter(~pw.this.is_query).without(pw.this.is_query)
+    queries = table.filter(pw.this.is_query).without(pw.this.is_query)
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries + index.get_nearest_items(
+        queries.coords, k=2, with_distances=True
+    ).select(
+        pw.this.dist,
+        nn=pw.this.coords,
+    )
+
+    expected = nn_with_dists_as_table(
+        [
+            ((0, 0), ((-1, 0), (1, 2)), (1, 5)),
+            ((2, -2), ((3, -2), (1, -4)), (1, 5)),
+            ((-1, 1), ((-1, 0), (-3, 1)), (1, 4)),
+            ((-2, -3), ((1, -4), (-1, 0)), (10, 10)),
+        ]
+    )
+    assert_table_equality_wo_index_types(result, expected)
+
+    knn_lsh_index = LshKnn(
+        points.coords,
+        metadata_column=None,
+        dimensions=2,
+        n_and=5,
+    )
+
+    @pw.udf
+    def negate_tuple(t):
+        return tuple(-x for x in t)
+
+    index2 = DataIndex(points, knn_lsh_index)
+    queries = queries.with_columns(k=2)
+    result2 = index2.query(
+        queries.coords,
+        number_of_matches=queries.k,
+    ).select(
+        coords=pw.left.coords,
+        dist=negate_tuple(pw.right[_SCORE]),
+        nn=pw.right.coords,
+    )
+
+    assert_table_equality_wo_index_types(result2, expected)
+
+
+def test_full_text_search():
+    index_data = pw.debug.table_from_markdown(
+        """
+        index_text                                                          | extra_info| __time__
+        Lorem ipsum dolor sit amet, consectetur adipiscing elit.            | 1         |     2
+        Cras ex lorem, luctus nec dui eu, pellentesque vestibulum velit.    | 2         |     2
+        Nunc laoreet tortor quis odio mattis vulputate.                     | 3         |     2
+        Quisque vel dictum neque, at efficitur nisi.                        | 4         |     2
+        Aliquam dui nibh, cursus ac porttitor nec, placerat quis nisi.      | 5         |     2
+        Curabitur vehicula enim vitae rhoncus feugiat.                      | 6         |     2
+        """,
+        split_on_whitespace=False,
+    )
+
+    queries = pw.debug.table_from_markdown(
+        """
+        query_text | __time__
+        nisi       | 2
+        elit       | 2
+        lorem      | 2
+        marchewka  | 2
+        """,
+        split_on_whitespace=False,
+    )
+
+    index = TantivyBM25(index_data.index_text, metadata_column=None)
+    data_index = DataIndex(index_data, index)
+    ret = data_index.query_as_of_now(
+        query_column=queries.query_text, number_of_matches=4
+    ).select(qtext=pw.left.query_text, info=pw.right.extra_info)
+
+    class ExpSchema(pw.Schema):
+        qtext: str
+        info: list[int]
+
+    df = pd.DataFrame(
+        {
+            "qtext": ["elit", "lorem", "marchewka", "nisi"],
+            "info": [(1,), (1, 2), (), (4, 5)],
+        },
+    )
+    expected = pw.debug.table_from_pandas(df, schema=ExpSchema)
+    assert_table_equality_wo_index(ret, expected)
+
+
+def test_output_joined_with_other_columns():
+    @pw.udf
+    def embedder(x: str) -> list[float]:
+        return [0.0, 1.0, 2.0]
+
+    @pw.udf
+    def sort_docs(x: list[str]) -> list[str]:
+        return sorted(x)
+
+    query = pw.debug.table_from_rows(
+        pw.schema_from_types(query=str), [("a",)]
+    )
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(doc=str), [("a",), ("b",), ("c",)]
+    )
+    index = default_lsh_knn_document_index(
+        docs.doc, docs, dimensions=3, embedder=embedder
+    )
+    res = query + index.query(query.query, collapse_rows=True).select(
+        doc=sort_docs(pw.right.doc)
+    )
+    expected = pw.debug.table_from_pandas(
+        pd.DataFrame({"query": ["a"], "doc": [("a", "b", "c")]})
+    )
+    assert_table_equality_wo_index(res.update_types(doc=list[str]), expected)
+
+
+def test_no_match_is_empty_list():
+    @pw.udf
+    def make_point(r: int) -> list[float]:
+        return [float(r), float(r)]
+
+    @pw.udf
+    def load_json(s: str) -> pw.Json:
+        return json.loads(s)
+
+    data = pw.debug.table_from_markdown(
+        """
+        r | filter_data
+        1 | {"v":2}
+        5 | {"v":1}
+        8 | {"v":1}
+    """
+    ).with_columns(
+        d=make_point(pw.this.r), filter_data=load_json(pw.this.filter_data)
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        r | filter_expr
+        4 | v==`1`
+        6 | v==`3`
+    """
+    ).with_columns(d=make_point(pw.this.r))
+    index = make_usearch_data_index(
+        data.d, data, dimensions=2, metadata_column=data.filter_data
+    )
+    result = index.query_as_of_now(
+        queries.d,
+        number_of_matches=2,
+        collapse_rows=True,
+        metadata_filter=queries.filter_expr,
+    ).select(l=pw.left.r, r=pw.right.r)
+    expected = pw.debug.table_from_pandas(
+        pd.DataFrame({"l": [4, 6], "r": [[5, 8], []]})
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+@pw.udf
+def fake_embedder(x: str) -> list[float]:
+    return [0.0, 1.0, float(ord(x[0])) / 5.0]
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        UsearchKnnFactory(
+            dimensions=3,
+            reserved_space=3,
+            embedder=fake_embedder,
+            metric=USearchMetricKind.COS,
+        ),
+        LshKnnFactory(dimensions=3, embedder=fake_embedder),
+        BruteForceKnnFactory(
+            dimensions=3,
+            reserved_space=3,
+            metric=BruteForceKnnMetricKind.COS,
+            embedder=fake_embedder,
+        ),
+        UsearchKnnFactory(  # without dimensions
+            reserved_space=3,
+            embedder=fake_embedder,
+            metric=USearchMetricKind.COS,
+        ),
+        LshKnnFactory(embedder=fake_embedder),
+        BruteForceKnnFactory(
+            reserved_space=3,
+            metric=BruteForceKnnMetricKind.COS,
+            embedder=fake_embedder,
+        ),
+        UsearchKnnFactory(  # without optional params
+            embedder=fake_embedder,
+        ),
+        TantivyBM25Factory(),
+        HybridIndexFactory(
+            [
+                TantivyBM25Factory(),
+                UsearchKnnFactory(
+                    dimensions=3,
+                    reserved_space=3,
+                    embedder=fake_embedder,
+                    metric=USearchMetricKind.COS,
+                ),
+            ]
+        ),
+    ],
+)
+def test_index_factory(factory):
+    query = pw.debug.table_from_rows(
+        pw.schema_from_types(query=str), [("a",)]
+    )
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(doc=str), [("a",), ("b",), ("c",)]
+    )
+
+    index = factory.build_index(docs.doc, docs)
+    res = query + index.query_as_of_now(
+        query.query, collapse_rows=True, number_of_matches=1
+    ).select(pw.right.doc)
+    expected = pw.debug.table_from_pandas(
+        pd.DataFrame({"query": ["a"], "doc": [("a",)]})
+    )
+    assert_table_equality_wo_index(res.update_types(doc=list[str]), expected)
+
+
+def test_usearch_distances():
+    @pw.udf
+    def fake_embedder(x: str) -> list[float]:
+        if x == "a":
+            return [1, 1, 1]
+        elif x == "b":
+            return [1, 1, 2]
+        elif x == "c":
+            return [1, 2, 2]
+        else:
+            return [1, 3, 1]
+
+    factory = UsearchKnnFactory(
+        embedder=fake_embedder,
+    )
+
+    query = pw.debug.table_from_rows(
+        pw.schema_from_types(query=str), [("a",)]
+    )
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(doc=str), [("b",), ("c",), ("d",)]
+    )
+
+    index = factory.build_index(docs.doc, docs)
+    res = (
+        index.query_as_of_now(
+            query.query, collapse_rows=False, number_of_matches=3
+        )
+        .select(
+            pw.right.doc,
+            distance=-pw.unwrap(pw.right[_SCORE]),
+        )
+        .with_id_from(pw.this.doc)
+        .select(pw.this.distance)
+    )
+
+    df = pw.debug.table_to_pandas(res).sort_index()
+    expected_df = pw.debug.table_to_pandas(
+        pw.debug.table_from_markdown(
+            """
+        doc | distance
+         b  | 0.05719095841793642
+         c  | 0.037749551350623634
+         d  | 0.12961172022151068
+    """
+        )
+        .with_id_from(pw.this.doc)
+        .select(pw.this.distance)
+    ).sort_index()
+    assert np.isclose(
+        df.to_numpy(), expected_df.to_numpy(), rtol=1e-5, atol=0.0
+    ).all()
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [UsearchKnnFactory, LshKnnFactory, BruteForceKnnFactory],
+)
+def test_knn_index_factory_init(factory):
+    index = factory(
+        dimensions=3,
+        embedder=None,
+    )
+    index = factory(dimensions=3)
+    index = factory(
+        embedder=fake_embedder,
+    )
+    assert index is not None
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [UsearchKnnFactory, LshKnnFactory, BruteForceKnnFactory],
+)
+def test_knn_index_factory_creation_error(factory):
+    with pytest.raises(
+        ValueError,
+        match="Either `dimensions` or `embedder` must be provided to index factory.",
+    ):
+        factory(
+            dimensions=None,
+            embedder=None,
+        )
