@@ -95,11 +95,12 @@ def _dtype_for(values: list[Any]) -> dt.DType:
     return out
 
 
-def _split_markdown(table_def: str):
+def _split_markdown(table_def: str, require_pipes: bool = False):
     """Shared markdown tokenizer: (header, data_rows, raw_ids|None) —
     separator-row filtering, escaped-pipe splitting, edge-cell stripping
     and leading-id-column detection used by table_from_markdown and
-    StreamGenerator.table_from_markdown."""
+    StreamGenerator.table_from_markdown. ``require_pipes`` rejects
+    whitespace-split fallback (split_on_whitespace=False semantics)."""
     lines = [l for l in table_def.strip().splitlines() if l.strip()]
     # separator rows (|---|:--|) need a dash: a dashless all-empty row
     # like "   |   " is DATA — a row of Nones (reference semantics)
@@ -121,6 +122,10 @@ def _split_markdown(table_def: str):
         data = split[1:]
         has_id_col = header[0] in ("", "id")
     else:
+        if require_pipes:
+            raise ValueError(
+                "split_on_whitespace=False requires a pipe-delimited table"
+            )
         header = lines[0].split()
         if len(header) == 1:
             # single unnamed column: whole line is the value (strings with
@@ -150,11 +155,9 @@ def table_from_markdown(
     (logical time), ``__diff__`` (+1/-1). ``split_on_whitespace=False``
     requires pipe delimiters (cells may contain spaces); the default
     auto-detects."""
-    if split_on_whitespace is False and "|" not in table_def:
-        raise ValueError(
-            "split_on_whitespace=False requires a pipe-delimited table"
-        )
-    header, data, ids = _split_markdown(table_def)
+    header, data, ids = _split_markdown(
+        table_def, require_pipes=split_on_whitespace is False
+    )
     col_names = [h for h in header if h not in ("__time__", "__diff__")]
     time_idx = header.index("__time__") if "__time__" in header else None
     diff_idx = header.index("__diff__") if "__diff__" in header else None

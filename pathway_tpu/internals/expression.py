@@ -144,6 +144,16 @@ class ColumnExpression:
             "use & | ~ instead of and/or/not."
         )
 
+    def __repr__(self) -> str:
+        from pathway_tpu.internals.expression_printer import (
+            get_expression_info,
+        )
+
+        try:
+            return get_expression_info(self)
+        except Exception:
+            return object.__repr__(self)
+
     # --- accessors -----------------------------------------------------------
 
     def __getitem__(self, item) -> "ColumnExpression":
@@ -264,8 +274,6 @@ class ColumnReference(ColumnExpression):
         result = mapping(self)
         return result if result is not None else self
 
-    def __repr__(self):
-        return f"<{self._table!r}>.{self._name}"
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -308,8 +316,6 @@ class ColumnBinaryOpExpression(ColumnExpression):
     def _rebuild(self, children):
         return ColumnBinaryOpExpression(self._op, children[0], children[1])
 
-    def __repr__(self):
-        return f"({self._left!r} {self._op} {self._right!r})"
 
 
 class ColumnUnaryOpExpression(ColumnExpression):
@@ -324,8 +330,6 @@ class ColumnUnaryOpExpression(ColumnExpression):
     def _rebuild(self, children):
         return ColumnUnaryOpExpression(self._op, children[0])
 
-    def __repr__(self):
-        return f"({self._op}{self._expr!r})"
 
 
 class ReducerExpression(ColumnExpression):
@@ -346,8 +350,6 @@ class ReducerExpression(ColumnExpression):
         kwargs = dict(zip(self._kwargs.keys(), children[n:]))
         return ReducerExpression(self._reducer, *args, **kwargs)
 
-    def __repr__(self):
-        return f"pathway.reducers.{self._reducer.name}({', '.join(map(repr, self._args))})"
 
 
 class ApplyExpression(ColumnExpression):
@@ -389,8 +391,6 @@ class ApplyExpression(ColumnExpression):
             max_batch_size=self._max_batch_size,
         )
 
-    def __repr__(self):
-        return f"pathway.apply({getattr(self._fn, '__name__', self._fn)!r}, ...)"
 
 
 class BatchApplyExpression(ApplyExpression):
@@ -420,8 +420,6 @@ class CastExpression(ColumnExpression):
     def _rebuild(self, children):
         return CastExpression(self._target, children[0])
 
-    def __repr__(self):
-        return f"pathway.cast({self._target}, {self._expr!r})"
 
 
 class ConvertExpression(ColumnExpression):
@@ -466,8 +464,6 @@ class IfElseExpression(ColumnExpression):
     def _rebuild(self, children):
         return IfElseExpression(*children)
 
-    def __repr__(self):
-        return f"pathway.if_else({self._if!r}, {self._then!r}, {self._else!r})"
 
 
 class CoalesceExpression(ColumnExpression):
@@ -663,5 +659,3 @@ class MethodCallExpression(ColumnExpression):
             propagate_none=self._propagate_none,
         )
 
-    def __repr__(self):
-        return f"({self._args[0]!r}).{self._name}(...)"

@@ -79,6 +79,21 @@ class SchemaMetaclass(type):
     __columns__: dict[str, ColumnSchema]
     __append_only__: bool
 
+    def __eq__(cls, other: object) -> bool:
+        # schemas are equal when their column names, dtypes and primary
+        # keys agree (reference: Schema equality is structural)
+        if not isinstance(other, SchemaMetaclass):
+            return NotImplemented
+        return [
+            (n, c.dtype, c.primary_key) for n, c in cls.__columns__.items()
+        ] == [
+            (n, c.dtype, c.primary_key)
+            for n, c in other.__columns__.items()
+        ]
+
+    def __hash__(cls) -> int:
+        return hash(tuple(cls.__columns__.keys()))
+
     def __init__(cls, name, bases, namespace, append_only: bool | None = None):
         super().__init__(name, bases, namespace)
         columns: dict[str, ColumnSchema] = {}

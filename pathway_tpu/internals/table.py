@@ -29,6 +29,24 @@ from pathway_tpu.internals.thisclass import ThisPlaceholder, ThisSlice, this
 from pathway_tpu.internals.universe import Universe
 
 
+class _ColumnNamespace:
+    """Attribute/item access that always resolves to columns."""
+
+    def __init__(self, owner: Any):
+        object.__setattr__(self, "_owner", owner)
+
+    def __getattr__(self, name: str):
+        try:
+            return self._owner[name]
+        except KeyError:
+            # __getattr__ must raise AttributeError so hasattr/getattr
+            # defaults and attribute probes (pickle, IPython) fall through
+            raise AttributeError(name) from None
+
+    def __getitem__(self, name: str):
+        return self._owner[name]
+
+
 class TableLike:
     _universe: Universe
 
@@ -370,8 +388,11 @@ class Table(Joinable):
         return self.column_names()
 
     @property
-    def C(self) -> "Table":
-        return self
+    def C(self) -> "_ColumnNamespace":
+        """Column-only access namespace: ``t.C.select`` is the COLUMN named
+        'select' even though the table has a method of that name
+        (reference: Table.C / test_colnamespace.py)."""
+        return _ColumnNamespace(self)
 
     def typehints(self) -> dict[str, Any]:
         return self._schema.typehints()
@@ -734,10 +755,13 @@ class Table(Joinable):
         return self.update_cells(other)
 
     def __add__(self, other: "Table") -> "Table":
-        """Column union of two same-universe tables: C.columns =
+        """Column union of two tables over the same rows: C.columns =
         self.columns + other.columns, C.id = self.id (reference:
-        Table.__add__, internals/table.py:424). Overlapping names are
-        allowed only when both sides name THE SAME column."""
+        Table.__add__, internals/table.py:424). Column names must be
+        disjoint. Universe agreement is the caller's contract — this build
+        does not prove universe equality (no universe solver here), so
+        mixing tables over different row sets yields missing cells rather
+        than a build-time error."""
         exprs: dict[str, Any] = {n: self[n] for n in self.column_names()}
         for n in other.column_names():
             if n in exprs and other is not self:

@@ -14,6 +14,12 @@ class ThisPlaceholder:
     def __init__(self, kind: str):
         self._kind = kind
 
+    @property
+    def C(self):
+        from pathway_tpu.internals.table import _ColumnNamespace
+
+        return _ColumnNamespace(self)
+
     def __getattr__(self, name: str) -> ColumnReference:
         if name.startswith("__") and name.endswith("__"):
             raise AttributeError(name)

@@ -192,10 +192,20 @@ class TpuKnnFactory(InnerIndexFactory):
 
 
 def _probe_dimensions(embedder) -> int:
-    """Dimensionality of an embedder by invoking its wrapped function on a
-    sample input (reference factories defer dimensions to the embedder)."""
+    """Dimensionality of an embedder (reference factories defer dimensions
+    to the embedder). API embedders expose get_embedding_dimension()
+    (which handles async _embed); plain functions/UDF wrappers are invoked
+    on a sample input, awaiting coroutines."""
+    getter = getattr(embedder, "get_embedding_dimension", None)
+    if callable(getter):
+        return int(getter())
     fn = getattr(embedder, "__wrapped__", embedder)
-    return len(fn("."))
+    out = fn(".")
+    if hasattr(out, "__await__"):
+        import asyncio
+
+        out = asyncio.run(out)
+    return len(out)
 
 
 def _check_factory_args(dimensions, embedder) -> None:

@@ -1,0 +1,265 @@
+"""Ported reference expression-repr / colnamespace / argtuple tests
+(reference: python/pathway/tests/test_expression_repr.py,
+test_colnamespace.py, test_argtuple.py) — the expression pretty-printer
+(<tableN> numbering), the .C column namespace over reserved names, and the
+ArgTuple multi-value return wrapper."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+from pathway_tpu.internals.arg_tuple import wrap_arg_tuple
+from pathway_tpu.internals.expression_printer import ExpressionFormatter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+# --- colnamespace ----------------------------------------------------------
+
+
+def test_namespace_1():
+    tab = pw.Table.empty(select=int)
+    assert isinstance(tab.C.select, pw.ColumnReference)
+
+
+def test_namespace_2():
+    tab = pw.Table.empty(select=int)
+    assert isinstance(tab.C["select"], pw.ColumnReference)
+
+
+def test_namespace_3():
+    tab = pw.Table.empty(C=int)
+    assert isinstance(tab.C.C, pw.ColumnReference)
+
+
+def test_namespace_4():
+    tab = pw.Table.empty(select=int)
+    tab2 = tab.select(pw.this.C.select)
+    assert tab.schema == tab2.schema
+
+
+def test_namespace_5():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this.C.C)
+    assert tab.schema == tab2.schema
+
+
+def test_namespace_6():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this.C["C"])
+    assert tab.schema == tab2.schema
+
+
+def test_namespace_7():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this["C"])
+    assert tab.schema == tab2.schema
+
+
+# --- arg tuple -------------------------------------------------------------
+
+
+def test_arg_tuple_wrapper_scalar():
+    result = wrap_arg_tuple(lambda: 1)()
+    assert result == 1
+
+
+def test_arg_tuple_wrapper_dict():
+    result = wrap_arg_tuple(lambda: {"a": 1, "b": 2})()
+    a, b = result
+    assert a == 1
+    assert b == 2
+    assert result.a == 1
+    assert result.b == 2
+    assert result["a"] == 1
+    assert result["b"] == 2
+
+
+def test_arg_tuple_wrapper_dict_with_one_element():
+    result = wrap_arg_tuple(lambda: {"a": 1})()
+    assert result.a == 1
+    assert result["a"] == 1
+
+
+def test_arg_tuple_wrapper_iterable():
+    result = wrap_arg_tuple(lambda: [1, 2])()
+    a, b = result
+    assert a == 1
+    assert b == 2
+    assert result["0"] == 1
+    assert result["1"] == 2
+
+
+def test_arg_tuple_wrapper_iterable_with_one_element():
+    result = wrap_arg_tuple(lambda: (1,))()
+    assert result == 1
+
+
+# --- expression repr -------------------------------------------------------
+
+
+def _pet_table():
+    return T(
+        """
+    pet  |  owner  | age
+     1   | Alice   | 10
+        """
+    )
+
+
+def test_column_reference():
+    t = _pet_table()
+    assert repr(t.pet) == "<table1>.pet"
+
+
+def test_column_binary_op():
+    t = _pet_table()
+    assert repr(t.pet + t.age) == "(<table1>.pet + <table1>.age)"
+    assert repr(t.pet - t.age) == "(<table1>.pet - <table1>.age)"
+    assert repr(t.pet * t.age) == "(<table1>.pet * <table1>.age)"
+    assert repr(t.pet / t.age) == "(<table1>.pet / <table1>.age)"
+    assert repr(t.pet // t.age) == "(<table1>.pet // <table1>.age)"
+    assert repr(t.pet**t.age) == "(<table1>.pet ** <table1>.age)"
+    assert repr(t.pet % t.age) == "(<table1>.pet % <table1>.age)"
+    assert repr(t.pet == t.age) == "(<table1>.pet == <table1>.age)"
+    assert repr(t.pet != t.age) == "(<table1>.pet != <table1>.age)"
+    assert repr(t.pet < t.age) == "(<table1>.pet < <table1>.age)"
+    assert repr(t.pet <= t.age) == "(<table1>.pet <= <table1>.age)"
+    assert repr(t.pet > t.age) == "(<table1>.pet > <table1>.age)"
+    assert repr(t.pet >= t.age) == "(<table1>.pet >= <table1>.age)"
+
+
+def test_2_args():
+    t = _pet_table()
+    tt = t.copy()
+    assert repr(t.pet + tt.age) == "(<table1>.pet + <table2>.age)"
+
+
+def test_3_args():
+    t = _pet_table()
+    tt = t.copy()
+    assert (
+        repr(pw.if_else(t.pet == 1, tt.pet, t.age))
+        == "pathway.if_else((<table1>.pet == 1), <table2>.pet, <table1>.age)"
+    )
+
+
+def test_column_unary_op():
+    t = _pet_table()
+    assert repr(-t.pet) == "(-<table1>.pet)"
+    assert repr(~t.pet) == "(~<table1>.pet)"
+
+
+def test_reducer():
+    t = _pet_table()
+    assert repr(pw.reducers.min(t.pet)) == "pathway.reducers.min(<table1>.pet)"
+    assert repr(pw.reducers.max(t.pet)) == "pathway.reducers.max(<table1>.pet)"
+    assert repr(pw.reducers.sum(t.pet)) == "pathway.reducers.sum(<table1>.pet)"
+    assert repr(pw.reducers.count()) == "pathway.reducers.count()"
+    assert (
+        repr(pw.reducers.argmin(t.pet))
+        == "pathway.reducers.argmin(<table1>.pet)"
+    )
+    assert (
+        repr(pw.reducers.argmax(t.pet))
+        == "pathway.reducers.argmax(<table1>.pet)"
+    )
+
+
+def test_apply():
+    t = _pet_table()
+    assert (
+        repr(pw.apply(lambda x, y: x + y, t.pet, t.age))
+        == "pathway.apply(<lambda>, <table1>.pet, <table1>.age)"
+    )
+
+
+def test_cast():
+    t = _pet_table()
+    assert repr(pw.cast(int, t.pet)) == "pathway.cast(INT, <table1>.pet)"
+    assert repr(pw.cast(float, t.pet)) == "pathway.cast(FLOAT, <table1>.pet)"
+
+
+def test_convert():
+    t = _pet_table()
+    assert repr(t.pet.as_int()) == "pathway.as_int(<table1>.pet)"
+    assert repr(t.pet.as_float()) == "pathway.as_float(<table1>.pet)"
+    assert repr(t.pet.as_str()) == "pathway.as_str(<table1>.pet)"
+    assert repr(t.pet.as_bool()) == "pathway.as_bool(<table1>.pet)"
+
+
+def test_declare_type():
+    t = _pet_table()
+    assert (
+        repr(pw.declare_type(int, t.pet))
+        == "pathway.declare_type(INT, <table1>.pet)"
+    )
+    assert (
+        repr(pw.declare_type(float, t.pet))
+        == "pathway.declare_type(FLOAT, <table1>.pet)"
+    )
+
+
+def test_coalesce():
+    t = _pet_table()
+    assert (
+        repr(pw.coalesce(t.pet, t.age))
+        == "pathway.coalesce(<table1>.pet, <table1>.age)"
+    )
+
+
+def test_require():
+    t = _pet_table()
+    assert (
+        repr(pw.require(t.pet, t.age))
+        == "pathway.require(<table1>.pet, <table1>.age)"
+    )
+
+
+def test_if_else():
+    t = _pet_table()
+    assert (
+        repr(pw.if_else(t.pet == 1, t.pet, t.age))
+        == "pathway.if_else((<table1>.pet == 1), <table1>.pet, <table1>.age)"
+    )
+
+
+def test_pointer():
+    t = _pet_table()
+    assert repr(t.pointer_from(4)) == "<table1>.pointer_from(4)"
+    assert (
+        repr(t.pointer_from(t.pet))
+        == "<table1>.pointer_from(<table1>.pet)"
+    )
+
+
+def test_method_call():
+    t = T(
+        """
+      | ts
+    1 | 1
+        """
+    ).select(ts=pw.this.ts.dt.from_timestamp(unit="s"))
+    assert repr(t.ts.dt.nanosecond()) == "(<table1>.ts).dt.nanosecond()"
+    assert repr(t.ts.dt.microsecond()) == "(<table1>.ts).dt.microsecond()"
+    assert repr(t.ts.dt.millisecond()) == "(<table1>.ts).dt.millisecond()"
+    assert repr(t.ts.dt.second()) == "(<table1>.ts).dt.second()"
+    assert repr(t.ts.dt.minute()) == "(<table1>.ts).dt.minute()"
+    assert repr(t.ts.dt.hour()) == "(<table1>.ts).dt.hour()"
+
+
+def test_formatter_table_infos():
+    t = _pet_table()
+    tt = t.copy()
+    fmt = ExpressionFormatter()
+    out = fmt.print_expression(t.pet + tt.age)
+    assert out == "(<table1>.pet + <table2>.age)"
+    infos = fmt.print_table_infos()
+    assert "<table1>=" in infos and "<table2>=" in infos
