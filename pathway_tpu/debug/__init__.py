@@ -102,6 +102,8 @@ def _split_markdown(table_def: str, require_pipes: bool = False):
     StreamGenerator.table_from_markdown. ``require_pipes`` rejects
     whitespace-split fallback (split_on_whitespace=False semantics)."""
     lines = [l for l in table_def.strip().splitlines() if l.strip()]
+    if not lines:
+        raise ValueError("table_from_markdown: empty table definition")
     # separator rows (|---|:--|) need a dash: a dashless all-empty row
     # like "   |   " is DATA — a row of Nones (reference semantics)
     lines = [

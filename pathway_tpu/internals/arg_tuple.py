@@ -32,6 +32,12 @@ class ArgTuple:
             return self._entries == other._entries
         return tuple(self) == other
 
+    def __hash__(self) -> int:
+        try:
+            return hash(tuple(self._entries.values()))
+        except TypeError:
+            return hash(tuple(self._entries.keys()))
+
     def __repr__(self) -> str:
         inner = ", ".join(f"{k}={v!r}" for k, v in self._entries.items())
         return f"ArgTuple({inner})"
@@ -62,7 +68,12 @@ class _Single(ArgTuple):
 
     def __eq__(self, other: object) -> bool:
         (v,) = list(self._entries.values())
-        return v == other or super().__eq__(other)
+        res = v == other
+        # == on array-like/expression values returns non-bools; only
+        # short-circuit on a genuine boolean result
+        if isinstance(res, bool) and res:
+            return True
+        return super().__eq__(other)
 
 
 def wrap_arg_tuple(fn: Callable) -> Callable:

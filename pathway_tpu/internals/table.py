@@ -36,11 +36,16 @@ class _ColumnNamespace:
         object.__setattr__(self, "_owner", owner)
 
     def __getattr__(self, name: str):
+        if name.startswith("__") or name.startswith("_ipython"):
+            # dunder/introspection probes (deepcopy, pickle, IPython) must
+            # fall through — a ThisPlaceholder owner would otherwise mint a
+            # ColumnReference for ANY name
+            raise AttributeError(name)
         try:
             return self._owner[name]
         except KeyError:
             # __getattr__ must raise AttributeError so hasattr/getattr
-            # defaults and attribute probes (pickle, IPython) fall through
+            # defaults work
             raise AttributeError(name) from None
 
     def __getitem__(self, name: str):

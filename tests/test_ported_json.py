@@ -1057,3 +1057,68 @@ def test_json_unpack_col_null(_type):
 
     with pytest.raises(ValueError, match="cannot unwrap if there is None value"):
         run_all()
+
+
+@pytest.mark.parametrize("delimiter", [",", ";", "\t"])
+def test_json_in_csv(tmp_path, delimiter: str):
+    # (reference: test_json.py test_json_in_csv) — csv cells typed as
+    # pw.Json parse as JSON values after csv unquoting
+    values = [
+        ('"{""a"": 1,""b"": ""foo"", ""c"": null, ""d"": [1,2,3]}"', dict),
+        ('"[1,2,3]"', list),
+        ("[]", list),
+        ("1", int),
+        ('"42"', int),
+        ("1.5", float),
+        ('""""""', str),
+        ('"""42"""', str),
+        ('"""foo"""', str),
+        ('"""true"""', str),
+        ("true", bool),
+        ('"false"', bool),
+        ("null", type(None)),
+    ]
+
+    if delimiter != ",":
+        values += [
+            ('{"field": 1, "b": "foo", "c": null, "d": [1,2,3]}', dict),
+            ("[1,2,3]", list),
+        ]
+
+    headers = [f"c{i}" for i in range(0, len(values))]
+    input_path = tmp_path / "input.csv"
+    input_path.write_text(
+        delimiter.join(headers)
+        + "\n"
+        + delimiter.join(v[0] for v in values)
+        + "\n"
+    )
+
+    schema = pw.schema_builder(
+        {name: pw.column_definition(dtype=pw.Json) for name in headers}
+    )
+    table = pw.io.csv.read(
+        input_path,
+        schema=schema,
+        mode="static",
+        csv_settings=pw.io.csv.CsvParserSettings(delimiter=delimiter),
+    )
+
+    @pw.udf
+    def assert_types(**kwargs) -> bool:
+        result = all(isinstance(arg, pw.Json) for arg in kwargs.values())
+        for v, t in zip(kwargs.values(), [v[1] for v in values]):
+            assert isinstance(v.value, t)
+        return result
+
+    result = table.select(ret=assert_types(**table))
+
+    assert_table_equality_wo_index(
+        T(
+            """
+                | ret
+            1   | True
+            """
+        ),
+        result,
+    )
