@@ -178,13 +178,21 @@ def table_from_markdown(
         d = int(parsed[diff_idx]) if diff_idx is not None else 1
         vals = tuple(parsed[i] for i in value_cols_idx)
         if ids is not None:
-            # hash the PARSED label ("1" -> int 1) so explicit markdown ids
-            # match pointer_from(<value>) — the reference's id derivation
-            key = int(ref_scalar(_parse_value(ids[ri])))
+            if unsafe_trusted_ids:
+                key = int(_parse_value(ids[ri]))
+            else:
+                # hash the PARSED label ("1" -> int 1) so explicit markdown
+                # ids match pointer_from(<value>) — the reference's id
+                # derivation
+                key = int(ref_scalar(_parse_value(ids[ri])))
         elif id_from:
             key = int(
                 ref_scalar(*[vals[col_names.index(c)] for c in id_from])
             )
+        elif unsafe_trusted_ids:
+            # trusted ids: the raw row number IS the key (reference:
+            # unsafe_make_pointer, ids_from_pandas:117-118)
+            key = counter
         else:
             # reference derivation: unkeyed debug rows key by row number
             # through the SAME pointer hash as pointer_from(i)

@@ -74,15 +74,39 @@ class SumAcc(Accumulator):
                 return
             v = 0
         if isinstance(v, np.ndarray):
-            if self.n == 0 and diff > 0:
-                self.s = v * diff
+            # keep a multiset and np.sum at read time: numpy's pairwise
+            # summation is the reference result (sequential += drifts,
+            # e.g. 1.1+4.1+7.1 != np.sum([...])); counts cancel on
+            # retraction so memory stays O(distinct arrays)
+            if not isinstance(self.s, dict):
+                # any scalar sum accumulated before the first array rides
+                # along and re-adds at read time
+                self.scalar_carry = self.s
+                self.s = {}
+            k = _hashable(v)
+            c = self.s.get(k, 0) + diff
+            if c == 0:
+                self.s.pop(k, None)
             else:
-                self.s = self.s + v * diff
+                self.s[k] = c
+        elif isinstance(self.s, dict):
+            self.scalar_carry = getattr(self, "scalar_carry", 0) + v * diff
         else:
             self.s = self.s + v * diff
         self.n += diff
 
     def value(self):
+        if isinstance(self.s, dict):
+            arrs = []
+            for k, c in self.s.items():
+                arrs.extend([_unhashable(k)] * max(c, 0))
+            carry = getattr(self, "scalar_carry", 0)
+            if not arrs:
+                return carry
+            total = np.sum(np.stack(arrs), axis=0)
+            if isinstance(carry, (int, float)) and carry == 0:
+                return total
+            return total + carry
         return self.s
 
 
@@ -223,7 +247,9 @@ class _KeyedMultisetAcc(Accumulator):
         v = args[0]
         if self.spec.skip_nones and v is None:
             return
-        k = (key, _hashable(v))
+        # the order key may itself be an ndarray (sort_by over an array
+        # column) — store its hashable, orderable form
+        k = (_hashable(key), _hashable(v))
         c = self.items.get(k, 0) + diff
         if c == 0:
             self.items.pop(k, None)
@@ -239,17 +265,31 @@ class _KeyedMultisetAcc(Accumulator):
 
 def _hashable(v: Any) -> Any:
     if isinstance(v, np.ndarray):
-        return ("__ndarray__", v.tobytes(), str(v.dtype), v.shape)
+        # value tuple FIRST after the tag so sorted() orders arrays by
+        # their contents (lexicographic), not by raw bytes
+        return (
+            "__ndarray__",
+            tuple(np.ravel(v).tolist()),
+            str(v.dtype),
+            v.shape,
+        )
     if isinstance(v, list):
-        return ("__tuple__", tuple(v))
+        return ("__tuple__", tuple(_hashable(x) for x in v))
+    if isinstance(v, tuple):
+        # sort tokens are (sort_value, key) tuples that may carry arrays
+        return tuple(_hashable(x) for x in v)
     return v
 
 
 def _unhashable(v: Any) -> Any:
     if isinstance(v, tuple) and len(v) == 4 and v[0] == "__ndarray__":
-        return np.frombuffer(v[1], dtype=np.dtype(v[2])).reshape(v[3])
+        return np.array(v[1], dtype=np.dtype(v[2])).reshape(v[3])
     if isinstance(v, tuple) and len(v) == 2 and v[0] == "__tuple__":
-        return v[1]
+        return tuple(_unhashable(x) for x in v[1])
+    if isinstance(v, tuple):
+        # plain tuples are encoded element-wise without a tag; decode any
+        # nested ndarray/list markers the same way
+        return tuple(_unhashable(x) for x in v)
     return v
 
 

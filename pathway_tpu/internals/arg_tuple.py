@@ -66,6 +66,13 @@ def _is_plain(v: Any) -> bool:
 class _Single(ArgTuple):
     """One named value: accessible by name AND equal to the bare value."""
 
+    def __hash__(self) -> int:
+        (v,) = list(self._entries.values())
+        try:
+            return hash(v)  # consistent with equality to the bare value
+        except TypeError:
+            return super().__hash__()
+
     def __eq__(self, other: object) -> bool:
         (v,) = list(self._entries.values())
         res = v == other

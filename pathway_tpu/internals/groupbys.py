@@ -82,6 +82,17 @@ class GroupedTable:
                     and g.name == ref.name
                 ):
                     return i
+            # same-universe sibling tables (t vs t.select(*pw.this)) may
+            # name the grouping column through either table (reference:
+            # universe-solver equivalence)
+            for i, g in enumerate(self._grouping):
+                if (
+                    isinstance(g, ColumnReference)
+                    and g.name == ref.name
+                    and getattr(ref.table, "_universe", None)
+                    is getattr(g.table, "_universe", object())
+                ):
+                    return i
             return None
 
         # --- build prep table: grouping cols + reducer args -------------------

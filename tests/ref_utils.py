@@ -26,7 +26,10 @@ def _norm(v: Any) -> Any:
     import numpy as np
 
     if isinstance(v, np.ndarray):
-        return ("__ndarray__", v.dtype.kind, tuple(np.ravel(v).tolist()))
+        # the reference hashes arrays by their DISPLAY string
+        # (make_value_hashable, tests/utils.py:302) — display rounding is
+        # part of the comparison semantics (12.2999999... == 12.3)
+        return ("__ndarray__", str(v.dtype), v.shape, str(v))
     if isinstance(v, float) and v != v:
         return "__nan__"
     if isinstance(v, (list, tuple)):
@@ -99,3 +102,38 @@ def assert_stream_equality_wo_index(t1, t2, **kwargs) -> None:
     c1 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s1)
     c2 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s2)
     assert c1 == c2, f"\nleft:  {sorted(c1.items(), key=str)}\nright: {sorted(c2.items(), key=str)}"
+
+
+def assert_stream_equality(t1, t2, **kwargs) -> None:
+    """Same multiset of (key, values, time, diff) updates
+    (reference: tests/utils.py assert_equal_streams)."""
+    from collections import Counter
+
+    streams: list[list] = [[], []]
+    for i, t in enumerate([t1, t2]):
+        names = list(t.column_names())
+
+        def on_change(
+            key, row, time, is_addition, _acc=streams[i], _names=names
+        ):
+            _acc.append(
+                (
+                    int(key),
+                    tuple(row[n] for n in _names),
+                    time,
+                    1 if is_addition else -1,
+                )
+            )
+
+        pw.io.subscribe(t, on_change)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
+    c1 = Counter(
+        (k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in streams[0]
+    )
+    c2 = Counter(
+        (k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in streams[1]
+    )
+    assert c1 == c2, (
+        f"\nleft:  {sorted(c1.items(), key=str)}"
+        f"\nright: {sorted(c2.items(), key=str)}"
+    )
