@@ -265,13 +265,25 @@ class _KeyedMultisetAcc(Accumulator):
 
 def _hashable(v: Any) -> Any:
     if isinstance(v, np.ndarray):
-        # value tuple FIRST after the tag so sorted() orders arrays by
-        # their contents (lexicographic), not by raw bytes
+        # order key FIRST after the tag so sorted() orders arrays by their
+        # contents; equality/hashing additionally uses the raw BYTES so
+        # NaN-holding arrays still cancel on retraction (nan != nan would
+        # otherwise split the multiset keys)
+        import math
+
+        flat = np.ravel(v).tolist()
+        if v.dtype.kind == "f":
+            order = tuple(
+                (1, 0.0) if math.isnan(x) else (0, float(x)) for x in flat
+            )
+        else:
+            order = tuple(flat)
         return (
             "__ndarray__",
-            tuple(np.ravel(v).tolist()),
+            order,
             str(v.dtype),
             v.shape,
+            v.tobytes(),
         )
     if isinstance(v, list):
         return ("__tuple__", tuple(_hashable(x) for x in v))
@@ -282,7 +294,12 @@ def _hashable(v: Any) -> Any:
 
 
 def _unhashable(v: Any) -> Any:
+    if isinstance(v, tuple) and len(v) == 5 and v[0] == "__ndarray__":
+        return (
+            np.frombuffer(v[4], dtype=np.dtype(v[2])).reshape(v[3]).copy()
+        )
     if isinstance(v, tuple) and len(v) == 4 and v[0] == "__ndarray__":
+        # older snapshot encoding (pre-bytes)
         return np.array(v[1], dtype=np.dtype(v[2])).reshape(v[3])
     if isinstance(v, tuple) and len(v) == 2 and v[0] == "__tuple__":
         return tuple(_unhashable(x) for x in v[1])

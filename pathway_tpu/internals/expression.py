@@ -144,6 +144,14 @@ class ColumnExpression:
             "use & | ~ instead of and/or/not."
         )
 
+    def __iter__(self):
+        # without this, star-unpacking an expression falls into the legacy
+        # iteration protocol over __getitem__ and loops forever building
+        # GetExpressions
+        raise TypeError(
+            f"{type(self).__name__} is not iterable"
+        )
+
     def __repr__(self) -> str:
         from pathway_tpu.internals.expression_printer import (
             get_expression_info,

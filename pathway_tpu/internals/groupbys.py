@@ -84,13 +84,37 @@ class GroupedTable:
                     return i
             # same-universe sibling tables (t vs t.select(*pw.this)) may
             # name the grouping column through either table (reference:
-            # universe-solver equivalence)
+            # universe-solver equivalence) — but only when both refs trace
+            # back to the SAME source column (a renamed sibling column,
+            # b.pet = a.owner, must not silently read the grouping key)
+            def origin(r: ColumnReference):
+                from pathway_tpu.engine.expression_eval import InternalColRef
+
+                node = getattr(r.table, "_node", None)
+                name = r.name
+                for _ in range(32):
+                    exprs = getattr(node, "exprs", None)
+                    if exprs is None:
+                        break
+                    inner = exprs.get(name)
+                    if isinstance(inner, InternalColRef):
+                        node = node.inputs[inner._input_index]
+                        name = inner._name
+                        continue
+                    if isinstance(inner, ColumnReference):
+                        node = getattr(inner.table, "_node", None)
+                        name = inner.name
+                        continue
+                    break
+                return (getattr(node, "id", None), name)
+
             for i, g in enumerate(self._grouping):
                 if (
                     isinstance(g, ColumnReference)
                     and g.name == ref.name
                     and getattr(ref.table, "_universe", None)
                     is getattr(g.table, "_universe", object())
+                    and origin(ref) == origin(g)
                 ):
                     return i
             return None

@@ -100,11 +100,19 @@ class _DeferredIxTable:
     select's context), enabling e.g. the singleton-broadcast pattern
     ``t.select(v=t.reduce(v=1).ix_ref().v)``."""
 
-    def __init__(self, source: "Table", args: tuple, optional: bool, instance):
+    def __init__(
+        self,
+        source: "Table",
+        args: tuple,
+        optional: bool,
+        instance,
+        raw_expr: bool = False,
+    ):
         self._source = source
         self._args = args
         self._optional = optional
         self._instance = instance
+        self._raw_expr = raw_expr  # args[0] IS the pointer expression
         self._cache: dict[int, "Table"] = {}
 
     def _materialize(self, caller: "Table") -> "Table":
@@ -113,20 +121,27 @@ class _DeferredIxTable:
             self._keepalive = getattr(self, "_keepalive", [])
             self._keepalive.append(caller)  # pin: id() reuse after GC
                                             # would alias a dead table
-            ptr = caller.pointer_from(
-                *[caller._desugar(a) for a in self._args],
-                instance=(
-                    caller._desugar(self._instance)
-                    if self._instance is not None
-                    else None
-                ),
-            )
+            if self._raw_expr:
+                ptr = caller._desugar(self._args[0])
+            else:
+                ptr = caller.pointer_from(
+                    *[caller._desugar(a) for a in self._args],
+                    instance=(
+                        caller._desugar(self._instance)
+                        if self._instance is not None
+                        else None
+                    ),
+                )
             self._cache[key] = self._source.ix(
                 ptr, optional=self._optional, context=caller
             )
         return self._cache[key]
 
-    def __getitem__(self, name: str) -> ColumnReference:
+    def __getitem__(self, name) -> Any:
+        if isinstance(name, (list, tuple)):
+            # column slice: a tuple of refs so select(*ix(...)[["a","b"]])
+            # unpacks (reference: ix(...)[[...]] usage)
+            return tuple(ColumnReference(self, n) for n in name)
         return ColumnReference(self, name)
 
     def __getattr__(self, name: str) -> ColumnReference:
@@ -827,7 +842,25 @@ class Table(Joinable):
         elif isinstance(e, PointerExpression) and isinstance(e._table, Table):
             indexer = e._table
         else:
-            raise ValueError("ix requires a column expression with a table")
+            wrapped = wrap_expr(e)
+            has_this = any(
+                isinstance(getattr(r, "table", None), ThisPlaceholder)
+                for r in wrapped._dependencies()
+            )
+            if not has_this:
+                raise ValueError(
+                    "ix requires a column expression with a table"
+                )
+            # pw.this-scoped pointer: defer to the CALLING operation's
+            # table, like ix_ref (reference: ix resolves in the select's
+            # context — t.select(x=other.ix(pw.this.ptr).col))
+            return _DeferredIxTable(
+                self,
+                (wrapped,),
+                optional or allow_misses,
+                None,
+                raw_expr=True,
+            )
         prep = indexer._build_rowwise({"_ptr": e})
         node = nodes.IxNode(
             prep._node, "_ptr", self._node, optional or allow_misses

@@ -75,8 +75,8 @@ def run_all(**kwargs) -> None:
 
 
 def _capture_streams(tables, **kwargs):
-    """Capture each table's full update stream [(vals, time, diff)] by
-    running the graph once with subscribers attached
+    """Capture each table's full update stream [(key, vals, time, diff)]
+    by running the graph once with subscribers attached
     (reference: GraphRunner.run_tables + CapturedStream)."""
     streams: list[list] = [[] for _ in tables]
 
@@ -85,7 +85,12 @@ def _capture_streams(tables, **kwargs):
 
         def on_change(key, row, time, is_addition, _acc=streams[i], _names=names):
             _acc.append(
-                (tuple(row[n] for n in _names), time, 1 if is_addition else -1)
+                (
+                    int(key),
+                    tuple(row[n] for n in _names),
+                    time,
+                    1 if is_addition else -1,
+                )
             )
 
         pw.io.subscribe(t, on_change)
@@ -99,8 +104,8 @@ def assert_stream_equality_wo_index(t1, t2, **kwargs) -> None:
     from collections import Counter
 
     s1, s2 = _capture_streams([t1, t2], **kwargs)
-    c1 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s1)
-    c2 = Counter((tuple(_norm(x) for x in v), t, d) for v, t, d in s2)
+    c1 = Counter((tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s1)
+    c2 = Counter((tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s2)
     assert c1 == c2, f"\nleft:  {sorted(c1.items(), key=str)}\nright: {sorted(c2.items(), key=str)}"
 
 
@@ -109,30 +114,9 @@ def assert_stream_equality(t1, t2, **kwargs) -> None:
     (reference: tests/utils.py assert_equal_streams)."""
     from collections import Counter
 
-    streams: list[list] = [[], []]
-    for i, t in enumerate([t1, t2]):
-        names = list(t.column_names())
-
-        def on_change(
-            key, row, time, is_addition, _acc=streams[i], _names=names
-        ):
-            _acc.append(
-                (
-                    int(key),
-                    tuple(row[n] for n in _names),
-                    time,
-                    1 if is_addition else -1,
-                )
-            )
-
-        pw.io.subscribe(t, on_change)
-    pw.run(monitoring_level=pw.MonitoringLevel.NONE, **kwargs)
-    c1 = Counter(
-        (k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in streams[0]
-    )
-    c2 = Counter(
-        (k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in streams[1]
-    )
+    s1, s2 = _capture_streams([t1, t2], **kwargs)
+    c1 = Counter((k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in s1)
+    c2 = Counter((k, tuple(_norm(x) for x in v), t, d) for k, v, t, d in s2)
     assert c1 == c2, (
         f"\nleft:  {sorted(c1.items(), key=str)}"
         f"\nright: {sorted(c2.items(), key=str)}"

@@ -1,0 +1,266 @@
+"""Ported reference ix tests
+(reference: python/pathway/tests/test_common.py ix section) — pointer-based
+row lookup: plain/optional ix, None pointers, missing keys raising at run,
+ix of columns holding None, self-ix, this-scoped ix with column slices, and
+prev/next pointers from sort feeding ix."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+
+from tests.ref_utils import assert_table_equality, run_all
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    from pathway_tpu.internals.errors import clear_errors
+
+    clear_errors()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_ix():
+    t_animals = T(
+        """
+            | genus      | epithet
+        1   | upupa      | epops
+        2   | acherontia | atropos
+        3   | bubo       | scandiacus
+        4   | dynastes   | hercules
+        """
+    )
+    t_birds = T(
+        """
+            | desc   | ptr
+        1   | hoopoe | 2
+        2   | owl    | 4
+        """
+    ).with_columns(ptr=t_animals.pointer_from(pw.this.ptr))
+    res = t_birds.select(latin=t_animals.ix(t_birds.ptr).genus)
+    expected = T(
+        """
+            | latin
+        1   | acherontia
+        2   | dynastes
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_ix_none():
+    t_animals = T(
+        """
+            | genus      | epithet
+        1   | upupa      | epops
+        2   | acherontia | atropos
+        3   | bubo       | scandiacus
+        4   | dynastes   | hercules
+        """
+    )
+    t_birds = T(
+        """
+            | desc   | ptr
+        1   | hoopoe | 2
+        2   | owl    | 4
+        3   | brbrb  |
+        """
+    ).with_columns(ptr=t_animals.pointer_from(pw.this.ptr, optional=True))
+    res = t_birds.select(
+        latin=t_animals.ix(t_birds.ptr, optional=True).genus
+    )
+    expected = T(
+        """
+            | latin
+        1   | acherontia
+        2   | dynastes
+        3   |
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_ix_this_getitem():
+    t_animals = T(
+        """
+            | genus      | epithet
+        1   | upupa      | epops
+        2   | acherontia | atropos
+        3   | bubo       | scandiacus
+        4   | dynastes   | hercules
+        """
+    )
+    t_birds = T(
+        """
+            | desc   | ptr
+        1   | hoopoe | 2
+        2   | owl    | 4
+        """
+    ).with_columns(ptr=t_animals.pointer_from(pw.this.ptr))
+    res = t_birds.select(*(t_animals.ix(pw.this.ptr)[["genus", "epithet"]]))
+    expected = T(
+        """
+            | genus         | epithet
+        1   | acherontia    | atropos
+        2   | dynastes      | hercules
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_ix_missing_key():
+    t_animals = T(
+        """
+            | genus      | epithet
+        1   | upupa      | epops
+        2   | acherontia | atropos
+        """
+    )
+    t_birds = T(
+        """
+            | desc   | ptr
+        1   | hoopoe | 1
+        2   | owl    | 3
+        """
+    ).with_columns(ptr=t_animals.pointer_from(pw.this.ptr))
+    t_birds.select(latin=t_animals.ix(t_birds.ptr).genus)
+    with pytest.raises(KeyError):
+        run_all()
+
+
+def test_ix_none_in_source():
+    t_animals = T(
+        """
+            | genus      | epithet
+        1   | upupa      | epops
+        2   | acherontia | atropos
+        3   | bubo       | scandiacus
+        4   |            | hercules
+        """
+    )
+    t_birds = T(
+        """
+            | desc   | ptr
+        1   | hoopoe | 2
+        2   | owl    | 4
+        """
+    ).with_columns(ptr=t_animals.pointer_from(pw.this.ptr))
+    res = t_birds.select(latin=t_animals.ix(t_birds.ptr).genus)
+    expected = T(
+        """
+            | latin
+        1   | acherontia
+        2   |
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_ix_no_select():
+    input = T(
+        """
+            | foo   | bar
+        1   | 1     | 4
+        2   | 1     | 5
+        3   | 2     | 6
+        """
+    ).with_columns(foo=pw.this.pointer_from(pw.this.foo))
+    result = input.ix(input.foo)[["bar"]]
+    assert_table_equality(
+        result,
+        T(
+            """
+                | bar
+            1   | 4
+            2   | 4
+            3   | 5
+            """
+        ),
+    )
+
+
+def test_ix_self_select():
+    input = T(
+        """
+            | foo   | bar
+        1   | 1     | 4
+        2   | 1     | 5
+        3   | 2     | 6
+        """
+    ).with_columns(foo=pw.this.pointer_from(pw.this.foo))
+    result = input.select(result=input.ix(pw.this.foo).bar)
+    assert_table_equality(
+        result,
+        T(
+            """
+                | result
+            1   | 4
+            2   | 4
+            3   | 5
+            """
+        ),
+    )
+
+
+def test_ix_sort_1():
+    data = T(
+        """
+        a | t
+        0 | 1
+        0 | 2
+        0 | 3
+        1 | 1
+        1 | 2
+    """
+    )
+    data_prev_next = data.sort(key=pw.this.t, instance=pw.this.a)
+    data_prev = data.ix(data_prev_next.prev, optional=True)
+    data_next = data.ix(data_prev_next.next, optional=True)
+    result = data.select(
+        pw.this.a, pw.this.t, prev_t=data_prev.t, next_t=data_next.t
+    )
+    expected = T(
+        """
+        a | t | prev_t | next_t
+        0 | 1 |        |    2
+        0 | 2 |    1   |    3
+        0 | 3 |    2   |
+        1 | 1 |        |    2
+        1 | 2 |    1   |
+    """
+    )
+    assert_table_equality(result, expected)
+
+
+def test_ix_sort_2():
+    data = T(
+        """
+        a | t
+        0 | 1
+        0 | 2
+        0 | 3
+        1 | 1
+        1 | 2
+    """
+    )
+    data += data.sort(key=pw.this.t, instance=pw.this.a)
+    data_prev = data.ix(data.prev, optional=True)
+    data_next = data.ix(data.next, optional=True)
+    result = data.select(
+        pw.this.a, pw.this.t, prev_t=data_prev.t, next_t=data_next.t
+    )
+    expected = T(
+        """
+        a | t | prev_t | next_t
+        0 | 1 |        |    2
+        0 | 2 |    1   |    3
+        0 | 3 |    2   |
+        1 | 1 |        |    2
+        1 | 2 |    1   |
+    """
+    )
+    assert_table_equality(result, expected)
