@@ -137,9 +137,19 @@ def _split_markdown(table_def: str, require_pipes: bool = False):
             data = [l.split() for l in lines[1:]]
         has_id_col = header[0] == "id"
     ids = None
+    if (
+        not has_id_col
+        and data
+        and all(len(r) == len(header) + 1 for r in data)
+    ):
+        # header without a leading pipe but data rows carrying one extra
+        # leading cell: that cell is the row id (reference T() accepts
+        # "col | on" headers over "1 | a | 11" rows)
+        has_id_col = True
     if has_id_col:
         # leading unnamed column = explicit row ids (reference style)
-        header = header[1:]
+        if header and header[0] in ("", "id"):
+            header = header[1:]
         ids = [r[0] for r in data]
         data = [r[1:] for r in data]
     return header, data, ids

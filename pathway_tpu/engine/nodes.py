@@ -739,7 +739,7 @@ class JoinExec(NodeExec):
                 # duplicate output id (id_from with non-unique matches) —
                 # reference raises a duplicate-id error; we poison + log
                 record_error(
-                    ValueError(
+                    KeyError(
                         "duplicate row id in join output (id= used with "
                         "non-unique matches)"
                     ),

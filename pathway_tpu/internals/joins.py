@@ -45,10 +45,24 @@ class JoinResult:
         mode: JoinMode,
         id_expr: Any = None,
     ):
+        if left is right:
+            raise ValueError(
+                "joining a table with itself; use <table>.copy() for "
+                "self-joins (reference: join_self)"
+            )
         self._left = left
         self._right = right
         self._mode = mode if isinstance(mode, JoinMode) else JoinMode(mode)
         self._id_expr = id_expr
+        if id_expr is not None and not (
+            isinstance(id_expr, ColumnReference)
+            and id_expr.name == "id"
+            and id_expr.table in (left, right, left_ph, right_ph)
+        ):
+            raise TypeError(
+                "join id= must be the id column of one side "
+                "(left.id or right.id)"
+            )
         self._left_on: list[ColumnExpression] = []
         self._right_on: list[ColumnExpression] = []
         for cond in on:
@@ -90,7 +104,12 @@ class JoinResult:
         a, b = cond._left, cond._right
         sa, sb = self._side_of(a), self._side_of(b)
         if sa == "r" or sb == "l":
-            a, b = b, a
+            # reference rejects swapped conditions outright: the left
+            # operand must come from the left table
+            raise ValueError(
+                "join condition sides are swapped: write "
+                "<left-col> == <right-col>"
+            )
         from pathway_tpu.internals.table import desugar
 
         l_e = desugar(a, {left_ph: self._left, this_ph: self._left})

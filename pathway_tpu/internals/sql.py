@@ -558,7 +558,11 @@ class _FromScope(_Scope):
                 return outer.col(name)
 
         cond = parser.parse_expr(_OnScope(), agg_ok=False)
-        jr = self.result_table.join(table, *_conjuncts(cond), how=how)
+        conds = [
+            _orient_join_condition(c, self.result_table, table)
+            for c in _conjuncts(cond)
+        ]
+        jr = self.result_table.join(table, *conds, how=how)
         # flatten: existing columns keep their flat names; new table's
         # columns get their names, prefixed on collision
         exprs: dict[str, Any] = {}
@@ -600,6 +604,33 @@ class _FromScope(_Scope):
         if qualifier is not None and (qualifier, name) in self._col_map:
             return self.result_table[self._col_map[(qualifier, name)]]
         return super().col(name, qualifier)
+
+
+def _orient_join_condition(cond, left_table, right_table):
+    """SQL places no order on equality operands (ON b.k = a.k is valid);
+    Table.join requires <left> == <right>, so flip swapped conjuncts."""
+    from pathway_tpu.internals.expression import (
+        ColumnBinaryOpExpression,
+        ColumnReference,
+    )
+
+    if not (
+        isinstance(cond, ColumnBinaryOpExpression) and cond._op == "=="
+    ):
+        return cond
+
+    def side(e):
+        for ref in e._dependencies():
+            if isinstance(ref, ColumnReference):
+                if ref.table is left_table:
+                    return "l"
+                if ref.table is right_table:
+                    return "r"
+        return None
+
+    if side(cond._left) == "r" and side(cond._right) == "l":
+        return ColumnBinaryOpExpression("==", cond._right, cond._left)
+    return cond
 
 
 def _conjuncts(e):

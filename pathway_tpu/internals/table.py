@@ -683,31 +683,49 @@ class Table(Joinable):
 
     # --- joins ----------------------------------------------------------------
 
-    def join(self, other: "Table", *on: Any, id: Any = None, how: Any = None, **kwargs):
+    def join(
+        self,
+        other: "Table",
+        *on: Any,
+        id: Any = None,
+        how: Any = None,
+        left_instance: Any = None,
+        right_instance: Any = None,
+        **kwargs,
+    ):
         from pathway_tpu.internals.joins import JoinMode, JoinResult
 
         mode = how if how is not None else JoinMode.INNER
+        if (left_instance is None) != (right_instance is None):
+            raise ValueError(
+                "join: left_instance and right_instance must be given "
+                "together"
+            )
+        if left_instance is not None:
+            # instance co-location joins as an additional equality
+            # (reference: join instance= args, sharded by instance)
+            on = (*on, left_instance == right_instance)
         return JoinResult(self, other, on, mode, id)
 
-    def join_inner(self, other: "Table", *on: Any, id: Any = None, **kwargs):
-        from pathway_tpu.internals.joins import JoinMode, JoinResult
+    def join_inner(self, other: "Table", *on: Any, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode
 
-        return JoinResult(self, other, on, JoinMode.INNER, id)
+        return self.join(other, *on, how=JoinMode.INNER, **kwargs)
 
-    def join_left(self, other: "Table", *on: Any, id: Any = None, **kwargs):
-        from pathway_tpu.internals.joins import JoinMode, JoinResult
+    def join_left(self, other: "Table", *on: Any, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode
 
-        return JoinResult(self, other, on, JoinMode.LEFT, id)
+        return self.join(other, *on, how=JoinMode.LEFT, **kwargs)
 
-    def join_right(self, other: "Table", *on: Any, id: Any = None, **kwargs):
-        from pathway_tpu.internals.joins import JoinMode, JoinResult
+    def join_right(self, other: "Table", *on: Any, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode
 
-        return JoinResult(self, other, on, JoinMode.RIGHT, id)
+        return self.join(other, *on, how=JoinMode.RIGHT, **kwargs)
 
-    def join_outer(self, other: "Table", *on: Any, id: Any = None, **kwargs):
-        from pathway_tpu.internals.joins import JoinMode, JoinResult
+    def join_outer(self, other: "Table", *on: Any, **kwargs):
+        from pathway_tpu.internals.joins import JoinMode
 
-        return JoinResult(self, other, on, JoinMode.OUTER, id)
+        return self.join(other, *on, how=JoinMode.OUTER, **kwargs)
 
     # --- set ops --------------------------------------------------------------
 

@@ -1,0 +1,418 @@
+"""Ported reference core-join tests
+(reference: python/pathway/tests/test_common.py join section) — empty
+selects over joins, id= assignment from either side (with duplicate-key
+errors), multi-condition joins, instance joins, condition-order and
+operator validation, self-join rejection, cross joins."""
+
+from __future__ import annotations
+
+import operator
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown as T
+
+from tests.ref_utils import (
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_all,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    from pathway_tpu.internals.errors import clear_errors
+
+    clear_errors()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_empty_join():
+    left = T(
+        """
+                col | on
+            1 | a   | 11
+            2 | b   | 12
+            3 | c   | 13
+        """
+    )
+    right = T(
+        """
+                col | on
+            1 | d   | 12
+            2 | e   | 13
+            3 | f   | 14
+        """,
+    )
+    joined = left.join(right, left.on == right.on).select()
+    assert_table_equality_wo_index(
+        joined,
+        T(
+            """
+                |
+            2   |
+            3   |
+            """
+        ).select(),
+    )
+
+
+def test_join_left_assign_id():
+    left = T(
+        """
+                col | on
+            1 | a   | 11
+            2 | b   | 12
+            3 | c   | 13
+            4 | d   | 13
+        """
+    )
+    right = T(
+        """
+                col | on
+            1 | d   | 12
+            2 | e   | 13
+            3 | f   | 14
+        """,
+    )
+    joined = left.join(right, left.on == right.on, id=left.id).select(
+        lcol=left.col, rcol=right.col
+    )
+    assert_table_equality(
+        joined,
+        T(
+            """
+        | lcol | rcol
+        2 |  b |    d
+        3 |  c |    e
+        4 |  d |    e
+    """
+        ),
+    )
+    with pytest.raises((AssertionError, TypeError, ValueError)):
+        left.join(right, left.on == right.on, id=left.on)
+    left.join(right, left.on == right.on, id=right.id).select(
+        lcol=left.col, rcol=right.col
+    )
+    with pytest.raises(KeyError):
+        run_all()
+
+
+def test_join_right_assign_id():
+    left = T(
+        """
+                col | on
+            1 | a   | 11
+            2 | b   | 12
+            3 | c   | 13
+        """
+    )
+    right = T(
+        """
+                col | on
+            0 | c   | 12
+            1 | d   | 12
+            2 | e   | 13
+            3 | f   | 14
+        """,
+    )
+    joined = left.join(right, left.on == right.on, id=right.id).select(
+        lcol=left.col, rcol=right.col
+    )
+    assert_table_equality(
+        joined,
+        T(
+            """
+          | lcol | rcol
+        0 |    b |    c
+        1 |    b |    d
+        2 |    c |    e
+    """
+        ),
+    )
+    with pytest.raises((AssertionError, TypeError, ValueError)):
+        left.join(right, left.on == right.on, id=right.on)
+    left.join(right, left.on == right.on, id=left.id).select(
+        lcol=left.col, rcol=right.col
+    )
+    with pytest.raises(KeyError):
+        run_all()
+
+
+def test_join():
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |   9 |    L
+        13  |   1 |   Tom |   8 |   XL
+        """
+    )
+    expected = T(
+        """
+            owner_name | L | R  | age
+            Bob        | 2 | 12 |   9
+            """,
+    ).with_columns(
+        L=t1.pointer_from(pw.this.L),
+        R=t2.pointer_from(pw.this.R),
+    )
+    res = t1.join(t2, t1.pet == t2.pet, t1.owner == t2.owner).select(
+        owner_name=t2.owner, L=t1.id, R=t2.id, age=t1.age
+    )
+    assert_table_equality_wo_index(
+        res,
+        expected,
+    )
+
+
+def test_join_instance():
+    t1 = T(
+        """
+            | owner | age | instance
+        1   | Alice |  10 | 1
+        2   |   Bob |   9 | 1
+        3   |   Tom |   8 | 1
+        4   | Alice |  10 | 2
+        5   |   Bob |   9 | 2
+        6   |   Tom |   8 | 2
+        """
+    )
+    t2 = T(
+        """
+            | owner | age | size | instance
+        11  | Alice |  10 |    M | 1
+        12  |   Bob |   9 |    L | 1
+        13  |   Tom |   8 |   XL | 1
+        14  | Alice |  10 |    M | 2
+        15  |   Bob |   9 |    L | 2
+        16  |   Tom |   8 |   XL | 2
+        """
+    )
+    expected = T(
+        """
+            owner_name | L | R  | age
+            Alice      | 1 | 11 |  10
+            Bob        | 2 | 12 |   9
+            Tom        | 3 | 13 |   8
+            Alice      | 4 | 14 |  10
+            Bob        | 5 | 15 |   9
+            Tom        | 6 | 16 |   8
+            """,
+    ).with_columns(
+        L=t1.pointer_from(pw.this.L),
+        R=t2.pointer_from(pw.this.R),
+    )
+    res = t1.join(
+        t2,
+        t1.owner == t2.owner,
+        left_instance=t1.instance,
+        right_instance=t2.instance,
+    ).select(owner_name=t2.owner, L=t1.id, R=t2.id, age=t1.age)
+    assert_table_equality_wo_index(
+        res,
+        expected,
+    )
+
+
+def test_join_swapped_condition():
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        1   |   3 | Alice |  10 |    M
+        2   |   1 |   Bob |   9 |    L
+        3   |   1 |   Tom |   8 |   XL
+        """
+    )
+    with pytest.raises(ValueError):
+        t1.join(t2, t2.pet == t1.pet).select(
+            owner_name=t2.owner, L=t1.id, R=t2.id, age=t1.age
+        )
+
+
+@pytest.mark.parametrize(
+    "op",
+    [operator.ne, operator.lt, operator.gt, operator.le, operator.ge],
+)
+def test_join_illegal_operator_in_condition(op):
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |   9 |    L
+        13  |   1 |   Tom |   8 |   XL
+        """
+    )
+    with pytest.raises((ValueError, TypeError)):
+        t1.join(t2, op(t1.pet, t2.pet)).select(t1.owner)
+
+
+def test_join_default():
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |   9 |    L
+        13  |   1 |   Tom |   8 |   XL
+        """
+    )
+    res = t1.join(t2, t1.pet == t2.pet).select(
+        owner_name=t2.owner, L=t1.id, R=t2.id, age=t1.age
+    )
+    expected = T(
+        """
+            owner_name  | L | R  | age
+            Bob         | 1 | 12 | 10
+            Tom         | 1 | 13 | 10
+            Bob         | 2 | 12 |  9
+            Tom         | 2 | 13 |  9
+        """,
+    ).with_columns(
+        L=t1.pointer_from(pw.this.L),
+        R=t2.pointer_from(pw.this.R),
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_self():
+    input = T(
+        """
+        foo   | bar
+        1     | 1
+        1     | 2
+        1     | 3
+        """
+    )
+    with pytest.raises(Exception):
+        input.join(input, input.foo == input.bar)
+
+
+def test_join_select_no_columns():
+    left = T(
+        """
+           | a
+        1  | 1
+        2  | 2
+        """
+    )
+    right = T(
+        """
+           | b
+        1  | foo
+        2  | bar
+        """
+    )
+    ret = left.join(right, left.id == right.id).select().select(col=42)
+    assert_table_equality_wo_index(
+        ret,
+        T(
+            """
+                | col
+            1   | 42
+            2   | 42
+            """
+        ),
+    )
+
+
+def test_cross_join():
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |  9  |    L
+        13  |   1 |   Tom |  8  |   XL
+        """
+    )
+    res = t1.join(t2).select(
+        owner_name=t2.owner, L=t1.id, R=t2.id, age=t1.age
+    )
+    expected = T(
+        """
+            owner_name  | L | R | age
+            Alice       | 1 | 11 |  10
+            Bob         | 1 | 12 |  10
+            Tom         | 1 | 13 |  10
+            Alice       | 2 | 11 |   9
+            Bob         | 2 | 12 |   9
+            Tom         | 2 | 13 |   9
+            Alice       | 3 | 11 |   8
+            Bob         | 3 | 12 |   8
+            Tom         | 3 | 13 |   8
+        """,
+    ).with_columns(
+        L=t1.pointer_from(pw.this.L),
+        R=t2.pointer_from(pw.this.R),
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_empty_join_2():
+    t1 = T(
+        """
+        v1
+        1
+        2
+        """,
+    )
+    t2 = T(
+        """
+        v2
+        10
+        20
+        """,
+    )
+    t = t1.join(t2).select(t1.v1, t2.v2)
+    expected_t = T(
+        """
+        v1  | v2
+        1   | 10
+        1   | 20
+        2   | 10
+        2   | 20
+        """,
+    )
+    assert_table_equality_wo_index(t, expected_t)
