@@ -26,25 +26,54 @@ import time
 
 def _probe_platform(timeout_s: float = 90.0) -> str:
     """Return the usable jax platform ('tpu'/'axon'/'cpu') by initializing
-    the backend in a throwaway subprocess. Falls back to 'cpu' on any
-    failure or timeout (the round-1 BENCH crashed and MULTICHIP hung at
-    exactly this step when the tunneled TPU was unavailable)."""
+    the backend in a throwaway subprocess. Falls back to 'cpu' only after
+    SIX attempts spread over >10 minutes of backoff: rounds 1-3 each lost
+    the hardware headline to a transient tunnel outage at probe time, so a
+    single failed probe must not forfeit the round's TPU evidence."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return "cpu"
     code = "import jax; print(jax.devices()[0].platform)"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
+    delays = (0, 30, 60, 120, 180, 240)  # cumulative 10.5 min of backoff
+    # stderr markers of a *failed accelerator init* (worth retrying) vs a
+    # box that simply has no accelerator (give up immediately)
+    accel_markers = ("tpu", "axon", "rpc", "plugin", "pjrt", "tunnel")
+    for attempt, delay in enumerate(delays):
+        if delay:
+            time.sleep(delay)
+        stderr = ""
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            stderr = (out.stderr or "").lower()
+            if out.returncode == 0:
+                platform = out.stdout.strip().splitlines()[-1].strip()
+                if platform and platform != "cpu":
+                    return platform
+                if platform == "cpu" and not any(
+                    m in stderr for m in accel_markers
+                ):
+                    # clean cpu probe, no sign of a failed accelerator
+                    # init: retrying won't conjure hardware
+                    return "cpu"
+            elif "modulenotfounderror" in stderr or (
+                "importerror" in stderr and "jax" in stderr
+            ):
+                # deterministic breakage — backoff can't fix an install
+                return "cpu"
+        except subprocess.TimeoutExpired:
+            pass  # hang = likely the tunnel; retry
+        except Exception:
+            pass
+        print(
+            f"bench: backend probe attempt {attempt + 1}/{len(delays)} "
+            "failed; retrying" if attempt + 1 < len(delays) else
+            "bench: backend probe exhausted; falling back to CPU",
+            file=sys.stderr,
         )
-        if out.returncode == 0:
-            platform = out.stdout.strip().splitlines()[-1].strip()
-            if platform:
-                return platform
-    except Exception:
-        pass
     return "cpu"
 
 
@@ -79,6 +108,7 @@ def _bench_knn(np, on_accel, errors):
     np.asarray(s)
 
     lat = []
+    bf16_ids = []  # reused by the recall pass — no re-querying
     for i in range(n_queries):
         t0 = time.perf_counter()
         s, ix = dense_topk_prepared(
@@ -86,6 +116,7 @@ def _bench_knn(np, on_accel, errors):
         )
         ids = np.asarray(ix)  # block until the result is on host
         lat.append((time.perf_counter() - t0) * 1000)
+        bf16_ids.append(ids.ravel()[:k])
     p50 = float(np.percentile(lat, 50))
 
     # Device-side per-query latency: the serial loop above is floored at
@@ -124,6 +155,7 @@ def _bench_knn(np, on_accel, errors):
             errors.append(f"knn-device:{type(e).__name__}:{e}")
 
     pallas_p50 = None
+    pallas_ids: list | None = None
     if on_accel:
         try:
             # compare the fused Pallas block-top-k against the XLA path on
@@ -139,17 +171,68 @@ def _bench_knn(np, on_accel, errors):
                     )[1]
                 )
                 plat = []
+                pallas_ids = []
                 for i in range(n_queries):
                     t0 = time.perf_counter()
                     s, ix = pt.pallas_dense_topk(
                         queries[i], prep, valid, k, metric="cosine"
                     )
-                    np.asarray(ix)
+                    ids = np.asarray(ix)
                     plat.append((time.perf_counter() - t0) * 1000)
+                    pallas_ids.append(ids.ravel()[:k])
                 pallas_p50 = float(np.percentile(plat, 50))
         except Exception as e:
             errors.append(f"knn-pallas:{type(e).__name__}:{e}")
-    return n, dim, p50, pallas_p50, device_ms
+
+    # Retrieval quality: recall@10 of the bf16 device path (and the Pallas
+    # path when supported) vs an exact f32 numpy top-k over the same
+    # corpus. BASELINE's <50 ms target is only meaningful if the fast path
+    # still finds the right neighbors; the advisor asked for >=0.99.
+    recalls: dict[str, float] = {}
+    try:
+        q2 = np.ascontiguousarray(queries[:, 0, :])  # [nq, dim] f32
+        qn = q2 / np.linalg.norm(q2, axis=1, keepdims=True)
+        # chunk the corpus so the [nq, chunk] f32 score block stays ~300 MB
+        # and the normalized corpus slice stays bounded too
+        step = max(1, min(n, 75_000_000 // max(1, len(q2))))
+        host = corpus.host[:n]
+        best_s = np.full((len(q2), k), -np.inf, np.float32)
+        best_i = np.zeros((len(q2), k), np.int64)
+        for lo in range(0, n, step):
+            hi = min(n, lo + step)
+            hchunk = host[lo:hi]
+            hn = hchunk / np.linalg.norm(hchunk, axis=1, keepdims=True)
+            s = qn @ hn.T  # f32 exact scores
+            # per-chunk top-k first, then merge the 2k-wide candidate set:
+            # keeps the int64 index array at [nq, 2k], not [nq, chunk]
+            csel = np.argpartition(-s, k - 1, axis=1)[:, :k]
+            cand_s = np.concatenate(
+                [best_s, np.take_along_axis(s, csel, axis=1)], axis=1
+            )
+            cand_i = np.concatenate([best_i, csel + lo], axis=1)
+            sel = np.argpartition(-cand_s, k - 1, axis=1)[:, :k]
+            best_s = np.take_along_axis(cand_s, sel, axis=1)
+            best_i = np.take_along_axis(cand_i, sel, axis=1)
+        exact = best_i
+
+        def _recall(approx_ids) -> float:
+            hits = 0
+            for i, ids in enumerate(approx_ids):
+                hits += len(set(ids.tolist()) & set(exact[i].tolist()))
+            return hits / (len(approx_ids) * k)
+
+        # ids were collected during the timing loops above — recall costs
+        # zero extra device round-trips
+        recalls["knn_recall_at_10_bf16"] = round(_recall(bf16_ids), 4)
+        # gate on the p50, not the ids list: a mid-loop pallas failure
+        # leaves partial ids that must not masquerade as a full measurement
+        if pallas_p50 is not None and pallas_ids:
+            recalls["knn_recall_at_10_pallas"] = round(
+                _recall(pallas_ids), 4
+            )
+    except Exception as e:
+        errors.append(f"recall:{type(e).__name__}:{e}")
+    return n, dim, p50, pallas_p50, device_ms, recalls
 
 
 # Same corpus/seed as _bench_knn; prints DEVICE_MS=<float>. Short scans: a
@@ -220,16 +303,54 @@ def _measure_dispatch_floor(np) -> float:
     return float(np.percentile(lat, 50))
 
 
+# bf16 peak FLOP/s per chip by device_kind substring, for MFU accounting.
+# Public figures: v2 45, v3 123, v4 275, v5e 197, v5p 459, v6e 918 TFLOP/s.
+_CHIP_PEAK_TFLOPS = (
+    ("v6e", 918.0),
+    ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _chip_peak_tflops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _CHIP_PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _encoder_flops_per_fwd(batch, seq, dim, depth, mlp_ratio=4) -> float:
+    """Analytic matmul FLOPs of one TransformerEncoder forward: per layer
+    4 attention projections (8·B·S·D²) + QKᵀ and AV (4·B·S²·D) + the
+    2-matmul MLP (2·2·B·S·D·(mlp_ratio·D))."""
+    per_layer = (
+        8 * batch * seq * dim * dim
+        + 4 * batch * seq * seq * dim
+        + 4 * batch * seq * dim * (mlp_ratio * dim)
+    )
+    return float(depth * per_layer)
+
+
 def _bench_embed(np, on_accel):
-    """Embed docs/sec/chip — flax sentence-encoder forward (BASELINE.md)."""
+    """Embed docs/sec/chip — flax sentence-encoder forward (BASELINE.md).
+    Also returns measured TFLOP/s and MFU vs the chip's bf16 peak so
+    "fast" is checkable against hardware limits (advisor round-3 ask)."""
     import jax
     import jax.numpy as jnp
 
     from pathway_tpu.xpacks.llm._encoder import TransformerEncoder
 
     batch, seq = (256, 128) if on_accel else (32, 64)
+    dim, depth = 384, 6
     model = TransformerEncoder(
-        vocab_size=30522, dim=384, depth=6, heads=12, max_len=512
+        vocab_size=30522, dim=dim, depth=depth, heads=12, max_len=512
     )
     rng = jax.random.PRNGKey(0)
     ids = jnp.zeros((batch, seq), jnp.int32)
@@ -245,7 +366,11 @@ def _bench_embed(np, on_accel):
         out = fwd(params, ids, mask)
     out.block_until_ready()
     dt = time.perf_counter() - t0
-    return float(reps * batch / dt)
+
+    tflops = _encoder_flops_per_fwd(batch, seq, dim, depth) * reps / dt / 1e12
+    peak = _chip_peak_tflops(jax.devices()[0].device_kind)
+    mfu = round(100.0 * tflops / peak, 2) if peak else None
+    return float(reps * batch / dt), round(tflops, 2), mfu
 
 
 def _bench_groupby(np):
@@ -494,21 +619,33 @@ def main() -> None:
         errors.append(f"floor:{type(e).__name__}:{e}")
 
     try:
-        n, dim, p50, pallas_p50, device_ms = _bench_knn(np, on_accel, errors)
-        result["metric"] = f"knn_query_p50_ms_{n}x{dim}"
+        n, dim, p50, pallas_p50, device_ms, recalls = _bench_knn(
+            np, on_accel, errors
+        )
+        # On CPU fallback the metric is a smaller workload on the wrong
+        # hardware: label it loudly and do NOT score it against the TPU
+        # target (the round-3 verdict flagged the old unconditional
+        # vs_baseline as misreadable).
+        suffix = "" if on_accel else "_CPU_FALLBACK"
+        result["metric"] = f"knn_query_p50_ms_{n}x{dim}{suffix}"
         result["value"] = round(p50, 3)
-        result["vs_baseline"] = round(target_ms / p50, 2)
+        result["vs_baseline"] = (
+            round(target_ms / p50, 2) if on_accel else None
+        )
         if pallas_p50 is not None:
             extra["knn_pallas_p50_ms"] = round(pallas_p50, 3)
         if device_ms is not None:
             extra["knn_device_ms_per_query"] = round(device_ms, 3)
+        extra.update(recalls)
     except Exception as e:
         errors.append(f"knn:{type(e).__name__}:{e}")
 
     try:
-        extra["embed_docs_per_sec_per_chip"] = round(
-            _bench_embed(np, on_accel), 1
-        )
+        docs_s, tflops, mfu = _bench_embed(np, on_accel)
+        extra["embed_docs_per_sec_per_chip"] = round(docs_s, 1)
+        extra["embed_tflops"] = tflops
+        if mfu is not None:
+            extra["embed_mfu_pct"] = mfu
     except Exception as e:
         errors.append(f"embed:{type(e).__name__}:{e}")
 
@@ -528,9 +665,10 @@ def main() -> None:
         errors.append(f"rag:{type(e).__name__}:{e}")
 
     try:
-        extra["rag_rest_p50_ms"] = round(
-            _bench_rag_rest_p50(np, on_accel), 3
-        )
+        # on CPU the server runs a toy dim-32 encoder over 100 docs — a
+        # smoke check of the REST path, not the <50 ms serving target
+        key = "rag_rest_p50_ms" if on_accel else "rag_rest_p50_ms_smoke"
+        extra[key] = round(_bench_rag_rest_p50(np, on_accel), 3)
     except Exception as e:
         errors.append(f"rag-rest:{type(e).__name__}:{e}")
 
