@@ -17,10 +17,12 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -414,6 +416,58 @@ static bool col_view_init(PyObject* col, Py_ssize_t n, ColView& cv) {
   return true;
 }
 
+// Serialize + hash one row from the column views into out[i]. Returns false
+// only for GENERIC-column Python failures (fast kinds cannot fail).
+static bool hash_one_row(std::vector<ColView>& views, Py_ssize_t ncols,
+                         Py_ssize_t i, const Blake2bState& key_state,
+                         std::string& buf, uint64_t* out) {
+  buf.clear();
+  buf.push_back('\x06');
+  put_u32(buf, (uint32_t)ncols);
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    ColView& cv = views[c];
+    switch (cv.kind) {
+      case ColView::I64:
+        put_u32(buf, 9);
+        buf.push_back('\x02');
+        put_i64(buf, cv.i64[i]);
+        break;
+      case ColView::PTR:
+        put_u32(buf, 9);
+        buf.push_back('\x07');
+        put_u64(buf, cv.u64[i]);
+        break;
+      case ColView::F64: {
+        double f = cv.f64[i];
+        double t = (f < 0) ? -std::floor(-f) : std::floor(f);
+        put_u32(buf, 9);
+        if (f == t && f < 9007199254740992.0 && f > -9007199254740992.0) {
+          buf.push_back('\x02');
+          put_i64(buf, (int64_t)f);
+        } else {
+          buf.push_back('\x03');
+          put_f64(buf, f);
+        }
+        break;
+      }
+      case ColView::GENERIC: {
+        PyObject* item = PySequence_GetItem(cv.obj, i);
+        if (!item) return false;
+        std::string sub;
+        bool ok = serialize_value(item, sub);
+        Py_DECREF(item);
+        if (!ok) return false;
+        put_u32(buf, (uint32_t)sub.size());
+        buf.append(sub);
+        break;
+      }
+    }
+  }
+  out[i] = blake2b64_from_state(key_state, (const uint8_t*)buf.data(),
+                                buf.size());
+  return true;
+}
+
 static PyObject* py_hash_columns(PyObject*, PyObject* args) {
   PyObject* columns;
   Py_ssize_t n;
@@ -423,69 +477,45 @@ static PyObject* py_hash_columns(PyObject*, PyObject* args) {
   Py_ssize_t ncols = PySequence_Fast_GET_SIZE(fast_cols);
   std::vector<ColView> views((size_t)ncols);
   bool ok = true;
+  bool all_fast = true;
   for (Py_ssize_t c = 0; c < ncols; c++) {
     if (!col_view_init(PySequence_Fast_GET_ITEM(fast_cols, c), n, views[c])) {
       ok = false;
       break;
     }
+    if (views[c].kind == ColView::GENERIC) all_fast = false;
   }
   PyObject* out_bytes = ok ? PyBytes_FromStringAndSize(nullptr, n * 8) : nullptr;
   if (!out_bytes) ok = false;
   uint64_t* out = out_bytes ? (uint64_t*)PyBytes_AS_STRING(out_bytes) : nullptr;
-  std::string buf;
   Blake2bState key_state;
   blake2b64_key_state((const uint8_t*)g_state.salt.data(),
                       g_state.salt.size(), &key_state);
-  for (Py_ssize_t i = 0; ok && i < n; i++) {
-    buf.clear();
-    buf.push_back('\x06');
-    put_u32(buf, (uint32_t)ncols);
-    for (Py_ssize_t c = 0; c < ncols; c++) {
-      ColView& cv = views[c];
-      switch (cv.kind) {
-        case ColView::I64:
-          put_u32(buf, 9);
-          buf.push_back('\x02');
-          put_i64(buf, cv.i64[i]);
-          break;
-        case ColView::PTR:
-          put_u32(buf, 9);
-          buf.push_back('\x07');
-          put_u64(buf, cv.u64[i]);
-          break;
-        case ColView::F64: {
-          double f = cv.f64[i];
-          double t = (f < 0) ? -std::floor(-f) : std::floor(f);
-          put_u32(buf, 9);
-          if (f == t && f < 9007199254740992.0 && f > -9007199254740992.0) {
-            buf.push_back('\x02');
-            put_i64(buf, (int64_t)f);
-          } else {
-            buf.push_back('\x03');
-            put_f64(buf, f);
-          }
-          break;
-        }
-        case ColView::GENERIC: {
-          PyObject* item = PySequence_GetItem(cv.obj, i);
-          if (!item) {
-            ok = false;
-            break;
-          }
-          std::string sub;
-          ok = serialize_value(item, sub);
-          Py_DECREF(item);
-          if (!ok) break;
-          put_u32(buf, (uint32_t)sub.size());
-          buf.append(sub);
-          break;
-        }
-      }
-      if (!ok) break;
+  unsigned nt = std::thread::hardware_concurrency();
+  if (nt > 8) nt = 8;
+  if (ok && all_fast && n >= 65536 && nt >= 2) {
+    // fast-kind columns touch no Python objects: release the GIL and hash
+    // row ranges on a small thread pool (each thread owns its scratch buf
+    // and writes a disjoint slice of out)
+    Py_BEGIN_ALLOW_THREADS;
+    Py_ssize_t chunk = (n + nt - 1) / nt;
+    std::vector<std::thread> threads;
+    for (unsigned t = 0; t < nt; t++) {
+      Py_ssize_t lo = (Py_ssize_t)t * chunk;
+      Py_ssize_t hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      threads.emplace_back([&views, ncols, lo, hi, &key_state, out]() {
+        std::string buf;
+        for (Py_ssize_t i = lo; i < hi; i++)
+          hash_one_row(views, ncols, i, key_state, buf, out);
+      });
     }
-    if (!ok) break;
-    out[i] = blake2b64_from_state(key_state, (const uint8_t*)buf.data(),
-                                  buf.size());
+    for (auto& th : threads) th.join();
+    Py_END_ALLOW_THREADS;
+  } else {
+    std::string buf;
+    for (Py_ssize_t i = 0; ok && i < n; i++)
+      ok = hash_one_row(views, ncols, i, key_state, buf, out);
   }
   for (auto& cv : views)
     if (cv.has_view) PyBuffer_Release(&cv.view);
@@ -497,6 +527,101 @@ static PyObject* py_hash_columns(PyObject*, PyObject* args) {
     return nullptr;
   }
   return out_bytes;
+}
+
+// match_fk(left_keys: buffer u64[nl], right_keys: buffer u64[nr])
+//   -> (li: bytes i64[m], ri: bytes i64[m])
+// Inner-equijoin match step: for each left row in input order, every right
+// row with an equal key, in right-input order (the differential join_core
+// merge order — reference src/engine/dataflow.rs:2834). Pure buffer work;
+// runs without the GIL.
+static PyObject* py_match_fk(PyObject*, PyObject* args) {
+  Py_buffer lb, rb;
+  if (!PyArg_ParseTuple(args, "y*y*", &lb, &rb)) return nullptr;
+  Py_ssize_t nl = lb.len / 8, nr = rb.len / 8;
+  const uint64_t* lk = (const uint64_t*)lb.buf;
+  const uint64_t* rk = (const uint64_t*)rb.buf;
+  std::vector<int64_t> li, ri;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    // per-key chain over right indices, preserving right-input order
+    std::unordered_map<uint64_t, std::pair<int64_t, int64_t>> chain;  // k -> (head, tail)
+    chain.reserve((size_t)nr * 2);
+    std::vector<int64_t> next((size_t)nr, -1);
+    for (Py_ssize_t j = 0; j < nr; j++) {
+      auto it = chain.find(rk[j]);
+      if (it == chain.end()) {
+        chain.emplace(rk[j], std::make_pair((int64_t)j, (int64_t)j));
+      } else {
+        next[(size_t)it->second.second] = j;
+        it->second.second = j;
+      }
+    }
+    unsigned nt = std::thread::hardware_concurrency();
+    if (nt > 8) nt = 8;
+    if (nt >= 2 && nl >= 65536) {
+      // probe phase threads over left ranges; per-thread buffers are
+      // concatenated in range order, preserving left-input order
+      Py_ssize_t chunk = (nl + nt - 1) / nt;
+      std::vector<std::vector<int64_t>> lis(nt), ris(nt);
+      std::vector<std::thread> threads;
+      for (unsigned t = 0; t < nt; t++) {
+        Py_ssize_t lo = (Py_ssize_t)t * chunk;
+        Py_ssize_t hi = std::min(nl, lo + chunk);
+        if (lo >= hi) break;
+        threads.emplace_back([&, t, lo, hi]() {
+          auto& l = lis[t];
+          auto& r = ris[t];
+          l.reserve((size_t)(hi - lo));
+          r.reserve((size_t)(hi - lo));
+          for (Py_ssize_t i = lo; i < hi; i++) {
+            auto it = chain.find(lk[i]);
+            if (it == chain.end()) continue;
+            for (int64_t j = it->second.first; j != -1; j = next[(size_t)j]) {
+              l.push_back((int64_t)i);
+              r.push_back(j);
+            }
+          }
+        });
+      }
+      for (auto& th : threads) th.join();
+      size_t total = 0;
+      for (auto& l : lis) total += l.size();
+      li.reserve(total);
+      ri.reserve(total);
+      for (unsigned t = 0; t < nt; t++) {
+        li.insert(li.end(), lis[t].begin(), lis[t].end());
+        ri.insert(ri.end(), ris[t].begin(), ris[t].end());
+      }
+    } else {
+      li.reserve((size_t)nl);
+      ri.reserve((size_t)nl);
+      for (Py_ssize_t i = 0; i < nl; i++) {
+        auto it = chain.find(lk[i]);
+        if (it == chain.end()) continue;
+        for (int64_t j = it->second.first; j != -1; j = next[(size_t)j]) {
+          li.push_back((int64_t)i);
+          ri.push_back(j);
+        }
+      }
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&lb);
+  PyBuffer_Release(&rb);
+  PyObject* li_b = PyBytes_FromStringAndSize((const char*)li.data(),
+                                             (Py_ssize_t)(li.size() * 8));
+  PyObject* ri_b = PyBytes_FromStringAndSize((const char*)ri.data(),
+                                             (Py_ssize_t)(ri.size() * 8));
+  if (!li_b || !ri_b) {
+    Py_XDECREF(li_b);
+    Py_XDECREF(ri_b);
+    return nullptr;
+  }
+  PyObject* res = PyTuple_Pack(2, li_b, ri_b);
+  Py_DECREF(li_b);
+  Py_DECREF(ri_b);
+  return res;
 }
 
 struct PairHash {
@@ -568,6 +693,8 @@ static PyMethodDef Methods[] = {
      "hash_columns(columns, n) -> bytes"},
     {"consolidate", py_consolidate, METH_VARARGS,
      "consolidate(keys, vhashes, diffs) -> (idx_bytes, diff_bytes)"},
+    {"match_fk", py_match_fk, METH_VARARGS,
+     "match_fk(left_keys, right_keys) -> (li_bytes, ri_bytes)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {

@@ -17,7 +17,8 @@ setup(
         Extension(
             "pathway_tpu._native",
             sources=[os.path.join(HERE, "pathway_native.cc")],
-            extra_compile_args=["-O3", "-std=c++17"],
+            extra_compile_args=["-O3", "-std=c++17", "-pthread"],
+            extra_link_args=["-pthread"],
             language="c++",
         )
     ],

@@ -356,34 +356,93 @@ def _schema_coercers(schema: Any, col_names: Sequence[str]) -> list:
 
 
 class _Capture:
+    """Captured output of one table. Batches are stored as-is; the row/
+    update views are built lazily on first access — the bulk-join path
+    emits hundreds of thousands of rows, and eagerly zipping them into
+    per-row tuples doubled the join bench's wall time when the consumer
+    (table_to_dicts) only ever wanted columns."""
+
     def __init__(self, table: Table):
         self.table = table
-        self.rows: dict[int, tuple] = {}
-        self.updates: list[tuple[int, int, int, tuple]] = []  # (time,key,diff,vals)
+        self._batches: list[tuple[int, DiffBatch]] = []
+        self._rows: dict[int, tuple] | None = None
+        self._updates: list[tuple[int, int, int, tuple]] | None = None
 
     def on_batch(self, t: int, batch: DiffBatch) -> None:
-        rows = self.rows
-        updates = self.updates
-        if len(batch) > 512 and bool((batch.diffs > 0).all()):
-            # insert-only bulk: one C-level dict.update instead of a
-            # per-row loop (bulk joins emit hundreds of thousands of rows)
+        self._batches.append((t, batch))
+        self._rows = None
+        self._updates = None
+
+    @property
+    def rows(self) -> dict[int, tuple]:
+        if self._rows is None:
+            rows: dict[int, tuple] = {}
+            for t, batch in self._batches:
+                if len(batch) > 512 and bool((batch.diffs > 0).all()):
+                    keys = batch.keys.tolist()
+                    cols = [c.tolist() for c in batch.columns.values()]
+                    vals = list(zip(*cols)) if cols else [()] * len(keys)
+                    rows.update(zip(keys, vals))
+                    continue
+                for k, d, vals in batch.iter_rows():
+                    if d > 0:
+                        rows[k] = vals
+                    else:
+                        rows.pop(k, None)
+            self._rows = rows
+        return self._rows
+
+    @property
+    def updates(self) -> list[tuple[int, int, int, tuple]]:
+        if self._updates is None:
             import itertools
 
-            keys = batch.keys.tolist()
-            cols = [c.tolist() for c in batch.columns.values()]
-            vals = list(zip(*cols)) if cols else [()] * len(keys)
-            diffs = batch.diffs.tolist()
-            updates.extend(
-                zip(itertools.repeat(t), keys, diffs, vals)
-            )
-            rows.update(zip(keys, vals))
-            return
-        for k, d, vals in batch.iter_rows():
-            updates.append((t, k, d, vals))
-            if d > 0:
-                rows[k] = vals
-            else:
-                rows.pop(k, None)
+            updates: list[tuple[int, int, int, tuple]] = []
+            for t, batch in self._batches:
+                if len(batch) > 512:
+                    keys = batch.keys.tolist()
+                    cols = [c.tolist() for c in batch.columns.values()]
+                    vals = list(zip(*cols)) if cols else [()] * len(keys)
+                    updates.extend(
+                        zip(
+                            itertools.repeat(t),
+                            keys,
+                            batch.diffs.tolist(),
+                            vals,
+                        )
+                    )
+                    continue
+                for k, d, vals in batch.iter_rows():
+                    updates.append((t, k, d, vals))
+            self._updates = updates
+        return self._updates
+
+    def column_dicts(self) -> tuple[list[int], dict[str, dict[int, Any]]]:
+        """Current rows as per-column dicts, built columnar — no per-row
+        tuples. Key order matches the `rows` dict (insertion order)."""
+        keys_live: dict[int, None] = {}
+        cols: dict[str, dict[int, Any]] = {}
+        for t, batch in self._batches:
+            names = list(batch.columns)
+            for nm in names:
+                if nm not in cols:
+                    cols[nm] = {}
+            if len(batch) > 512 and bool((batch.diffs > 0).all()):
+                keys = batch.keys.tolist()
+                keys_live.update(dict.fromkeys(keys))
+                for nm, c in batch.columns.items():
+                    cols[nm].update(zip(keys, c.tolist()))
+                continue
+            for i, (k, d, vals) in enumerate(batch.iter_rows()):
+                if d > 0:
+                    keys_live[k] = None
+                    for nm, v in zip(names, vals):
+                        cols[nm][k] = v
+                else:
+                    keys_live.pop(k, None)
+                    for nm in names:
+                        cols[nm].pop(k, None)
+        return list(keys_live.keys()), cols
 
 
 def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
@@ -404,12 +463,8 @@ def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
 def table_to_dicts(table: Table):
     cap = _run_capture([table])[0]
     col_names = table.column_names()
-    keys = list(cap.rows.keys())
-    vals = list(cap.rows.values())
-    columns = {
-        n: dict(zip(keys, [v[i] for v in vals]))
-        for i, n in enumerate(col_names)
-    }
+    keys, cols = cap.column_dicts()
+    columns = {n: cols.get(n, {}) for n in col_names}
     return keys, columns
 
 

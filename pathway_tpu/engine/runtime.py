@@ -30,6 +30,76 @@ from pathway_tpu.engine.nodes import (
 )
 
 
+def annotate_live_columns(order: Sequence[Node]) -> None:
+    """Backward column-liveness pass: sets node._live_cols to the set of
+    output columns any consumer may read, or None for "all" (the safe
+    default). Lets JoinExec skip materializing the `_left_id`/`_right_id`
+    Pointer columns on bulk ticks when no downstream expression references
+    them — per-row Pointer boxing dominated the bulk join profile
+    (reference analog: differential's arrangements never materialize
+    unused columns either; they are demand-built from traces)."""
+    from pathway_tpu.engine.expression_eval import InternalColRef
+    from pathway_tpu.engine.nodes import FilterNode, RowwiseNode
+
+    live: dict[int, set | None] = {n.id: set() for n in order}
+
+    def demand(node: Node, cols: set | None) -> None:
+        if cols is None:
+            live[node.id] = None
+        elif live[node.id] is not None:
+            live[node.id] |= cols  # type: ignore[operator]
+
+    def expr_refs(exprs, n_inputs: int) -> list[set]:
+        sets: list[set] = [set() for _ in range(n_inputs)]
+
+        def walk(e):
+            if isinstance(e, InternalColRef):
+                if e._name != "id" and 0 <= e._input_index < n_inputs:
+                    sets[e._input_index].add(e._name)
+                return
+            for c in e._children:
+                walk(c)
+
+        for e in exprs:
+            walk(e)
+        return sets
+
+    # roots (no consumers in `order`) may be captured externally: all live
+    has_consumer = {inp.id for node in order for inp in node.inputs}
+    for node in order:
+        if node.id not in has_consumer:
+            live[node.id] = None
+
+    for node in reversed(order):
+        if isinstance(node, RowwiseNode):
+            per_input = expr_refs(node.exprs.values(), len(node.inputs))
+            for pos, inp in enumerate(node.inputs):
+                demand(inp, per_input[pos])
+        elif isinstance(node, FilterNode):
+            refs = expr_refs([node.predicate], 1)[0]
+            own = live[node.id]
+            demand(
+                node.inputs[0], None if own is None else (refs | own)
+            )
+        else:
+            for inp in node.inputs:
+                demand(inp, None)
+
+    for node in order:
+        # merge with any annotation from another Runtime over the same
+        # graph nodes (interactive mode builds overlapping runtimes):
+        # liveness only ever widens, so concurrent annotation can cost
+        # optimization but never correctness
+        prev = getattr(node, "_live_cols", ())
+        new = live[node.id]
+        if prev is None or new is None:
+            node._live_cols = None
+        elif prev == ():  # never annotated
+            node._live_cols = new
+        else:
+            node._live_cols = prev | new
+
+
 def collect_nodes(outputs: Sequence[Node]) -> list[Node]:
     """Tree-shake + topological order (inputs first)."""
     order: list[Node] = []
@@ -193,6 +263,7 @@ class Runtime:
         worker_threads: bool = True,
     ):
         self.order = collect_nodes(outputs)
+        annotate_live_columns(self.order)
         self.execs: dict[int, NodeExec] = {
             node.id: node.make_exec() for node in self.order
         }

@@ -17,22 +17,34 @@ _tried = False
 
 
 def _build() -> bool:
+    """Compile to a temp file and swap in atomically: a failed build must
+    never clobber (or have required deleting) a working cached kernel."""
     pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     src = os.path.join(os.path.dirname(pkg_dir), "native", "pathway_native.cc")
     if not os.path.exists(src):
         return False
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     target = os.path.join(pkg_dir, "_native" + suffix)
+    tmp = target + ".tmp"
     include = sysconfig.get_paths()["include"]
     cmd = [
-        "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-        f"-I{include}", src, "-o", target,
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        f"-I{include}", src, "-o", tmp,
     ]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
-        return res.returncode == 0 and os.path.exists(target)
+        if res.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, target)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def get_native():
@@ -43,6 +55,24 @@ def get_native():
     _tried = True
     if os.environ.get("PATHWAY_NO_NATIVE"):
         return None
+    # stale-cache guard: rebuild when the source is newer than the .so
+    # (a cached kernel from an older source must not mask new entry
+    # points). The rebuild goes via a temp file, so a box without g++
+    # keeps its working cached kernel — callers feature-check new entry
+    # points with hasattr.
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(os.path.dirname(pkg_dir), "native", "pathway_native.cc")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    target = os.path.join(pkg_dir, "_native" + suffix)
+    try:
+        if (
+            os.path.exists(src)
+            and os.path.exists(target)
+            and os.path.getmtime(src) > os.path.getmtime(target)
+        ):
+            _build()
+    except OSError:
+        pass
     try:
         from pathway_tpu import _native as mod  # type: ignore[attr-defined]
     except ImportError:
