@@ -1,0 +1,184 @@
+"""Cross-process exec wrappers: keyed state spanning engine processes.
+
+Stateful operators exchange rows to the process owning their key before
+doing stateful work, exactly like the reference's Exchange pact over
+timely's TCP mesh (reference: src/engine/dataflow/operators.rs:128,432;
+external/timely-dataflow/communication/src/networking.rs:16-33). Rows are
+routed by the low shard bits of the group/join key hash
+(src/engine/value.rs:38 SHARD_MASK), so each process's inner exec holds a
+disjoint key range; within a process the inner exec may further shard
+over the device mesh (engine/sharded.py). Every process calls process()
+for every node at every lockstep tick (runtime.py), so each (channel,
+tick, src->dst) pair carries exactly one message — possibly an empty
+partition — and gather() knows exactly how many to wait for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import NodeExec
+from pathway_tpu.engine.sharded import shard_of
+
+
+class _DcnRouter:
+    """Partition batches by owning process and swap partitions over the
+    host mesh; merge arrivals in process-id order (deterministic)."""
+
+    def __init__(self, channel: str):
+        from pathway_tpu.parallel.host_exchange import get_host_mesh
+
+        self.mesh = get_host_mesh()
+        self.channel = channel
+        self.n = self.mesh.n
+        self.pid = self.mesh.pid
+        self.exchanges = 0  # observability, mirrors _ShardRouter counter
+
+    def partition(
+        self, batches: Sequence[DiffBatch], dests_fn
+    ) -> list[list[DiffBatch]]:
+        parts: list[list[DiffBatch]] = [[] for _ in range(self.n)]
+        for b in batches:
+            if not len(b):
+                continue
+            dest = dests_fn(b)
+            for p in range(self.n):
+                m = dest == p
+                if m.any():
+                    parts[p].append(b if m.all() else b.mask(m))
+        return parts
+
+    def exchange(
+        self, t: int, parts: list[list[DiffBatch]]
+    ) -> list[DiffBatch]:
+        self.exchanges += 1
+        for p in range(self.n):
+            if p != self.pid:
+                self.mesh.send(p, self.channel, t, parts[p])
+        got = self.mesh.gather(self.channel, t)
+        merged = list(parts[self.pid])
+        for src in sorted(got):
+            merged.extend(got[src])
+        return merged
+
+
+class DcnGroupByExec(NodeExec):
+    """groupby-reduce whose keyed state spans processes: rows go to the
+    process owning their group key; the local exec (possibly device-mesh
+    sharded) reduces its disjoint range (reference: group_by_table after
+    Exchange, src/engine/dataflow.rs:3404)."""
+
+    def __init__(self, node):
+        super().__init__(node)
+        self.inner = node._make_local_exec()
+        self.router = _DcnRouter(f"gb{node.id}")
+        # ticks at or below this time are already covered by restored
+        # state: drop them AFTER the exchange (the exchange itself must
+        # still run so channel/tick pairing stays aligned group-wide) —
+        # the receiver-side half of the reference's "all workers flushed
+        # up to T" consensus (src/persistence/state.rs:291)
+        self.replay_floor = -1
+        # stateless probe for group-key derivation (no rows ever applied)
+        self._probe = (
+            self.inner.shards[0]
+            if hasattr(self.inner, "shards")
+            else self.inner
+        )
+
+    def _gks(self, b: DiffBatch) -> np.ndarray:
+        probe = self._probe
+        simple = not self.node.set_id and probe.inst_idx is None
+        if simple:
+            return np.asarray(probe._group_keys_batch(b), dtype=np.uint64)
+        cols = list(b.columns.values())
+        return np.fromiter(
+            (
+                probe._group_key(tuple(c[i] for c in cols))
+                & 0xFFFFFFFFFFFFFFFF
+                for i in range(len(b))
+            ),
+            dtype=np.uint64,
+            count=len(b),
+        )
+
+    def _dests(self, b: DiffBatch) -> np.ndarray:
+        return shard_of(self._gks(b), self.router.n)
+
+    def process(self, t, inputs):
+        parts = self.router.partition(inputs[0], self._dests)
+        local = self.router.exchange(t, parts)
+        if t <= self.replay_floor:
+            return []  # restored state already covers this tick
+        return self.inner.process(t, [local])
+
+    def owned_group_keys(self) -> set[int]:
+        if hasattr(self.inner, "shard_group_keys"):
+            return set().union(*self.inner.shard_group_keys())
+        return set(self.inner.groups.keys())
+
+    def on_end(self):
+        return self.inner.on_end()
+
+    def state_dict(self):
+        return {"inner": self.inner.state_dict()}
+
+    def load_state(self, state):
+        if state.get("inner"):
+            self.inner.load_state(state["inner"])
+
+
+class DcnJoinExec(NodeExec):
+    """Equijoin whose build/probe state spans processes: both sides route
+    by join-key hash so matches co-locate (reference: join_tables
+    arrange+join_core after Exchange, src/engine/dataflow.rs:2740)."""
+
+    def __init__(self, node):
+        super().__init__(node)
+        self.inner = node._make_local_exec()
+        self.lrouter = _DcnRouter(f"jl{node.id}")
+        self.rrouter = _DcnRouter(f"jr{node.id}")
+        self.replay_floor = -1  # see DcnGroupByExec.replay_floor
+        lcols = node.inputs[0].column_names
+        rcols = node.inputs[1].column_names
+        self._l_on = [lcols.index(c) for c in node.left_on]
+        self._r_on = [rcols.index(c) for c in node.right_on]
+        # probe JoinExec for join-key derivation: the routing hash MUST be
+        # the exact _batch_jks contract the inner exec groups by, or DCN
+        # routing silently diverges from local state
+        self._probe = (
+            self.inner.shards[0]
+            if hasattr(self.inner, "shards")
+            else self.inner
+        )
+
+    def _dests(self, b: DiffBatch, on_idx: list[int]) -> np.ndarray:
+        jks = np.asarray(
+            self._probe._batch_jks(b, on_idx), dtype=np.uint64
+        )
+        return shard_of(jks, self.lrouter.n)
+
+    def process(self, t, inputs):
+        lparts = self.lrouter.partition(
+            inputs[0], lambda b: self._dests(b, self._l_on)
+        )
+        rparts = self.rrouter.partition(
+            inputs[1], lambda b: self._dests(b, self._r_on)
+        )
+        local_l = self.lrouter.exchange(t, lparts)
+        local_r = self.rrouter.exchange(t, rparts)
+        if t <= self.replay_floor:
+            return []  # restored state already covers this tick
+        return self.inner.process(t, [local_l, local_r])
+
+    def on_end(self):
+        return self.inner.on_end()
+
+    def state_dict(self):
+        return {"inner": self.inner.state_dict()}
+
+    def load_state(self, state):
+        if state.get("inner"):
+            self.inner.load_state(state["inner"])

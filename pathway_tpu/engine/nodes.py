@@ -314,7 +314,7 @@ class GroupByNode(Node):
         self.set_id = set_id
         self.sort_by = sort_by
 
-    def make_exec(self):
+    def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
 
         em = get_engine_mesh()
@@ -323,6 +323,13 @@ class GroupByNode(Node):
 
             return ShardedGroupByExec(self, em[0], em[1])
         return GroupByExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnGroupByExec
+
+            return DcnGroupByExec(self)
+        return self._make_local_exec()
 
 
 class _GroupState:
@@ -636,7 +643,7 @@ class JoinNode(Node):
         self.mode = mode
         self.id_from = id_from
 
-    def make_exec(self):
+    def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
 
         em = get_engine_mesh()
@@ -645,6 +652,13 @@ class JoinNode(Node):
 
             return ShardedJoinExec(self, em[0], em[1])
         return JoinExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnJoinExec
+
+            return DcnJoinExec(self)
+        return self._make_local_exec()
 
 
 class _SideState:

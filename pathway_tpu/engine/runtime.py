@@ -261,9 +261,28 @@ class Runtime:
         autocommit_ms: int = 50,
         on_tick: Callable[[int], None] | None = None,
         worker_threads: bool = True,
+        distributed: bool | None = None,
     ):
         self.order = collect_nodes(outputs)
         annotate_live_columns(self.order)
+        # multi-process engine (DCN rung): stateful sharded execs exchange
+        # host rows over the TCP mesh and ticks run in lockstep across the
+        # process group (reference: timely workers over the TCP mesh,
+        # src/engine/dataflow/config.rs:88-121). Inner runtimes (iterate,
+        # interactive) pass distributed=False — they must not join the
+        # group's barrier cadence.
+        from pathway_tpu.parallel.host_exchange import dcn_active
+
+        self.dcn = dcn_active() if distributed is None else (
+            distributed and dcn_active()
+        )
+        self.host_mesh = None
+        if self.dcn:
+            from pathway_tpu.parallel.host_exchange import get_host_mesh
+
+            self.host_mesh = get_host_mesh()
+        for node in self.order:
+            node._dcn = self.dcn
         self.execs: dict[int, NodeExec] = {
             node.id: node.make_exec() for node in self.order
         }
@@ -413,7 +432,11 @@ class Runtime:
 
     def run_static(self) -> None:
         """Run all static sources to completion, merging events by time
-        (deterministic 'batch mode' — reference PersistenceMode::Batch)."""
+        (deterministic 'batch mode' — reference PersistenceMode::Batch).
+        Multi-process: tick times are agreed by a min-barrier over the host
+        mesh, so every process ticks the same logical times in lockstep —
+        DCN execs then exchange exactly one partition per (channel, tick,
+        peer) and the barrier doubles as the frontier consensus."""
         events: list[tuple[int, int, DiffBatch]] = []  # (time, node_id, batch)
         for node in self.order:
             if isinstance(node, InputNode) and isinstance(
@@ -424,13 +447,28 @@ class Runtime:
         events.sort(key=lambda e: e[0])
         i = 0
         n = len(events)
-        while i < n:
-            t = events[i][0]
-            injected: dict[int, list[DiffBatch]] = {}
+        if self.host_mesh is None:
+            while i < n:
+                t = events[i][0]
+                injected: dict[int, list[DiffBatch]] = {}
+                while i < n and events[i][0] == t:
+                    injected.setdefault(events[i][1], []).append(events[i][2])
+                    i += 1
+                self.tick(t, injected)
+            self.tick(END_OF_TIME)
+            return
+        while True:
+            local_next = events[i][0] if i < n else END_OF_TIME
+            vals = self.host_mesh.barrier(("tick", local_next))
+            t = min(v[1] for v in vals.values())
+            if t >= END_OF_TIME:
+                break
+            injected = {}
             while i < n and events[i][0] == t:
                 injected.setdefault(events[i][1], []).append(events[i][2])
                 i += 1
             self.tick(t, injected)
+            self.global_frontier = t
         self.tick(END_OF_TIME)
 
     # --- streaming run --------------------------------------------------------
@@ -451,6 +489,9 @@ class Runtime:
                         static_events.append((t, node.id, batch))
         for _node, src in sources:
             src.start()
+        if self.host_mesh is not None:
+            self._run_streaming_lockstep(sources, static_events)
+            return
         # feed all static data at the first tick
         last_t = 0
         if static_events:
@@ -479,6 +520,68 @@ class Runtime:
                 last_t = t
                 self.tick(t, injected)
             if all_done and not any_data:
+                break
+        for _node, src in sources:
+            src.stop()
+        self.tick(END_OF_TIME)
+
+    def _run_streaming_lockstep(self, sources, static_events) -> None:
+        """Streaming loop for the multi-process engine: every autocommit
+        interval the group exchanges (proposed time, has-data, all-done)
+        over the host mesh; if anyone has data, EVERY process ticks at the
+        min proposed time (possibly with empty input), so DCN exchanges
+        and the per-tick frontier stay aligned. Termination needs group
+        consensus: all sources finished everywhere and no data in flight."""
+        first_static: dict[int, list[DiffBatch]] | None = None
+        if static_events:
+            first_static = {}
+            for _t, nid, batch in static_events:
+                first_static.setdefault(nid, []).append(batch)
+        last_t = 0
+        while True:
+            if first_static is None:
+                self._wake.wait(timeout=self.autocommit_ms / 1000.0)
+                self._wake.clear()
+            injected: dict[int, list[DiffBatch]] = (
+                first_static if first_static is not None else {}
+            )
+            any_data = bool(injected)
+            all_done = True
+            for node, src in sources:
+                rows = src.session.drain()
+                if rows:
+                    any_data = True
+                    injected.setdefault(node.id, []).append(
+                        DiffBatch.from_rows(rows, src.column_names)
+                    )
+                if not src.session.finished:
+                    all_done = False
+            first_static = None
+            # stop() must be group-coordinated: a process leaving the
+            # lockstep cadence unilaterally would strand peers at their
+            # next gather. Any process's stop request stops the group at
+            # this round, BEFORE the tick, so the final END tick pairs up.
+            vals = self.host_mesh.barrier(
+                (
+                    "stream",
+                    self._now_ms(),
+                    any_data,
+                    all_done,
+                    self._stop.is_set(),
+                )
+            )
+            group_stop = any(v[4] for v in vals.values())
+            group_any = any(v[2] for v in vals.values())
+            group_done = all(v[3] for v in vals.values())
+            if group_any:
+                # rows already drained from sessions advanced their offset
+                # markers — they must be ticked (and so logged) even when
+                # stopping, or a post-restart seek would skip them
+                t = max(min(v[1] for v in vals.values()), last_t + 2)
+                last_t = t
+                self.tick(t, injected)
+                self.global_frontier = t
+            if group_stop or (group_done and not group_any):
                 break
         for _node, src in sources:
             src.stop()

@@ -71,7 +71,7 @@ class LiveTable:
         # not run as a side effect of peeking at a table
         node = OutputNode(self._table._node, self._on_batch)
         G = parse_graph.G
-        self._runtime = Runtime([node], autocommit_ms=50)
+        self._runtime = Runtime([node], autocommit_ms=50, distributed=False)
         G.last_runtime = self._runtime
 
         def run():

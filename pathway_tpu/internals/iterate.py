@@ -87,7 +87,7 @@ class IterateExec(NodeExec):
             outputs.append(OutputNode(rnode, make_cb(name)))
         # nested per-iteration runtimes are driven via tick() directly and
         # would leak one thread pool per fixpoint iteration
-        rt = Runtime(outputs, worker_threads=False)
+        rt = Runtime(outputs, worker_threads=False, distributed=False)
         injected: dict[int, list[DiffBatch]] = {}
         for ph, name in zip(node.placeholder_nodes, node.iterated_names):
             rows = [(k, 1, v) for k, v in current[name].items()]

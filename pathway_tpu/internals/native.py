@@ -25,7 +25,9 @@ def _build() -> bool:
         return False
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     target = os.path.join(pkg_dir, "_native" + suffix)
-    tmp = target + ".tmp"
+    # per-process tmp: N processes of one spawn group may rebuild
+    # concurrently — a shared tmp path would interleave linker writes
+    tmp = f"{target}.{os.getpid()}.tmp"
     include = sysconfig.get_paths()["include"]
     cmd = [
         "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",

@@ -41,10 +41,19 @@ def run(
     if not seeds:
         return
     # join the process group when `pathway spawn -n N` launched us
-    # (reference env contract PATHWAY_PROCESSES/PROCESS_ID, config.rs:88)
-    from pathway_tpu.parallel.distributed import maybe_initialize
+    # (reference env contract PATHWAY_PROCESSES/PROCESS_ID, config.rs:88).
+    # The engine's multi-process transport is the host mesh (TCP, DCN
+    # rung) — the Runtime joins it itself; the jax.distributed device
+    # group is only needed for cross-process device collectives (sharded
+    # KNN/embed) and is joined when PATHWAY_JAX_DISTRIBUTED=1.
+    import os as _os
 
-    maybe_initialize()
+    from pathway_tpu.parallel.host_exchange import dcn_active
+
+    if not dcn_active() or _os.environ.get("PATHWAY_JAX_DISTRIBUTED") == "1":
+        from pathway_tpu.parallel.distributed import maybe_initialize
+
+        maybe_initialize()
     runtime = Runtime(seeds, autocommit_ms=autocommit_duration_ms)
     G.runtime = runtime
     G.last_runtime = runtime

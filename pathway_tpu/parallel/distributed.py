@@ -10,12 +10,15 @@ TPU-native equivalent is one JAX process per host joined through
 device set, meshes span hosts, and XLA collectives ride ICI within a slice
 and DCN across slices — no hand-rolled socket protocol.
 
-What runs multi-process today: device-resident data parallelism — corpus
+What runs multi-process: device-resident data parallelism — corpus
 sharding for the KNN/retrieval path (`sharded_topk_global`), embed batch
-sharding, and the per-tick frontier consensus (engine/runtime.py) which
-doubles as the cross-process tick barrier. Host-side keyed engine state
-remains per-process (the engine's mesh sharding stays within one process);
-routing arbitrary host rows across processes in lockstep is the next rung.
+sharding — on the jax.distributed device group (this module), and host-
+side keyed engine state spanning processes over the TCP host mesh
+(parallel/host_exchange.py + engine/dcn.py): groupby/join state is
+key-sharded across the process group with lockstep barrier-scheduled
+ticks and group-consistent persistence. The device group is joined when
+PATHWAY_JAX_DISTRIBUTED=1; the host mesh joins whenever
+PATHWAY_PROCESSES > 1.
 """
 
 from __future__ import annotations

@@ -1,0 +1,283 @@
+"""Cross-process host-row exchange — the DCN rung of the comm backend.
+
+The reference moves *all* dataflow records between worker processes over
+timely's TCP mesh (reference:
+external/timely-dataflow/communication/src/networking.rs:16-33 — one
+framed socket per process pair, handshake magic + peer id;
+src/engine/dataflow/config.rs:88-121 — PATHWAY_PROCESSES/PROCESS_ID/
+FIRST_PORT env contract). The TPU-native split keeps *device* data on XLA
+collectives (ICI) and gives *host* keyed rows this mesh: every process
+pair holds a framed TCP connection, DiffBatch partitions travel pickled,
+and a value-exchange barrier doubles as the lockstep tick scheduler (the
+frontier consensus of timely's progress tracking).
+
+Fail-stop: a dead peer surfaces as HostMeshError at the next gather or
+barrier; the job exits nonzero and the supervisor restarts the whole
+process group from persisted state — exactly the reference's recovery
+model (whole-cluster restart from the persisted frontier,
+src/persistence/state.rs:291).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+_HELLO_MAGIC = b"PWHX1"  # protocol version tag (networking.rs handshake analog)
+
+
+class HostMeshError(RuntimeError):
+    pass
+
+
+def process_env() -> tuple[int, int, int, str]:
+    """(n_processes, process_id, base_port, host) from the reference env
+    contract."""
+    n = int(os.environ.get("PATHWAY_PROCESSES", "1") or 1)
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
+    port = int(os.environ.get("PATHWAY_DCN_PORT", "10700") or 10700)
+    host = os.environ.get("PATHWAY_DCN_HOST", "127.0.0.1")
+    return n, pid, port, host
+
+
+class HostMesh:
+    """Full TCP mesh between N engine processes.
+
+    Each process listens on base_port+pid and dials every peer; the dialing
+    side sends a hello frame carrying its process id, so each ordered pair
+    (src -> dst) has exactly one connection used for src's sends. Frames
+    are length-prefixed pickles:
+
+      ("data", src, channel, tick, payload)   — DiffBatch partitions
+      ("bar",  src, round, value)             — barrier value exchange
+    """
+
+    def __init__(
+        self,
+        n: int,
+        pid: int,
+        base_port: int,
+        host: str = "127.0.0.1",
+        connect_timeout: float = 60.0,
+    ):
+        self.n = n
+        self.pid = pid
+        self.base_port = base_port
+        self.host = host
+        self._cv = threading.Condition()
+        # (channel, tick) -> {src: payload}
+        self._data: dict[tuple[str, int], dict[int, Any]] = {}
+        # round -> {src: value}
+        self._bars: dict[int, dict[int, Any]] = {}
+        self._round = 0
+        self._dead: set[int] = set()
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._out: dict[int, socket.socket] = {}
+        self._closed = False
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, base_port + pid))
+        self._listener.listen(n)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+        deadline = time.time() + connect_timeout
+        for peer in range(n):
+            if peer == pid:
+                continue
+            self._out[peer] = self._dial(peer, deadline)
+            self._send_locks[peer] = threading.Lock()
+
+    # --- wiring -----------------------------------------------------------
+
+    def _dial(self, peer: int, deadline: float) -> socket.socket:
+        last_err: Exception | None = None
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(
+                    (self.host, self.base_port + peer), timeout=5.0
+                )
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                s.sendall(_HELLO_MAGIC + struct.pack("<i", self.pid))
+                return s
+            except OSError as e:
+                last_err = e
+                time.sleep(0.1)
+        raise HostMeshError(
+            f"process {self.pid}: could not reach peer {peer} at "
+            f"{self.host}:{self.base_port + peer} ({last_err})"
+        )
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._reader, args=(conn,), daemon=True
+            ).start()
+
+    def _read_exact(self, conn: socket.socket, count: int) -> bytes | None:
+        buf = b""
+        while len(buf) < count:
+            chunk = conn.recv(count - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _reader(self, conn: socket.socket) -> None:
+        src = -1
+        try:
+            hello = self._read_exact(conn, len(_HELLO_MAGIC) + 4)
+            if hello is None or hello[: len(_HELLO_MAGIC)] != _HELLO_MAGIC:
+                conn.close()
+                return
+            src = struct.unpack("<i", hello[len(_HELLO_MAGIC) :])[0]
+            while True:
+                head = self._read_exact(conn, 4)
+                if head is None:
+                    break
+                (length,) = struct.unpack("<I", head)
+                body = self._read_exact(conn, length)
+                if body is None:
+                    break
+                frame = pickle.loads(body)
+                kind = frame[0]
+                with self._cv:
+                    if kind == "data":
+                        _k, fsrc, channel, tick, payload = frame
+                        self._data.setdefault((channel, tick), {})[
+                            fsrc
+                        ] = payload
+                    elif kind == "bar":
+                        _k, fsrc, rnd, value = frame
+                        self._bars.setdefault(rnd, {})[fsrc] = value
+                    self._cv.notify_all()
+        except OSError:
+            pass
+        finally:
+            conn.close()
+            if src >= 0:
+                with self._cv:
+                    self._dead.add(src)
+                    self._cv.notify_all()
+
+    # --- send/recv --------------------------------------------------------
+
+    def _send_frame(self, dst: int, frame: tuple) -> None:
+        body = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+        msg = struct.pack("<I", len(body)) + body
+        try:
+            with self._send_locks[dst]:
+                self._out[dst].sendall(msg)
+        except OSError as e:
+            raise HostMeshError(
+                f"process {self.pid}: send to peer {dst} failed ({e})"
+            ) from e
+
+    def send(self, dst: int, channel: str, tick: int, payload: Any) -> None:
+        self._send_frame(dst, ("data", self.pid, channel, tick, payload))
+
+    def gather(
+        self, channel: str, tick: int, timeout: float = 300.0
+    ) -> dict[int, Any]:
+        """Wait for one payload from every other process on (channel, tick)."""
+        want = self.n - 1
+        deadline = time.time() + timeout
+        key = (channel, tick)
+        with self._cv:
+            while True:
+                got = self._data.get(key, {})
+                if len(got) >= want:
+                    return self._data.pop(key)
+                if self._dead:
+                    missing = set(range(self.n)) - {self.pid} - set(got)
+                    if missing & self._dead:
+                        raise HostMeshError(
+                            f"process {self.pid}: peer(s) "
+                            f"{sorted(missing & self._dead)} died before "
+                            f"delivering {channel}@{tick}"
+                        )
+                left = deadline - time.time()
+                if left <= 0:
+                    raise HostMeshError(
+                        f"process {self.pid}: timeout waiting for "
+                        f"{channel}@{tick} (have {sorted(got)})"
+                    )
+                self._cv.wait(timeout=min(left, 1.0))
+
+    def barrier(self, value: Any, timeout: float = 300.0) -> dict[int, Any]:
+        """Exchange `value` with every process; returns {pid: value} for all
+        N processes (including self). Must be called in lockstep — the
+        internal round counter is the channel."""
+        rnd = self._round
+        self._round += 1
+        for peer in range(self.n):
+            if peer != self.pid:
+                self._send_frame(peer, ("bar", self.pid, rnd, value))
+        want = self.n - 1
+        deadline = time.time() + timeout
+        with self._cv:
+            while True:
+                got = self._bars.get(rnd, {})
+                if len(got) >= want:
+                    out = self._bars.pop(rnd)
+                    out[self.pid] = value
+                    return out
+                if self._dead:
+                    missing = set(range(self.n)) - {self.pid} - set(got)
+                    if missing & self._dead:
+                        raise HostMeshError(
+                            f"process {self.pid}: peer(s) "
+                            f"{sorted(missing & self._dead)} died at "
+                            f"barrier {rnd}"
+                        )
+                left = deadline - time.time()
+                if left <= 0:
+                    raise HostMeshError(
+                        f"process {self.pid}: timeout at barrier {rnd}"
+                    )
+                self._cv.wait(timeout=min(left, 1.0))
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in self._out.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+_mesh: HostMesh | None = None
+_mesh_lock = threading.Lock()
+
+
+def dcn_active() -> bool:
+    """True when this process is part of a multi-process engine group."""
+    n, _pid, _port, _host = process_env()
+    return n > 1 and os.environ.get("PATHWAY_DCN", "1") != "0"
+
+
+def get_host_mesh() -> HostMesh:
+    """Process-wide mesh singleton (daemon threads live for the process)."""
+    global _mesh
+    with _mesh_lock:
+        if _mesh is None:
+            n, pid, port, host = process_env()
+            if n <= 1:
+                raise HostMeshError("PATHWAY_PROCESSES must be > 1")
+            _mesh = HostMesh(n, pid, port, host)
+        return _mesh

@@ -146,6 +146,9 @@ class PersistenceDriver:
                 self.snapshot_operators = False
         self.replayed_events = 0  # observability: bounded-replay assertions
         self.restored_from_snapshot = False
+        # multi-process: lockstep tick counter driving group-safe snapshot
+        # points (identical on every process — ticks are barrier-agreed)
+        self._ticks_seen = 0
         # set when the latest snapshot attempt aborted on an unpicklable
         # exec ("<class>#<ordinal>"); also mirrored into metadata
         self.degraded_snapshot: str | None = None
@@ -205,6 +208,26 @@ class PersistenceDriver:
         return out
 
     def on_tick(self, t: int, injected: dict[int, list[DiffBatch]] | None = None):
+        hm = getattr(self.runtime, "host_mesh", None)
+        if (
+            hm is not None
+            and self.record
+            and self.snapshot_operators
+            and t < END_OF_TIME
+        ):
+            # group-safe snapshot point: we are at the HEAD of a lockstep
+            # tick, so the barrier that scheduled it has confirmed every
+            # peer completed (and, with per-tick commits below, durably
+            # logged) the previous tick. State captured here can never run
+            # ahead of any peer's durable input log — the reference's
+            # "all workers flushed up to T" consensus
+            # (src/persistence/state.rs:291) realized on the tick barrier.
+            if (
+                self._ticks_seen > 0
+                and self._ticks_seen % self.snapshot_every == 0
+            ):
+                self.commit(snapshot=True)
+            self._ticks_seen += 1
         self._orig_tick(t, injected)
         if not self.record:
             # selective mode snapshots named operators on shutdown AND on
@@ -247,11 +270,17 @@ class PersistenceDriver:
         import time as _time
 
         now = _time.monotonic()
-        if (now - self._last_commit_wall) * 1000.0 >= self.snapshot_interval_ms:
+        if hm is not None:
+            # multi-process: the input log must be durable before the next
+            # barrier round lets any peer snapshot state derived from this
+            # tick's exchanged rows — commit every tick
+            self._last_commit_wall = now
+            self.commit()
+        elif (now - self._last_commit_wall) * 1000.0 >= self.snapshot_interval_ms:
             self._last_commit_wall = now
             self.commit()
 
-    def commit(self, final: bool = False) -> None:
+    def commit(self, final: bool = False, snapshot: bool = False) -> None:
         """Atomically advance the durable frontier: flush pending log chunks,
         snapshot source offsets (and, periodically, every operator's state),
         then write metadata last (metadata names exactly the chunks +
@@ -295,10 +324,16 @@ class PersistenceDriver:
                 offsets_changed = True
         snap = None
         self._commits_since_snapshot += 1
+        dcn = getattr(self.runtime, "host_mesh", None) is not None
         if self.snapshot_operators and (
             final  # clean shutdown always snapshots: restarts restore
             # accumulator state (deduplicate) even for short runs
-            or (wrote and self._commits_since_snapshot >= self.snapshot_every)
+            or snapshot  # explicit group-safe snapshot point (on_tick)
+            or (
+                not dcn  # multi-process snapshots ONLY at group-safe points
+                and wrote
+                and self._commits_since_snapshot >= self.snapshot_every
+            )
         ):
             snap = self._snapshot_operators(meta)
         if wrote or offsets_changed or final or snap:
@@ -306,6 +341,19 @@ class PersistenceDriver:
             meta["live_chunks"] = self._live_chunks
             meta["last_time"] = max(meta.get("last_time", 0), self._last_real_time)
             if snap:
+                if dcn:
+                    # multi-process: retain the PREVIOUS generation (state
+                    # + the chunks between the two snapshots). Snapshot
+                    # points are lockstep-aligned, so generation skew
+                    # across a crash is at most one; restart restores the
+                    # group-min generation, which is always retained
+                    # (reference: consistent frontier across workers,
+                    # src/persistence/state.rs:291)
+                    if meta.get("state"):
+                        meta["prev_state"] = meta["state"]
+                    meta["prev_chunks"] = {
+                        pid: list(v) for pid, v in self._live_chunks.items()
+                    }
                 meta["state"] = snap
                 meta["live_chunks"] = self._live_chunks = {
                     pid: [] for pid in self._live_chunks
@@ -373,7 +421,26 @@ class PersistenceDriver:
 
     def _gc(self, meta: dict, snap: dict) -> None:
         """After the metadata naming the new generation is durable, delete
-        the input chunks the snapshot covers and older state generations."""
+        the input chunks the snapshot covers and older state generations.
+        Multi-process keeps one extra generation (state + the inter-
+        snapshot chunks) so a restart can restore the group-min time."""
+        if getattr(self.runtime, "host_mesh", None) is not None:
+            keep_inputs = {
+                f"inputs/{pid}/chunk-{i:08d}.pkl"
+                for pid, ids in meta.get("prev_chunks", {}).items()
+                for i in ids
+            }
+            for key in self.store.list_keys("inputs/"):
+                if key not in keep_inputs:
+                    self.store.remove(key)
+            keep = {f"states/gen-{snap['gen']:06d}/"}
+            prev = meta.get("prev_state")
+            if prev:
+                keep.add(f"states/gen-{int(prev['gen']):06d}/")
+            for key in self.store.list_keys("states/"):
+                if not any(key.startswith(p) for p in keep):
+                    self.store.remove(key)
+            return
         for key in self.store.list_keys("inputs/"):
             self.store.remove(key)
         prefix = f"states/gen-{snap['gen']:06d}/"
@@ -394,44 +461,110 @@ class PersistenceDriver:
         }
         if not self.replay_allowed:
             return
+        # multi-process: replay ticks must run in lockstep like live ticks
+        # (DCN exchanges pair by (channel, tick) group-wide), and the
+        # whole group must restore at ONE agreed time — a process whose
+        # snapshot is newer than a peer's would otherwise skip replaying
+        # logged rows the peer's state still needs. Snapshot generations
+        # are lockstep-aligned with skew <= 1, and commit() retains the
+        # previous generation, so the group-min time is always locally
+        # restorable (the reference's cross-worker flushed-frontier
+        # consensus, src/persistence/state.rs:291).
+        hm = getattr(self.runtime, "host_mesh", None)
         state_time = -1  # -1 = no snapshot: replay everything incl. t=0
-        snap = meta.get("state")
-        if snap:
-            state_time = self._restore_operators(snap)
-            if any(
-                getattr(ex, "_restore_emit", None)
-                for ex in self.runtime.execs.values()
-            ):
-                # flush restored-accumulator re-emissions at the run's
-                # INITIAL time, before any log-tail replay at later times —
-                # otherwise the emission timestamp would be whatever data
-                # tick happens to run first
-                self._orig_tick(0, None)
+        if hm is None:
+            snap = meta.get("state")
+            if snap:
+                state_time = self._restore_operators(snap)
+        else:
+            latest = meta.get("state")
+            prev = meta.get("prev_state")
+            latest_time = int(latest.get("time", 0)) if latest else -1
+            vals = hm.barrier(("replay-gen", latest_time))
+            group_time = min(v[1] for v in vals.values())
+            chosen = None
+            if group_time >= 0:
+                if latest and int(latest.get("time", 0)) <= group_time:
+                    chosen = latest
+                elif prev and int(prev.get("time", 0)) <= group_time:
+                    chosen = prev
+            if chosen is not None:
+                state_time = self._restore_operators(chosen)
+        # receiver-side floor: drop exchanged partitions already covered
+        # by this process's restored state
+        if hm is not None and state_time >= 0:
+            for ex in self.runtime.execs.values():
+                if hasattr(ex, "replay_floor"):
+                    ex.replay_floor = state_time
+        # sender-side floor must be the GROUP minimum: rows this process
+        # logged may route to a peer restored at an older time (e.g. a
+        # structural-mismatch fallback on one process)
+        if hm is not None:
+            vals = hm.barrier(("replay-floor", state_time))
+            group_floor = min(v[1] for v in vals.values())
+        else:
+            group_floor = state_time
+        need_emit = any(
+            getattr(ex, "_restore_emit", None)
+            for ex in self.runtime.execs.values()
+        )
+        if hm is not None:
+            vals = hm.barrier(("replay-emit", need_emit))
+            need_emit = any(v[1] for v in vals.values())
+        if need_emit:
+            # flush restored-accumulator re-emissions at the run's
+            # INITIAL time, before any log-tail replay at later times —
+            # otherwise the emission timestamp would be whatever data
+            # tick happens to run first
+            self._orig_tick(0, None)
         events: list[tuple[int, int, DiffBatch]] = []  # (time, node_id, batch)
         for pid, node in self.inputs.items():
             chunk_ids = self._live_chunks.get(pid)
             if chunk_ids is None:  # pre-compaction metadata: contiguous
                 chunk_ids = list(range(meta.get("chunks", {}).get(pid, 0)))
+            if hm is not None:
+                # previous-generation chunks too: they cover the span
+                # between the retained generations, needed when the group
+                # restores the older one
+                chunk_ids = list(
+                    dict.fromkeys(
+                        list(meta.get("prev_chunks", {}).get(pid, []))
+                        + list(chunk_ids)
+                    )
+                )
             for i in chunk_ids:
                 raw = self.store.get(f"inputs/{pid}/chunk-{i:08d}.pkl")
                 if raw is None:
                     continue
                 for t, rows in pickle.loads(raw):
-                    if t <= state_time:
-                        continue  # covered by the operator snapshot
+                    if t <= group_floor:
+                        continue  # covered by every process's state
                     events.append(
                         (t, node.id, DiffBatch.from_rows(rows, node.column_names))
                     )
         self.replayed_events = len(events)
         events.sort(key=lambda e: e[0])
         i, n = 0, len(events)
-        while i < n:
-            t = events[i][0]
-            injected: dict[int, list[DiffBatch]] = {}
-            while i < n and events[i][0] == t:
-                injected.setdefault(events[i][1], []).append(events[i][2])
-                i += 1
-            self._orig_tick(t, injected)
+        if hm is None:
+            while i < n:
+                t = events[i][0]
+                injected: dict[int, list[DiffBatch]] = {}
+                while i < n and events[i][0] == t:
+                    injected.setdefault(events[i][1], []).append(events[i][2])
+                    i += 1
+                self._orig_tick(t, injected)
+        else:
+            while True:
+                local_next = events[i][0] if i < n else END_OF_TIME
+                vals = hm.barrier(("replay", local_next))
+                t = min(v[1] for v in vals.values())
+                if t >= END_OF_TIME:
+                    break
+                injected = {}
+                while i < n and events[i][0] == t:
+                    injected.setdefault(events[i][1], []).append(events[i][2])
+                    i += 1
+                self._orig_tick(t, injected)
         # restore offsets so live sources continue past what was replayed
         for pid, node in () if self.selective else self.inputs.items():
             raw = self.store.get(f"offsets/{pid}.pkl")
