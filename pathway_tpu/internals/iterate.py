@@ -1,12 +1,27 @@
 """pw.iterate — fixed-point iteration
 (reference: internals/common.py:39 pw.iterate; engine iterate,
-src/engine/dataflow.rs:4185).
+src/engine/dataflow.rs:4185-4282).
 
-TPU-engine strategy: instead of differential's nested product-order scopes,
-each outer tick recomputes the fixpoint over full input snapshots by running
-the iteration body subgraph repeatedly (bounded by ``iteration_limit``), then
-emits the diff vs the previously emitted fixpoint. Inner iteration is
-batch-synchronous — the microbatch analog of `Variable` feedback loops.
+TPU-engine strategy: a persistent inner runtime PER ITERATION DEPTH, fed
+by DIFF batches — the microbatch realization of differential's nested
+product-order scopes ((outer time, iteration) lexicographic). Depth-i's
+runtime holds the incremental state of the i-th body application; an
+outer delta touching d rows flows down the depth chain as diff batches,
+costing O(d · depths-reached) instead of O(n · iters) per tick:
+
+  - per-depth consumed-pointer logs (xlog/blog) let a depth that was
+    skipped on earlier ticks (early convergence) catch up with exactly
+    the accumulated diffs when a later tick reaches it;
+  - fixpoint detection is incremental: neq[i] tracks the keys where
+    X_i != X_{i-1}, updated only for keys touched this tick — all-empty
+    means the sequence is self-converged at depth i;
+  - if the incoming diff dies out at depth i (nothing to inject and the
+    depth's cache matches), every deeper value is unchanged from the
+    previous tick, so the previous fixpoint stands (emit nothing).
+
+The outer input state is still mirrored in MultisetStates so persistence
+can snapshot the exec (inner runtimes are not picklable); on restore the
+depth chain reseeds from the full snapshot on the next tick.
 """
 
 from __future__ import annotations
@@ -15,7 +30,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from pathway_tpu.engine.batch import DiffBatch, MultisetState
+from pathway_tpu.engine.batch import DiffBatch, MultisetState, _values_eq
 from pathway_tpu.engine.nodes import InputExec, InputNode, Node, NodeExec, OutputNode
 from pathway_tpu.engine.runtime import Runtime, StaticSource
 from pathway_tpu.internals import dtype as dt
@@ -54,27 +69,25 @@ class IterateNode(Node):
         return IterateExec(self)
 
 
-class IterateExec(NodeExec):
-    def __init__(self, node: IterateNode):
-        super().__init__(node)
-        self.states = [
-            MultisetState(inp.column_names) for inp in node.inputs
-        ]
-        self.emitted: dict[int, tuple] = {}
+class _Depth:
+    """One iteration depth: a persistent inner runtime computing the
+    (i+1)-th sequence element from diffs of the i-th, plus its bookkeeping
+    (consumed-log pointers, captured output value, this-tick deltas)."""
 
-    def _run_body(
-        self,
-        current: dict[str, dict[int, tuple]],
-        boundary: list[dict[int, tuple]],
-    ) -> dict[str, dict[int, tuple]]:
-        """One application of the iteration body over full snapshots."""
-        node = self.node
-        captures: dict[str, dict[int, tuple]] = {name: {} for name in node.result_nodes}
+    def __init__(self, node: IterateNode):
+        self.node = node
+        # captured CURRENT value of every result table at this depth
+        self.value: dict[str, dict[int, tuple]] = {
+            name: {} for name in node.result_nodes
+        }
+        # diffs captured during the current tick() only
+        self.tick_out: dict[str, list[DiffBatch]] = {}
         outputs = []
 
         def make_cb(name):
             def cb(t, batch: DiffBatch):
-                store = captures[name]
+                self.tick_out.setdefault(name, []).append(batch)
+                store = self.value[name]
                 for k, d, vals in batch.iter_rows():
                     if d > 0:
                         store[k] = vals
@@ -85,60 +98,309 @@ class IterateExec(NodeExec):
 
         for name, rnode in node.result_nodes.items():
             outputs.append(OutputNode(rnode, make_cb(name)))
-        # nested per-iteration runtimes are driven via tick() directly and
-        # would leak one thread pool per fixpoint iteration
-        rt = Runtime(outputs, worker_threads=False, distributed=False)
-        injected: dict[int, list[DiffBatch]] = {}
-        for ph, name in zip(node.placeholder_nodes, node.iterated_names):
-            rows = [(k, 1, v) for k, v in current[name].items()]
-            injected[ph.id] = [DiffBatch.from_rows(rows, ph.column_names)]
-        for proxy, snap in zip(node.boundary_proxies, boundary):
-            rows = [(k, 1, v) for k, v in snap.items()]
-            injected[proxy.id] = [DiffBatch.from_rows(rows, proxy.column_names)]
-        rt.tick(0, injected)
-        rt.tick(1 << 62)  # flush
-        return captures
+        # inner runtimes are driven via tick() directly; no worker pool,
+        # never part of the cross-process lockstep cadence
+        self.runtime = Runtime(outputs, worker_threads=False, distributed=False)
+        # on_end-dependent operators (temporal buffers) cannot live in a
+        # persistent per-depth runtime: there is no final tick to flush
+        # them, so rows would be silently held forever — refuse loudly
+        for ex in self.runtime.execs.values():
+            if type(ex).__name__ in ("BufferExec", "ForgetExec", "FreezeExec"):
+                raise NotImplementedError(
+                    "temporal buffer/forget/freeze operators inside a "
+                    "pw.iterate body are not supported by the incremental "
+                    "iterate engine (no final flush tick inside the "
+                    "fixpoint loop)"
+                )
+        self.time = 0
+        self.xptr: dict[str, int] = {}  # consumed xlog prefix, per name
+        self.bptr = 0  # consumed prefix of the boundary log
+
+    def run(
+        self,
+        x_batches: dict[int, list[DiffBatch]],
+        b_batches: dict[int, list[DiffBatch]],
+    ) -> dict[str, list[DiffBatch]]:
+        self.tick_out = {}
+        injected = dict(x_batches)
+        injected.update(b_batches)
+        self.runtime.tick(self.time, injected)
+        self.time += 1
+        return self.tick_out
+
+
+class IterateExec(NodeExec):
+    def __init__(self, node: IterateNode):
+        super().__init__(node)
+        self.states = [
+            MultisetState(inp.column_names) for inp in node.inputs
+        ]
+        self.emitted: dict[int, tuple] = {}
+        self._depths: list[_Depth] = []
+        # xlog[i] = every diff batch ever produced for sequence element i
+        # (i=0: outer input diffs; i>0: depth i-1 output diffs), so a depth
+        # skipped by early convergence can catch up later via its xptr
+        self._xlog: list[dict[str, list[DiffBatch]]] = []
+        self._blog: list[dict[int, list[DiffBatch]]] = []  # boundary diffs
+        # neq[i][name] = keys where X_i differs from X_{i-1} (all empty =
+        # self-converged at depth i); updated only for touched keys
+        self._neq: list[dict[str, set]] = []
+        self._v0: dict[str, dict[int, tuple]] = {
+            name: {} for name in node.iterated_names
+        }
+        self._final_depth: int | None = None
+        self._needs_reseed = False
+
+    # --- persistence: inner runtimes are rebuilt, not pickled -------------
+
+    def state_dict(self) -> dict | None:
+        return {
+            "states": self.states,
+            "emitted": self.emitted,
+            "_needs_reseed": True,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.states = state["states"]
+        self.emitted = state["emitted"]
+        self._needs_reseed = True
+
+    # --- incremental fixpoint --------------------------------------------
+
+    def _depth(self, i: int) -> _Depth:
+        while len(self._depths) <= i:
+            self._depths.append(_Depth(self.node))
+            self._xlog.append({n: [] for n in self.node.iterated_names})
+            self._neq.append({n: set() for n in self.node.iterated_names})
+        return self._depths[i]
+
+    def _value_at(self, i: int, name: str) -> dict[int, tuple]:
+        """X_i: the i-th sequence element (0 = outer input mirror)."""
+        if i == 0:
+            return self._v0[name]
+        return self._depths[i - 1].value[name]
+
+    def _update_neq(self, i: int, name: str, touched) -> None:
+        """Re-evaluate X_i vs X_{i-1} equality for the touched keys only.
+        Stored in _neq[i-1] (convention: _neq[j] compares X_{j+1} vs X_j,
+        created alongside depth j)."""
+        if i < 1:
+            return
+        self._depth(i - 1)
+        lo = self._value_at(i - 1, name)
+        hi = self._value_at(i, name)
+        neq = self._neq[i - 1][name]
+        for k in touched:
+            a = lo.get(k)
+            b = hi.get(k)
+            if (a is None) != (b is None) or (
+                a is not None and not _values_eq(a, b)
+            ):
+                neq.add(k)
+            else:
+                neq.discard(k)
+
+    def _converged_at(self, i: int) -> bool:
+        """True when X_{i+1} == X_i (depth i's output equals its input)."""
+        return all(not s for s in self._neq[i].values())
 
     def process(self, t, inputs):
-        touched = False
+        node = self.node
+        n_iter = len(node.iterated_names)
+        touched_any = False
         for state, batches in zip(self.states, inputs):
             for b in batches:
                 if len(b):
-                    touched = True
+                    touched_any = True
                 state.apply(b)
-        if not touched:
+        if self._needs_reseed:
+            # after a persistence restore the depth chain is empty: feed
+            # the full mirrored state through it once
+            self._needs_reseed = False
+            self._depths = []
+            self._xlog = []
+            self._blog = []
+            self._neq = []
+            self._v0 = {n: {} for n in node.iterated_names}
+            seed: list[DiffBatch] = []
+            for idx, (name, state) in enumerate(
+                zip(node.iterated_names, self.states[:n_iter])
+            ):
+                rows = [(k, 1, e[0]) for k, e in state.rows.items()]
+                ncols = node.placeholder_nodes[idx].column_names
+                seed.append(DiffBatch.from_rows(rows, ncols))
+            inputs = [[b] for b in seed] + [
+                [
+                    DiffBatch.from_rows(
+                        [(k, 1, e[0]) for k, e in state.rows.items()],
+                        proxy.column_names,
+                    )
+                ]
+                for state, proxy in zip(
+                    self.states[n_iter:], node.boundary_proxies
+                )
+            ]
+            touched_any = True
+        if not touched_any:
             return []
-        node = self.node
-        n_iter = len(node.iterated_names)
-        current: dict[str, dict[int, tuple]] = {}
-        for name, state in zip(node.iterated_names, self.states[:n_iter]):
-            current[name] = {k: e[0] for k, e in state.rows.items()}
-        boundary = [
-            {k: e[0] for k, e in state.rows.items()}
-            for state in self.states[n_iter:]
-        ]
-        limit = node.iteration_limit or 1000
-        for _i in range(limit):
-            result = self._run_body(current, boundary)
-            new = {name: result[name] for name in node.iterated_names}
-            if all(new[name] == current[name] for name in node.iterated_names):
-                current = new
-                break
-            current = new
-        final = result[node.out_name]  # type: ignore[possibly-undefined]
-        from pathway_tpu.engine.batch import _values_eq
 
+        # stage this tick's outer diffs into the logs + the V0 mirror
+        out_touched: set[int] = set()
+        self._depth(0)
+        x0 = self._xlog[0]
+        v0_touched: dict[str, set] = {}
+        for idx, name in enumerate(node.iterated_names):
+            batches = [b for b in inputs[idx] if len(b)]
+            x0[name].extend(batches)
+            mirror = self._v0[name]
+            tk = v0_touched.setdefault(name, set())
+            for b in batches:
+                for k, d, vals in b.iter_rows():
+                    tk.add(k)
+                    if d > 0:
+                        mirror[k] = vals
+                    else:
+                        mirror.pop(k, None)
+        bdiffs: dict[int, list[DiffBatch]] = {}
+        for bidx, proxy in enumerate(node.boundary_proxies):
+            batches = [b for b in inputs[n_iter + bidx] if len(b)]
+            if batches:
+                bdiffs.setdefault(proxy.id, []).extend(batches)
+        self._blog.append(bdiffs)
+        for name, tk in v0_touched.items():
+            self._update_neq(1, name, tk)
+
+        limit = node.iteration_limit or 1000
+        prev_final_depth = self._final_depth
+        converged_depth: int | None = None
+        i = 0
+        # walk the depth chain. Before convergence, new depths are created
+        # as diffs demand them; after convergence, EXISTING deeper depths
+        # are still drained (their backlogs consumed) so every per-depth
+        # log can be truncated each tick — memory stays bounded by one
+        # tick's churn, not total history.
+        while i < limit:
+            if i >= len(self._depths) and converged_depth is not None:
+                break
+            depth = self._depth(i)
+            fresh = depth.time == 0
+            xlog_i = self._xlog[i]
+            x_pending: dict[int, list[DiffBatch]] = {}
+            if fresh:
+                # a fresh depth seeds from the CURRENT value of X_i (the
+                # consolidated equivalent of the full history) instead of
+                # the log — logs can therefore be truncated aggressively
+                for idx, name in enumerate(node.iterated_names):
+                    rows = [
+                        (k, 1, v) for k, v in self._value_at(i, name).items()
+                    ]
+                    ncols = node.placeholder_nodes[idx].column_names
+                    if rows:
+                        x_pending[node.placeholder_nodes[idx].id] = [
+                            DiffBatch.from_rows(rows, ncols)
+                        ]
+                    depth.xptr[name] = len(xlog_i[name])
+                b_pending: dict[int, list[DiffBatch]] = {}
+                for state, proxy in zip(
+                    self.states[n_iter:], node.boundary_proxies
+                ):
+                    rows = [(k, 1, e[0]) for k, e in state.rows.items()]
+                    if rows:
+                        b_pending[proxy.id] = [
+                            DiffBatch.from_rows(rows, proxy.column_names)
+                        ]
+                depth.bptr = len(self._blog)
+            else:
+                for idx, name in enumerate(node.iterated_names):
+                    tail = xlog_i[name][depth.xptr.get(name, 0) :]
+                    if tail:
+                        x_pending[node.placeholder_nodes[idx].id] = tail
+                    depth.xptr[name] = len(xlog_i[name])
+                b_pending = {}
+                for blog_entry in self._blog[depth.bptr :]:
+                    for pid_, bs in blog_entry.items():
+                        b_pending.setdefault(pid_, []).extend(bs)
+                depth.bptr = len(self._blog)
+            if not x_pending and not b_pending:
+                # the delta died out: X_j unchanged from the previous tick
+                # for every j >= i, and no deeper depth has backlog either
+                # (boundary diffs fan out to every depth, X diffs chain
+                # contiguously) — the previous fixpoint stands
+                break
+            out = depth.run(x_pending, b_pending)
+            # record depth output diffs into the next depth's log + neq
+            # (unless this is the last depth we will touch: a fresh depth
+            # created later seeds from the value, which already includes
+            # these diffs)
+            if i + 1 < len(self._depths) or converged_depth is None:
+                self._depth(i + 1)
+                next_log = self._xlog[i + 1]
+            else:
+                next_log = None
+            for name in node.iterated_names:
+                produced = [b for b in out.get(name, []) if len(b)]
+                if next_log is not None:
+                    next_log[name].extend(produced)
+                tk = set()
+                for b in produced:
+                    tk.update(b.keys.tolist())
+                if tk:
+                    self._update_neq(i + 1, name, tk)
+                    if len(self._depths) >= i + 2:
+                        self._update_neq(i + 2, name, tk)
+            for b in out.get(node.out_name, []):
+                out_touched.update(b.keys.tolist())
+            if converged_depth is None and self._converged_at(i):
+                converged_depth = i
+            i += 1
+        if converged_depth is not None:
+            final_depth = converged_depth
+        elif i >= limit:
+            final_depth = limit - 1  # iteration_limit semantics: X_limit
+        else:
+            final_depth = prev_final_depth  # delta died out: unchanged
+        # every existing depth has now consumed its full backlog: truncate
+        # the logs and drop far-beyond-convergence depths (recreated from
+        # value seeds if ever needed again)
+        for d_i, depth in enumerate(self._depths):
+            log = self._xlog[d_i]
+            for name in node.iterated_names:
+                consumed = depth.xptr.get(name, 0)
+                if consumed:
+                    del log[name][:consumed]
+                    depth.xptr[name] = 0
+            depth.bptr = 0
+        self._blog.clear()
+        if final_depth is not None and len(self._depths) > final_depth + 4:
+            del self._depths[final_depth + 4 :]
+            del self._xlog[final_depth + 4 :]
+            del self._neq[final_depth + 4 :]
+        self._final_depth = final_depth
+
+        # emit the fixpoint's delta vs what we last emitted, checking only
+        # keys touched this tick (untouched keys keep their old fixpoint)
+        if final_depth is None:
+            return []
+        final = self._depths[final_depth].value[node.out_name]
+        candidates = set(out_touched)
+        if prev_final_depth != final_depth:
+            # the converged depth moved: values at both depths are the
+            # fixpoints, but re-check everything that differs between them
+            candidates.update(final.keys())
+            candidates.update(self.emitted.keys())
         out_rows = []
-        for k, old in list(self.emitted.items()):
+        for k in candidates:
+            old = self.emitted.get(k)
             neww = final.get(k)
-            if neww is None or not _values_eq(old, neww):
+            if old is not None and (
+                neww is None or not _values_eq(old, neww)
+            ):
                 out_rows.append((k, -1, old))
                 del self.emitted[k]
-        for k, vals in final.items():
-            old = self.emitted.get(k)
-            if old is None:
-                out_rows.append((k, 1, vals))
-                self.emitted[k] = vals
+                old = None
+            if neww is not None and old is None:
+                out_rows.append((k, 1, neww))
+                self.emitted[k] = neww
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, node.column_names)]

@@ -54,6 +54,15 @@ def run(
         from pathway_tpu.parallel.distributed import maybe_initialize
 
         maybe_initialize()
+    else:
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "multi-process engine: host-row exchange active; cross-process "
+            "DEVICE collectives (sharded KNN/embed over jax.distributed) "
+            "are disabled — set PATHWAY_JAX_DISTRIBUTED=1 to join the "
+            "device group as well"
+        )
     runtime = Runtime(seeds, autocommit_ms=autocommit_duration_ms)
     G.runtime = runtime
     G.last_runtime = runtime
