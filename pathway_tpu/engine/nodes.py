@@ -1149,6 +1149,13 @@ class SortNode(Node):
         self.instance_col = instance_col
 
     def make_exec(self):
+        from pathway_tpu.parallel.mesh import get_engine_mesh
+
+        em = get_engine_mesh()
+        if em is not None:
+            from pathway_tpu.engine.sharded import ShardedSortExec
+
+            return ShardedSortExec(self, em[0], em[1])
         return SortExec(self)
 
 
@@ -1720,6 +1727,13 @@ class BufferNode(Node):
         self.flush_on_end = flush_on_end
 
     def make_exec(self):
+        from pathway_tpu.parallel.mesh import get_engine_mesh
+
+        em = get_engine_mesh()
+        if em is not None:
+            from pathway_tpu.engine.sharded import ShardedBufferExec
+
+            return ShardedBufferExec(self, em[0], em[1])
         return BufferExec(self)
 
 

@@ -36,42 +36,101 @@ def shard_of(gks: np.ndarray, n_shards: int) -> np.ndarray:
     )).astype(np.int32)
 
 
-def _batch_numeric_columns(
-    b: DiffBatch,
-) -> list[tuple[np.ndarray, np.dtype]] | None:
-    """(typed view, ORIGINAL dtype) of every value column, or None if any
-    column holds non-numeric payloads (strings/json/tuples stay host-side).
-    The original dtype lets the receiver restore the exact representation
-    the host-partition path would have kept, so both paths feed identical
-    columns downstream."""
+def _pack_scalar_column(col: np.ndarray):
+    """One numeric device array + rebuild spec for a scalar column, or
+    None when ineligible."""
     from pathway_tpu.parallel.exchange import packable
 
-    out: list[tuple[np.ndarray, np.dtype]] = []
-    for col in b.columns.values():
-        orig = col.dtype
-        arr = col
-        if arr.dtype == object:
-            if not len(arr):
-                return None
-            # type-homogeneous python scalars only: a mixed int/float
-            # column would come back type-changed after the round trip
-            # and hash to different group keys than the host path
-            t0 = type(arr[0])
-            if t0 not in (int, float, bool) or not all(
-                type(v) is t0 for v in arr
-            ):
-                return None
-            try:
-                arr = np.asarray(arr.tolist())
-            except (TypeError, ValueError, OverflowError):
-                return None
-        if arr.dtype.kind == "f" and arr.dtype.itemsize < 4:
-            arr = arr.astype(np.float32)
-        if arr.dtype.kind in "iu" and arr.dtype.itemsize < 8:
-            arr = arr.astype(np.int64)
-        if not packable(arr):
+    orig = col.dtype
+    arr = col
+    if arr.dtype == object:
+        # type-homogeneous python scalars only: a mixed int/float
+        # column would come back type-changed after the round trip
+        # and hash to different group keys than the host path
+        t0 = type(arr[0])
+        if t0 not in (int, float, bool) or not all(
+            type(v) is t0 for v in arr
+        ):
             return None
-        out.append((arr, orig))
+        try:
+            arr = np.asarray(arr.tolist())
+        except (TypeError, ValueError, OverflowError):
+            return None
+    if arr.dtype.kind == "f" and arr.dtype.itemsize < 4:
+        arr = arr.astype(np.float32)
+    if arr.dtype.kind in "iu" and arr.dtype.itemsize < 8:
+        arr = arr.astype(np.int64)
+    if not packable(arr):
+        return None
+    return [arr], ("scalar", orig)
+
+
+def _pack_tuple_column(col: np.ndarray):
+    """Fixed-arity tuples of homogeneous numeric scalars decompose into
+    one device array per position (window ids like (instance, start, end)
+    ride ICI instead of forcing the whole batch onto the host path)."""
+    from pathway_tpu.parallel.exchange import packable
+
+    v0 = col[0]
+    arity = len(v0)
+    if arity == 0:
+        return None
+    elem_types = [type(v) for v in v0]
+    if any(t not in (int, float, bool) for t in elem_types):
+        return None
+    for v in col:
+        if type(v) is not tuple or len(v) != arity:
+            return None
+        for x, t in zip(v, elem_types):
+            if type(x) is not t:
+                return None
+    arrays = []
+    for pos in range(arity):
+        a = np.asarray([v[pos] for v in col])
+        if a.dtype.kind == "f" and a.dtype.itemsize < 4:
+            a = a.astype(np.float32)
+        if a.dtype.kind in "iu" and a.dtype.itemsize < 8:
+            a = a.astype(np.int64)
+        if not packable(a):
+            return None
+        arrays.append(a)
+    return arrays, ("tuple", elem_types)
+
+
+def _rebuild_column(arrays: list[np.ndarray], spec) -> np.ndarray:
+    kind, info = spec
+    if kind == "scalar":
+        return arrays[0].astype(info)
+    lists = [a.tolist() for a in arrays]  # python scalars, like host path
+    out = np.empty(len(lists[0]), dtype=object)
+    for i, vals in enumerate(zip(*lists)):
+        out[i] = tuple(
+            t(v) for t, v in zip(info, vals)
+        )  # restore bools: int arrays round-trip python bools as ints
+    return out
+
+
+def _batch_numeric_columns(b: DiffBatch):
+    """[(device arrays, rebuild spec)] per value column, or None if any
+    column holds payloads that cannot ride the device path (strings/json/
+    nested or ragged tuples stay host-side). The spec lets the receiver
+    restore the exact representation the host-partition path would have
+    kept, so both paths feed identical columns downstream."""
+    out = []
+    for col in b.columns.values():
+        if col.dtype == object:
+            if not len(col):
+                return None
+            packed = (
+                _pack_tuple_column(col)
+                if type(col[0]) is tuple
+                else _pack_scalar_column(col)
+            )
+        else:
+            packed = _pack_scalar_column(col)
+        if packed is None:
+            return None
+        out.append(packed)
     return out
 
 
@@ -110,36 +169,72 @@ class _ShardRouter:
         from pathway_tpu.parallel.exchange import exchange_rows
 
         self.device_exchanges += 1
-        arrays = [b.keys, b.diffs] + [a for a, _orig in numeric_cols]
+        arrays = [b.keys, b.diffs]
+        for col_arrays, _spec in numeric_cols:
+            arrays.extend(col_arrays)
         blocks = exchange_rows(arrays, dest, self.mesh, self.axis)
         names = b.column_names
-        origs = [orig for _a, orig in numeric_cols]
         out: list[DiffBatch | None] = [None] * self.n_shards
         for s, cols in enumerate(blocks):
             if not len(cols[0]):
                 continue
-            columns = {
-                # restore each column to its pre-exchange representation
-                # (object columns back to native python scalars, typed
-                # columns back to their original dtype) so sharded results
-                # are identical to the host-partition and unsharded paths
-                name: arr.astype(orig)
-                for name, arr, orig in zip(names, cols[2:], origs)
-            }
+            # restore each column to its pre-exchange representation
+            # (object columns back to native python scalars, tuple
+            # columns re-zipped, typed columns back to their original
+            # dtype) so sharded results are identical to the
+            # host-partition and unsharded paths
+            columns = {}
+            pos = 2
+            for name, (col_arrays, spec) in zip(names, numeric_cols):
+                take = len(col_arrays)
+                columns[name] = _rebuild_column(
+                    list(cols[pos : pos + take]), spec
+                )
+                pos += take
             out[s] = DiffBatch(cols[0], cols[1], columns)
         return out
 
 
-class ShardedGroupByExec(NodeExec):
+class _ShardedExec(NodeExec):
+    """Shared scaffolding for per-shard execs: a router, one inner exec
+    per shard, the partition loop, and shard-state (de)serialization."""
+
+    inner_cls: Any = None
+
+    def __init__(self, node, mesh: Any, axis: str = "data"):
+        super().__init__(node)
+        self.router = _ShardRouter(mesh, axis)
+        self.shards = [
+            self.inner_cls(node) for _ in range(self.router.n_shards)
+        ]
+
+    def _partition(self, batches, dests_fn) -> list[list[DiffBatch]]:
+        parts: list[list[DiffBatch]] = [[] for _ in self.shards]
+        for b in batches:
+            if not len(b):
+                continue
+            for s, sub in enumerate(self.router.route(b, dests_fn(b))):
+                if sub is not None:
+                    parts[s].append(sub)
+        return parts
+
+    def state_dict(self) -> dict:
+        # router holds the (unpicklable) mesh; shard states carry the data
+        return {"shards": [ex.state_dict() for ex in self.shards]}
+
+    def load_state(self, state: dict) -> None:
+        for ex, st in zip(self.shards, state["shards"]):
+            if st:
+                ex.load_state(st)
+
+
+class ShardedGroupByExec(_ShardedExec):
     """groupby-reduce with per-shard disjoint state: rows are exchanged to
     the shard owning their group key, each shard reduces independently
     (reference: group_by_table reindex-to-grouping-key + Exchange,
     src/engine/dataflow.rs:3404)."""
 
-    def __init__(self, node, mesh: Any, axis: str = "data"):
-        super().__init__(node)
-        self.router = _ShardRouter(mesh, axis)
-        self.shards = [GroupByExec(node) for _ in range(self.router.n_shards)]
+    inner_cls = GroupByExec
 
     def _dests(self, b: DiffBatch) -> np.ndarray:
         ex = self.shards[0]
@@ -160,13 +255,7 @@ class ShardedGroupByExec(NodeExec):
         return shard_of(gks, self.router.n_shards)
 
     def process(self, t, inputs):
-        parts: list[list[DiffBatch]] = [[] for _ in self.shards]
-        for b in inputs[0]:
-            if not len(b):
-                continue
-            for s, sub in enumerate(self.router.route(b, self._dests(b))):
-                if sub is not None:
-                    parts[s].append(sub)
+        parts = self._partition(inputs[0], self._dests)
         out: list[DiffBatch] = []
         for ex, sub_batches in zip(self.shards, parts):
             if sub_batches:
@@ -178,25 +267,13 @@ class ShardedGroupByExec(NodeExec):
         tests and the state snapshotter)."""
         return [set(ex.groups.keys()) for ex in self.shards]
 
-    def state_dict(self) -> dict:
-        # router holds the (unpicklable) mesh; shard states carry the data
-        return {"shards": [ex.state_dict() for ex in self.shards]}
 
-    def load_state(self, state: dict) -> None:
-        for ex, st in zip(self.shards, state["shards"]):
-            if st:
-                ex.load_state(st)
-
-
-class ShardedJoinExec(NodeExec):
+class ShardedJoinExec(_ShardedExec):
     """Equijoin with per-shard disjoint state: both sides exchange on the
     join-key hash so matching rows co-locate (reference: join_tables
     arrange+join_core after Exchange, src/engine/dataflow.rs:2740,2834)."""
 
-    def __init__(self, node, mesh: Any, axis: str = "data"):
-        super().__init__(node)
-        self.router = _ShardRouter(mesh, axis)
-        self.shards = [JoinExec(node) for _ in range(self.router.n_shards)]
+    inner_cls = JoinExec
 
     def _dests(self, b: DiffBatch, on_cols: Sequence[str]) -> np.ndarray:
         from pathway_tpu.internals.api import ref_scalars_columns
@@ -208,32 +285,109 @@ class ShardedJoinExec(NodeExec):
         return shard_of(jks, self.router.n_shards)
 
     def process(self, t, inputs):
-        lparts: list[list[DiffBatch]] = [[] for _ in self.shards]
-        rparts: list[list[DiffBatch]] = [[] for _ in self.shards]
-        for b in inputs[0]:
-            if len(b):
-                for s, sub in enumerate(
-                    self.router.route(b, self._dests(b, self.node.left_on))
-                ):
-                    if sub is not None:
-                        lparts[s].append(sub)
-        for b in inputs[1]:
-            if len(b):
-                for s, sub in enumerate(
-                    self.router.route(b, self._dests(b, self.node.right_on))
-                ):
-                    if sub is not None:
-                        rparts[s].append(sub)
+        lparts = self._partition(
+            inputs[0], lambda b: self._dests(b, self.node.left_on)
+        )
+        rparts = self._partition(
+            inputs[1], lambda b: self._dests(b, self.node.right_on)
+        )
         out: list[DiffBatch] = []
         for ex, lsub, rsub in zip(self.shards, lparts, rparts):
             if lsub or rsub:
                 out.extend(ex.process(t, [lsub, rsub]))
         return out
 
-    def state_dict(self) -> dict:
-        return {"shards": [ex.state_dict() for ex in self.shards]}
 
-    def load_state(self, state: dict) -> None:
-        for ex, st in zip(self.shards, state["shards"]):
-            if st:
-                ex.load_state(st)
+def _buffer_exec_cls():
+    from pathway_tpu.engine.nodes import BufferExec
+
+    return BufferExec
+
+
+class ShardedBufferExec(_ShardedExec):
+    """Temporal buffer with per-shard held state: rows route to the shard
+    owning their row key; the release watermark (max time seen) is a
+    GLOBAL property, combined across shards every tick — the decentralized
+    redesign of the reference's single-worker buffer (the anti-pattern at
+    src/engine/dataflow/operators/time_column.rs:44-47, which pins all
+    postponed state on one worker)."""
+
+    def __init__(self, node, mesh: Any, axis: str = "data"):
+        self.inner_cls = _buffer_exec_cls()
+        super().__init__(node, mesh, axis)
+
+    def _dests(self, b: DiffBatch) -> np.ndarray:
+        return shard_of(np.asarray(b.keys, dtype=np.uint64), self.router.n_shards)
+
+    def process(self, t, inputs):
+        cur_idx = self.shards[0].cur_idx
+        batch_max = None
+        for b in inputs[0]:
+            if not len(b):
+                continue
+            # global watermark: the max current-time over the WHOLE batch
+            # (all shards), not just the rows a shard happens to own
+            for v in b.columns[self.node.inputs[0].column_names[cur_idx]]:
+                if v is not None and (batch_max is None or v > batch_max):
+                    batch_max = v
+        parts = self._partition(inputs[0], self._dests)
+        if batch_max is not None:
+            for ex in self.shards:
+                if ex.max_seen is None or batch_max > ex.max_seen:
+                    ex.max_seen = batch_max
+        out: list[DiffBatch] = []
+        for ex, sub_batches in zip(self.shards, parts):
+            if sub_batches or batch_max is not None:
+                out.extend(ex.process(t, [sub_batches]))
+        return out
+
+    def on_end(self):
+        out: list[DiffBatch] = []
+        for ex in self.shards:
+            out.extend(ex.on_end())
+        return out
+
+    def shard_touched_keys(self) -> list[set[int]]:
+        """Keys each shard has ever held or released — the distribution
+        evidence tests assert on (held empties after the final flush)."""
+        return [
+            set(ex.held.keys()) | set(ex.released) for ex in self.shards
+        ]
+
+
+class ShardedSortExec(_ShardedExec):
+    """prev/next maintenance sharded by INSTANCE: each instance's sorted
+    order lives wholly on the shard owning the instance hash, so pointer
+    maintenance parallelizes across instances (reference: prev_next
+    instance co-location, src/engine/dataflow/operators/prev_next.rs).
+    With no instance column the single global order degenerates to shard
+    0 — same centralization degree as the reference's single arrangement."""
+
+    def __init__(self, node, mesh: Any, axis: str = "data"):
+        from pathway_tpu.engine.nodes import SortExec
+
+        self.inner_cls = SortExec
+        super().__init__(node, mesh, axis)
+        self._i_idx = self.shards[0].i_idx
+
+    def _dests(self, b: DiffBatch) -> np.ndarray:
+        if self._i_idx is None:
+            return np.zeros(len(b), dtype=np.int32)
+        from pathway_tpu.internals.api import ref_scalars_columns
+
+        inst_col = list(b.columns.values())[self._i_idx]
+        insts = np.asarray(
+            ref_scalars_columns([inst_col], len(b)), dtype=np.uint64
+        )
+        return shard_of(insts, self.router.n_shards)
+
+    def process(self, t, inputs):
+        parts = self._partition(inputs[0], self._dests)
+        out: list[DiffBatch] = []
+        for ex, sub_batches in zip(self.shards, parts):
+            if sub_batches:
+                out.extend(ex.process(t, [sub_batches]))
+        return out
+
+    def shard_instances(self) -> list[set]:
+        return [set(ex.instances.keys()) for ex in self.shards]

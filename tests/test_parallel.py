@@ -277,3 +277,103 @@ def test_cli_spawn_sets_engine_shards(tmp_path):
     )
     assert out.returncode == 0, out.stderr
     assert "shards: 4" in out.stdout
+
+
+def test_sharded_window_matches_single_shard():
+    """Per-instance tumbling-window aggregation at 8 engine shards equals
+    the unsharded result; the temporal buffer state is spread across
+    shards (VERDICT r3 item 6 — the reference centralizes postponed rows
+    on one worker, time_column.rs:44-47)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.sharded import ShardedBufferExec
+    from pathway_tpu.internals import parse_graph
+
+    class S(pw.Schema):
+        instance: int
+        t: int
+        v: int
+
+    rows = [(i % 5, i % 40, i) for i in range(400)]
+
+    def build_and_run():
+        t = pw.debug.table_from_rows(S, rows)
+        res = t.windowby(
+            t.t,
+            window=pw.temporal.tumbling(duration=10),
+            instance=t.instance,
+            behavior=pw.temporal.common_behavior(delay=5),
+        ).reduce(
+            pw.this._pw_instance,
+            start=pw.this._pw_window_start,
+            s=pw.reducers.sum(pw.this.v),
+        )
+        return pw.debug.table_to_dicts(res)
+
+    keys0, cols0 = build_and_run()
+    parse_graph.G.clear()
+    mesh_mod = _with_engine_mesh(8)
+    try:
+        keys1, cols1 = build_and_run()
+        rt = parse_graph.G.last_runtime
+        bufs = [
+            e
+            for e in rt.execs.values()
+            if isinstance(e, ShardedBufferExec)
+        ]
+        assert bufs, "expected a sharded buffer exec"
+        # buffer state was actually SPREAD across shards (held empties
+        # after the final flush, so assert on ever-touched keys): disjoint
+        # ownership, more than one shard populated
+        touched = bufs[0].shard_touched_keys()
+        populated = [s for s in touched if s]
+        assert len(populated) >= 2, "buffer rows all landed on one shard"
+        for i in range(len(touched)):
+            for j in range(i + 1, len(touched)):
+                assert not (touched[i] & touched[j]), "key on two shards"
+        assert sum(cols1["s"].values()) == sum(cols0["s"].values())
+        assert dict(cols0["s"]) == dict(cols1["s"])
+        assert dict(cols0["start"]) == dict(cols1["start"])
+    finally:
+        mesh_mod.set_engine_mesh(None)
+        parse_graph.G.clear()
+
+
+def test_sharded_sort_matches_single_shard():
+    """Instance-sharded prev/next pointers at 8 shards equal the
+    unsharded result; each instance's order lives on exactly one shard."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.sharded import ShardedSortExec
+    from pathway_tpu.internals import parse_graph
+
+    class S(pw.Schema):
+        instance: int
+        k: int
+
+    rows = [((i * 7) % 6, (i * 13) % 97) for i in range(200)]
+
+    def build_and_run():
+        t = pw.debug.table_from_rows(S, rows)
+        res = t.sort(key=t.k, instance=t.instance)
+        return pw.debug.table_to_dicts(res)
+
+    keys0, cols0 = build_and_run()
+    parse_graph.G.clear()
+    mesh_mod = _with_engine_mesh(8)
+    try:
+        keys1, cols1 = build_and_run()
+        rt = parse_graph.G.last_runtime
+        sorts = [
+            e for e in rt.execs.values() if isinstance(e, ShardedSortExec)
+        ]
+        assert sorts, "expected a sharded sort exec"
+        insts = sorts[0].shard_instances()
+        populated = [s for s in insts if s]
+        assert len(populated) >= 2, "instances all landed on one shard"
+        for i in range(len(insts)):
+            for j in range(i + 1, len(insts)):
+                assert not (insts[i] & insts[j]), "instance on two shards"
+        assert dict(cols0["prev"]) == dict(cols1["prev"])
+        assert dict(cols0["next"]) == dict(cols1["next"])
+    finally:
+        mesh_mod.set_engine_mesh(None)
+        parse_graph.G.clear()
