@@ -30,9 +30,21 @@ def answer_with_geometric_rag_strategy(
     (reference: question_answering.py:97-162)."""
     question = questions if isinstance(questions, str) else questions[0]
     n = n_starting_documents
+    # strict mode instructs small open-source models to answer tersely
+    # with the exact not-found marker (reference: strict_prompt on
+    # answer_with_geometric_rag_strategy, question_answering.py:120)
+    rules = (
+        "Answer with ONLY the shortest possible phrase, or exactly "
+        '"No information found." if the documents do not contain the '
+        "answer."
+        if strict_prompt
+        else ""
+    )
     for _ in range(max_iterations):
         docs = list(documents)[:n]
-        prompt = prompt_lib.prompt_qa_geometric_rag(question, docs)
+        prompt = prompt_lib.prompt_qa_geometric_rag(
+            question, docs, additional_rules=rules
+        )
         answer = llm_chat_model.func(prompt)
         if answer and "no information" not in str(answer).lower():
             return str(answer)
@@ -43,18 +55,59 @@ def answer_with_geometric_rag_strategy(
 
 
 def answer_with_geometric_rag_strategy_from_index(
-    questions: Any,
-    index: Any,
-    documents_column: str,
+    questions: Any,  # ColumnReference[str]
+    index: Any,  # DataIndex
+    documents_column: str | Any,
     llm_chat_model: Any,
     n_starting_documents: int,
     factor: int,
     max_iterations: int,
-    **kwargs,
+    metadata_filter: Any = None,
+    strict_prompt: bool = False,
 ):
-    raise NotImplementedError(
-        "use AdaptiveRAGQuestionAnswerer for the table-level flow"
+    """Table-level adaptive RAG straight from a DataIndex: retrieve the
+    maximum document count once (n_starting * factor^(max_iterations-1)),
+    then per row grow the prompt's document slice geometrically until the
+    LLM finds an answer (reference: question_answering.py:162-215).
+    Returns a column of answers (None where no answer was found)."""
+    from pathway_tpu.internals import expression as expr_mod
+
+    max_documents = n_starting_documents * (factor ** (max_iterations - 1))
+    if isinstance(documents_column, expr_mod.ColumnReference):
+        documents_column_name = documents_column.name
+    else:
+        documents_column_name = documents_column
+
+    query_context = questions.table + index.query_as_of_now(
+        questions,
+        number_of_matches=max_documents,
+        collapse_rows=True,
+        metadata_filter=metadata_filter,
+    ).select(
+        documents_list=pw.coalesce(pw.this[documents_column_name], ()),
     )
+
+    question_col = query_context[questions.name]
+    llm = llm_chat_model
+
+    def adaptive(question: str, docs: Any) -> str | None:
+        doc_list = docs.value if isinstance(docs, Json) else list(docs or ())
+        return answer_with_geometric_rag_strategy(
+            question,
+            list(doc_list or ()),
+            llm,
+            n_starting_documents,
+            factor,
+            max_iterations,
+            strict_prompt=strict_prompt,
+        )
+
+    answered = query_context.select(
+        answer=apply_with_type(
+            adaptive, str | None, question_col, this.documents_list
+        )
+    )
+    return answered.answer
 
 
 class BaseQuestionAnswerer:
