@@ -1152,7 +1152,9 @@ class SortNode(Node):
         from pathway_tpu.parallel.mesh import get_engine_mesh
 
         em = get_engine_mesh()
-        if em is not None:
+        # instance-less sort is one global order: sharding would route
+        # every row to shard 0 and pay exchange overhead for nothing
+        if em is not None and self.instance_col is not None:
             from pathway_tpu.engine.sharded import ShardedSortExec
 
             return ShardedSortExec(self, em[0], em[1])

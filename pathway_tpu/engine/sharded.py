@@ -21,7 +21,13 @@ from typing import Any, Sequence
 import numpy as np
 
 from pathway_tpu.engine.batch import DiffBatch
-from pathway_tpu.engine.nodes import GroupByExec, JoinExec, NodeExec
+from pathway_tpu.engine.nodes import (
+    BufferExec,
+    GroupByExec,
+    JoinExec,
+    NodeExec,
+    SortExec,
+)
 
 # Minimum rows per batch before the device all-to-all path is worth the
 # dispatch overhead; tests lower this to force the collective.
@@ -298,12 +304,6 @@ class ShardedJoinExec(_ShardedExec):
         return out
 
 
-def _buffer_exec_cls():
-    from pathway_tpu.engine.nodes import BufferExec
-
-    return BufferExec
-
-
 class ShardedBufferExec(_ShardedExec):
     """Temporal buffer with per-shard held state: rows route to the shard
     owning their row key; the release watermark (max time seen) is a
@@ -312,9 +312,7 @@ class ShardedBufferExec(_ShardedExec):
     src/engine/dataflow/operators/time_column.rs:44-47, which pins all
     postponed state on one worker)."""
 
-    def __init__(self, node, mesh: Any, axis: str = "data"):
-        self.inner_cls = _buffer_exec_cls()
-        super().__init__(node, mesh, axis)
+    inner_cls = BufferExec
 
     def _dests(self, b: DiffBatch) -> np.ndarray:
         return shard_of(np.asarray(b.keys, dtype=np.uint64), self.router.n_shards)
@@ -363,10 +361,9 @@ class ShardedSortExec(_ShardedExec):
     With no instance column the single global order degenerates to shard
     0 — same centralization degree as the reference's single arrangement."""
 
-    def __init__(self, node, mesh: Any, axis: str = "data"):
-        from pathway_tpu.engine.nodes import SortExec
+    inner_cls = SortExec
 
-        self.inner_cls = SortExec
+    def __init__(self, node, mesh: Any, axis: str = "data"):
         super().__init__(node, mesh, axis)
         self._i_idx = self.shards[0].i_idx
 

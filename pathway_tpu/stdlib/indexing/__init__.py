@@ -4,6 +4,8 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     USearchMetricKind,
     BruteForceKnn,
     BruteForceKnnFactory,
+    IvfKnn,
+    IvfKnnFactory,
     LshKnn,
     LshKnnFactory,
     TpuKnn,
@@ -31,6 +33,8 @@ __all__ = [
     "InnerIndex",
     "BruteForceKnn",
     "BruteForceKnnFactory",
+    "IvfKnn",
+    "IvfKnnFactory",
     "LshKnn",
     "LshKnnFactory",
     "TpuKnn",

@@ -379,3 +379,202 @@ class LshKnnIndex:
             scored.sort(key=lambda kv: -kv[1])
             out.append(tuple(scored[: int(k)]))
         return out
+
+
+class IvfKnnIndex:
+    """Two-level IVF KNN — the >HBM scale-out tier (design note in
+    ops/ivf.py; reference counterpart: usearch HNSW,
+    src/external_integration/usearch_integration.rs:20). Coarse matmul
+    quantization picks nprobe inverted lists, exact matmul scoring ranks
+    their members. Below ``min_train`` points (and until training) the
+    index scores exactly over everything, so small corpora behave
+    identically to the brute-force index."""
+
+    def __init__(
+        self,
+        dimensions: int | None = None,
+        metric: str = "cosine",
+        n_clusters: int | None = None,
+        n_probe: int | None = None,
+        min_train: int = 4096,
+        train_sample: int = 20000,
+        seed: int = 0,
+    ):
+        if metric not in ("cosine", "dot", "l2sq"):
+            raise ValueError(f"unknown metric {metric!r}")
+        self.dim = dimensions
+        self.metric = metric
+        self.n_clusters = n_clusters
+        self.n_probe = n_probe
+        self.min_train = min_train
+        self.train_sample = train_sample
+        self.seed = seed
+        self.vecs: dict[int, np.ndarray] = {}
+        self.metadata: dict[int, Any] = {}
+        self.centroids: np.ndarray | None = None
+        self.lists: dict[int, set[int]] = {}
+        self.key_cluster: dict[int, int] = {}
+        self._pending: list[int] = []  # keys awaiting cluster assignment
+        self._trained_size = 0
+
+    # --- maintenance ------------------------------------------------------
+
+    def _space(self, v: np.ndarray) -> np.ndarray:
+        """Clustering space: normalized for cosine (so L2 ~ angle), raw
+        otherwise."""
+        if self.metric == "cosine":
+            return v / (np.linalg.norm(v, axis=-1, keepdims=True) + 1e-30)
+        return v
+
+    def upsert(self, key: int, data: Any, metadata: Any) -> None:
+        vec = _as_vector(data)
+        if self.dim is not None and len(vec) != self.dim:
+            raise ValueError(
+                f"IvfKnnIndex: expected {self.dim}-dim vectors, "
+                f"got {len(vec)}"
+            )
+        self.remove(key)
+        self.vecs[key] = vec
+        if metadata is not None:
+            self.metadata[key] = metadata
+        self._pending.append(key)
+
+    def remove(self, key: int) -> None:
+        self.vecs.pop(key, None)
+        self.metadata.pop(key, None)
+        c = self.key_cluster.pop(key, None)
+        if c is not None:
+            self.lists.get(c, set()).discard(key)
+
+    def _maybe_train(self) -> None:
+        from pathway_tpu.ops.ivf import train_centroids
+
+        n = len(self.vecs)
+        if n < self.min_train:
+            return
+        if self.centroids is not None and n < 4 * self._trained_size:
+            return
+        rng = np.random.default_rng(self.seed)
+        keys = list(self.vecs.keys())
+        if len(keys) > self.train_sample:
+            keys = [
+                keys[i]
+                for i in rng.choice(
+                    len(keys), size=self.train_sample, replace=False
+                )
+            ]
+        sample = self._space(np.stack([self.vecs[k] for k in keys]))
+        n_clusters = self.n_clusters or max(
+            8, int(round(math.sqrt(n) / 8)) * 8
+        )
+        self.centroids = train_centroids(
+            sample, n_clusters, seed=self.seed
+        )
+        # reassign EVERYTHING under the new centroids
+        self.lists = {}
+        self.key_cluster = {}
+        self._pending = list(self.vecs.keys())
+        self._trained_size = n
+
+    def _flush_assign(self) -> None:
+        from pathway_tpu.ops.ivf import assign_clusters
+
+        if self.centroids is None:
+            return  # keep pending until training happens
+        if not self._pending:
+            return
+        keys = [k for k in self._pending if k in self.vecs]
+        self._pending = []
+        if not keys:
+            return
+        x = self._space(np.stack([self.vecs[k] for k in keys]))
+        assign = assign_clusters(x, self.centroids)
+        for k, c in zip(keys, assign.tolist()):
+            self.key_cluster[k] = c
+            self.lists.setdefault(c, set()).add(k)
+
+    # --- snapshots --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "vecs": self.vecs,
+            "metadata": self.metadata,
+            "centroids": self.centroids,
+            "key_cluster": self.key_cluster,
+            "trained_size": self._trained_size,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.vecs = dict(state["vecs"])
+        self.metadata = dict(state["metadata"])
+        self.centroids = state["centroids"]
+        self._trained_size = int(state.get("trained_size", 0))
+        self.key_cluster = dict(state["key_cluster"])
+        self.lists = {}
+        for k, c in self.key_cluster.items():
+            self.lists.setdefault(c, set()).add(k)
+        self._pending = [k for k in self.vecs if k not in self.key_cluster]
+
+    # --- query ------------------------------------------------------------
+
+    def _score(self, q: np.ndarray, keys: list[int]) -> np.ndarray:
+        mat = np.stack([self.vecs[k] for k in keys]).astype(np.float32)
+        qv = q.astype(np.float32)
+        if self.metric == "cosine":
+            qv = qv / (np.linalg.norm(qv) + 1e-30)
+            mat = mat / (
+                np.linalg.norm(mat, axis=1, keepdims=True) + 1e-30
+            )
+            return mat @ qv - 1.0  # reference COS convention: -(1 - cos)
+        if self.metric == "l2sq":
+            d = mat - qv[None, :]
+            return -np.sum(d * d, axis=1)
+        return mat @ qv
+
+    def search(self, queries: Sequence[tuple[Any, int, Any]]):
+        if not queries:
+            return []
+        if not self.vecs:
+            return [() for _ in queries]
+        self._maybe_train()
+        self._flush_assign()
+        out = []
+        for q, k, flt in queries:
+            if int(k) <= 0:
+                out.append(())
+                continue
+            qv = _as_vector(q)
+            if self.centroids is None:
+                cand = list(self.vecs.keys())  # exact below min_train
+            else:
+                qs = self._space(qv[None, :]).astype(np.float32)
+                c32 = self.centroids.astype(np.float32)
+                d = (
+                    np.sum(c32 * c32, axis=1)
+                    - 2.0 * (qs @ c32.T)[0]
+                )
+                n_probe = self.n_probe or max(
+                    1, int(round(math.sqrt(len(c32))))
+                )
+                n_probe = min(n_probe, len(c32))
+                probes = np.argpartition(d, n_probe - 1)[:n_probe]
+                cand = [
+                    key
+                    for c in probes.tolist()
+                    for key in self.lists.get(c, ())
+                ]
+                if not cand:
+                    cand = list(self.vecs.keys())
+            scores = self._score(qv, cand)
+            order = np.argsort(-scores, kind="stable")
+            pred = compile_filter(flt) if flt else None
+            matches = []
+            for j in order.tolist():
+                key = cand[j]
+                if pred is not None and not pred(self.metadata.get(key)):
+                    continue
+                matches.append((key, float(scores[j])))
+                if len(matches) >= int(k):
+                    break
+            out.append(tuple(matches))
+        return out

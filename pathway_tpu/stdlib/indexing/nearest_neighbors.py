@@ -291,3 +291,65 @@ class LshKnnFactory(InnerIndexFactory):
             distance_type=self.distance_type,
             embedder=self.embedder,
         )
+
+
+class IvfKnn(EngineInnerIndex):
+    """Two-level IVF index: MXU coarse quantization + exact fine scoring —
+    the sub-linear / >HBM tier (design note: ops/ivf.py; reference
+    counterpart: usearch HNSW, src/external_integration/
+    usearch_integration.rs:20)."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+        *,
+        dimensions: int | None = None,
+        metric: Any = None,
+        n_clusters: int | None = None,
+        n_probe: int | None = None,
+        min_train: int = 4096,
+        embedder: Any = None,
+    ):
+        from pathway_tpu.stdlib.indexing._index_impls import IvfKnnIndex
+
+        metric_s = _metric_name(metric)
+        super().__init__(
+            data_column,
+            metadata_column,
+            index_factory=lambda: IvfKnnIndex(
+                dimensions=dimensions,
+                metric=metric_s,
+                n_clusters=n_clusters,
+                n_probe=n_probe,
+                min_train=min_train,
+            ),
+            embedder=embedder,
+        )
+        self.dimensions = dimensions
+        self.metric = metric_s
+
+
+@dataclass(kw_only=True)
+class IvfKnnFactory(InnerIndexFactory):
+    dimensions: int | None = None
+    metric: Any = None
+    n_clusters: int | None = None
+    n_probe: int | None = None
+    min_train: int = 4096
+    embedder: Any = None
+
+    def __post_init__(self):
+        _check_factory_args(self.dimensions, self.embedder)
+
+    def build_inner_index(self, data_column, metadata_column=None):
+        return IvfKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions,
+            metric=self.metric,
+            n_clusters=self.n_clusters,
+            n_probe=self.n_probe,
+            min_train=self.min_train,
+            embedder=self.embedder,
+        )

@@ -86,3 +86,28 @@ def default_lsh_knn_document_index(
         embedder=embedder,
     )
     return DataIndex(data_table, inner)
+
+
+def default_ivf_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder: Any = None,
+    dimensions: int | None = None,
+    metadata_column: ColumnExpression | None = None,
+    n_clusters: int | None = None,
+    n_probe: int | None = None,
+) -> DataIndex:
+    """IVF document index — sub-linear queries for corpora past the
+    HBM-resident brute-force tier (ops/ivf.py design note)."""
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import IvfKnn
+
+    inner = IvfKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        n_clusters=n_clusters,
+        n_probe=n_probe,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
