@@ -358,8 +358,8 @@ class ShardedSortExec(_ShardedExec):
     order lives wholly on the shard owning the instance hash, so pointer
     maintenance parallelizes across instances (reference: prev_next
     instance co-location, src/engine/dataflow/operators/prev_next.rs).
-    With no instance column the single global order degenerates to shard
-    0 — same centralization degree as the reference's single arrangement."""
+    Instance-less sorts never take this path — SortNode.make_exec builds
+    a plain SortExec for them (one global order cannot shard)."""
 
     inner_cls = SortExec
 

@@ -3,7 +3,13 @@
 `pyiceberg`; this speaks a compatible subset of the spec on pyarrow:
 parquet data files tracked by versioned JSON snapshots under `metadata/`
 with a `version-hint.text` pointer (the layout pyiceberg's filesystem
-catalog reads). Full-catalog deployments should install `pyiceberg`."""
+catalog reads). Snapshots carry the table schema and a snapshot-history
+list; appending writers are schema-guarded (new columns require
+``schema_evolution="allow_add"``, drops/type changes are refused), the
+``mode="overwrite"`` writer starts a snapshot containing only its own
+files, and the streaming reader RETRACTS rows of files that leave the
+snapshot, so overwrites flow as incremental updates. Full-catalog
+deployments should install `pyiceberg`."""
 
 from __future__ import annotations
 
@@ -35,14 +41,20 @@ def _current_version(root: str) -> int:
         return -1
 
 
-def _snapshot_files(root: str, version: int) -> list[str]:
+def _snapshot_meta(root: str, version: int) -> dict:
     path = os.path.join(_meta_dir(root), f"v{version}.metadata.json")
     try:
         with open(path) as f:
-            meta = _json.loads(f.read())
+            return _json.loads(f.read())
     except OSError:
-        return []
-    return [os.path.join(root, "data", p) for p in meta.get("files", [])]
+        return {}
+
+
+def _snapshot_files(root: str, version: int) -> list[str]:
+    return [
+        os.path.join(root, "data", p)
+        for p in _snapshot_meta(root, version).get("files", [])
+    ]
 
 
 class _IcebergStaticSource(StaticSource):
@@ -78,6 +90,8 @@ class _IcebergStreamingSource(StreamingSource):
         self._stop = threading.Event()
         self._thread = None
         self._seen_files: set[str] = set()
+        # file -> contributed rows, for retraction when a snapshot drops it
+        self._live: dict[str, list] = {}
         self._version = -1
         import itertools
 
@@ -89,18 +103,33 @@ class _IcebergStreamingSource(StreamingSource):
     def seek(self, state: dict) -> None:
         self._version = int(state.get("version", -1))
         self._seen_files = set(state.get("files", []))
+        # rebuild the live map WITHOUT emitting (rows were delivered
+        # before the restart; the persistence input log replays them)
+        for f in self._seen_files:
+            try:
+                self._live[f] = _rows_from_parquet(
+                    f, self.column_names, self.schema, self._counter
+                )
+            except OSError:
+                pass
 
     def _scan(self):
         v = _current_version(self.root)
         if v < 0 or v == self._version:
             return
+        current = set(_snapshot_files(self.root, v))
         rows = []
-        for f in _snapshot_files(self.root, v):
-            if f in self._seen_files:
-                continue
-            rows.extend(
-                _rows_from_parquet(f, self.column_names, self.schema, self._counter)
+        # files dropped by the new snapshot (overwrite): retract their rows
+        for f in sorted(self._seen_files - current):
+            for k, d, vals in self._live.pop(f, []):
+                rows.append((k, -d, vals))
+            self._seen_files.discard(f)
+        for f in sorted(current - self._seen_files):
+            part_rows = _rows_from_parquet(
+                f, self.column_names, self.schema, self._counter
             )
+            self._live[f] = part_rows
+            rows.extend(part_rows)
             self._seen_files.add(f)
         self._version = v
         if rows:
@@ -147,20 +176,100 @@ def read(
 
 
 class _IcebergWriter:
-    def __init__(self, root, column_names):
+    def __init__(
+        self,
+        root,
+        column_names,
+        schema_desc: list[dict] | None = None,
+        *,
+        mode: str = "append",
+        schema_evolution: str = "strict",
+    ):
         self.root = root
         self.column_names = list(column_names)
+        self.schema_desc = schema_desc or [
+            {"name": n, "type": "any"} for n in column_names
+        ]
         os.makedirs(_meta_dir(root), exist_ok=True)
         os.makedirs(os.path.join(root, "data"), exist_ok=True)
         self.version = _current_version(root)
+        if self.version >= 0:
+            self._check_schema(schema_evolution)
+        # overwrite: the fresh (files-of-this-writer-only) snapshot is
+        # committed WITH the first data batch, not at construction — an
+        # aborted pipeline must not have emptied the table
         self.files: list[str] = (
             [
                 os.path.relpath(f, os.path.join(root, "data"))
                 for f in _snapshot_files(root, self.version)
             ]
-            if self.version >= 0
+            if self.version >= 0 and mode != "overwrite"
             else []
         )
+
+    def _check_schema(self, evolution: str) -> None:
+        """Evolution guard (mirrors pw.io.deltalake): identical schemas
+        append; new columns need schema_evolution='allow_add'; dropped or
+        type-changed columns are refused."""
+        meta = _snapshot_meta(self.root, self.version)
+        fields = meta.get("schema", {}).get("fields")
+        if not fields:
+            return
+        existing = {f["name"]: f.get("type", "any") for f in fields}
+        mine = {f["name"]: f["type"] for f in self.schema_desc}
+        dropped = set(existing) - set(mine)
+        if dropped:
+            raise ValueError(
+                f"iceberg: writer schema drops existing column(s) "
+                f"{sorted(dropped)}; refusing to append"
+            )
+        changed = {
+            n
+            for n in existing
+            if existing[n] not in ("any", mine[n]) and mine[n] != "any"
+        }
+        if changed:
+            raise ValueError(
+                f"iceberg: writer changes type of column(s) "
+                f"{sorted(changed)}; refusing to append"
+            )
+        added = set(mine) - set(existing)
+        if added and evolution != "allow_add":
+            raise ValueError(
+                f"iceberg: writer adds new column(s) {sorted(added)}; "
+                "pass schema_evolution='allow_add' to evolve the table"
+            )
+
+    def _commit_snapshot(self) -> None:
+        import time as _time
+
+        prev = _snapshot_meta(self.root, self.version)
+        snapshots = list(prev.get("snapshots", []))
+        self.version += 1
+        snapshots.append(
+            {
+                "snapshot-id": self.version,
+                "timestamp-ms": int(_time.time() * 1000),
+                "files": list(self.files),
+            }
+        )
+        meta = {
+            "files": list(self.files),
+            "schema": {"fields": self.schema_desc},
+            "snapshots": snapshots[-64:],  # bounded history
+        }
+        meta_path = os.path.join(
+            _meta_dir(self.root), f"v{self.version}.metadata.json"
+        )
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_json.dumps(meta))
+        os.replace(tmp, meta_path)
+        hint = os.path.join(_meta_dir(self.root), "version-hint.text")
+        tmp = hint + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self.version))
+        os.replace(tmp, hint)
 
     def write_batch(self, t: int, batch: DiffBatch) -> None:
         import pyarrow as pa
@@ -178,19 +287,7 @@ class _IcebergWriter:
         fname = f"{uuid.uuid4().hex}.parquet"
         pq.write_table(pa.table(cols), os.path.join(self.root, "data", fname))
         self.files.append(fname)
-        self.version += 1
-        meta_path = os.path.join(
-            _meta_dir(self.root), f"v{self.version}.metadata.json"
-        )
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(_json.dumps({"files": self.files}))
-        os.replace(tmp, meta_path)
-        hint = os.path.join(_meta_dir(self.root), "version-hint.text")
-        tmp = hint + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(self.version))
-        os.replace(tmp, hint)
+        self._commit_snapshot()
 
 
 def write(
@@ -199,11 +296,21 @@ def write(
     *,
     namespace: list[str] | None = None,
     table_name: str | None = None,
+    mode: str = "append",
+    schema_evolution: str = "strict",
     **kwargs: Any,
 ) -> None:
+    from pathway_tpu.io.deltalake import _schema_desc
+
     root = catalog_uri
     if namespace or table_name:
         parts = list(namespace or []) + ([table_name] if table_name else [])
         root = os.path.join(catalog_uri, *parts)
-    writer = _IcebergWriter(root, table.column_names())
+    writer = _IcebergWriter(
+        root,
+        table.column_names(),
+        _schema_desc(table),
+        mode=mode,
+        schema_evolution=schema_evolution,
+    )
     add_writer(table, writer.write_batch)

@@ -191,9 +191,9 @@ def test_deltalake_write_then_static_read(tmp_path):
 def test_deltalake_streaming_tails_new_commits(tmp_path):
     lake = tmp_path / "lake"
     from pathway_tpu.engine.batch import DiffBatch
-    from pathway_tpu.io.deltalake import _DeltaWriter
+    from pathway_tpu.io.deltalake import _DeltaWriter, _Store
 
-    w = _DeltaWriter(str(lake), ["k", "v"])
+    w = _DeltaWriter(_Store(str(lake)), ["k", "v"])
     w.write_batch(0, DiffBatch.from_rows([(1, 1, ("a", 1))], ["k", "v"]))
 
     class KVD(pw.Schema):
