@@ -125,9 +125,12 @@ def _split_markdown(table_def: str, require_pipes: bool = False):
         has_id_col = header[0] in ("", "id")
     else:
         if require_pipes:
-            raise ValueError(
-                "split_on_whitespace=False requires a pipe-delimited table"
-            )
+            # single-column table: each line IS one cell (the reference's
+            # split_on_whitespace=False semantics — a one-column table has
+            # nothing to delimit, so full lines are the values)
+            header = [lines[0].strip()]
+            data = [[l] for l in lines[1:]]
+            return header, data, None
         header = lines[0].split()
         if len(header) == 1:
             # single unnamed column: whole line is the value (strings with
@@ -672,7 +675,16 @@ def compute_and_print(
 ) -> None:
     cap = _run_capture([table])[0]
     col_names = table.column_names()
-    rows = sorted(cap.rows.items(), key=lambda kv: kv[0])
+    # reference display order: rows sorted by VALUES then key (debug/
+    # __init__.py _compute_and_print_single); unsortable values keep
+    # capture order
+    rows = list(cap.rows.items())
+    try:
+        rows.sort(key=lambda kv: tuple(
+            (v is not None, v) for v in kv[1]
+        ) + (kv[0],))
+    except (ValueError, TypeError):
+        rows.sort(key=lambda kv: kv[0])
     if n_rows is not None:
         rows = rows[:n_rows]
     header = ([""] if include_id else []) + col_names
@@ -706,15 +718,38 @@ def compute_and_print_update_stream(
     cap = _run_capture([table])[0]
     col_names = table.column_names()
     header = ([""] if include_id else []) + col_names + ["__time__", "__diff__"]
-    print(" | ".join(header))
-    for t, k, d, vals in cap.updates[: n_rows if n_rows else None]:
+    updates = list(cap.updates[: n_rows if n_rows else None])
+    # reference stream display order: (time, diff) first, then values,
+    # then key; unsortable values keep CAPTURE order (sorted() leaves the
+    # original untouched when a comparison raises)
+    try:
+        updates = sorted(
+            updates,
+            key=lambda u: (u[0], u[2])
+            + tuple((v is not None, v) for v in u[3])
+            + (u[1],),
+        )
+    except (ValueError, TypeError):
+        pass
+    out_rows = []
+    for t, k, d, vals in updates:
         key_s = str(Pointer(k))
         if short_pointers:
             key_s = key_s[:12] + "..."
-        cells = ([key_s] if include_id else []) + [
-            _fmt_value(v) for v in vals
-        ] + [str(t), str(d)]
-        print(" | ".join(cells))
+        out_rows.append(
+            ([key_s] if include_id else [])
+            + [_fmt_value(v) for v in vals]
+            + [str(t), str(d)]
+        )
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in out_rows))
+        if out_rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip())
+    for r in out_rows:
+        print(" | ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
 
 
 # ---------------------------------------------------------------------------

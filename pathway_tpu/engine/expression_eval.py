@@ -436,7 +436,7 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
                 for i, r in zip(chunk, results):
                     out[i] = r
             except Exception as exc:
-                record_error(exc)
+                record_error(exc, user=True)
                 for i in chunk:
                     out[i] = ERROR
             pos += max_bs
@@ -461,7 +461,7 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
             except Exception as exc:
                 from pathway_tpu.internals.errors import record_error
 
-                record_error(exc)
+                record_error(exc, user=True)
                 out[i] = ERROR
         return _coerce_to_dtype(out, e._return_type)
     if isinstance(e, expr.ReducerExpression):
@@ -498,7 +498,7 @@ def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndar
             except Exception as exc:
                 from pathway_tpu.internals.errors import record_error
 
-                record_error(exc)
+                record_error(exc, user=True)
                 return ERROR
 
         return await asyncio.gather(*[one(i) for i in range(n)])
@@ -526,6 +526,11 @@ def _coerce_to_dtype(out: np.ndarray, target: dt.DType) -> np.ndarray:
         return _elementwise(norm, out)
     storage = target.np_dtype
     if storage != np.dtype(object) and out.dtype == object:
+        # ERROR poison and None must survive coercion: astype(bool) would
+        # silently turn the (truthy) Error object into True and None into
+        # False, losing the poison/optionality
+        if any(v is None or isinstance(v, Error) for v in out):
+            return out
         try:
             return out.astype(storage)
         except (ValueError, TypeError):

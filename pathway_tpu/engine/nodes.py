@@ -53,6 +53,10 @@ class Node:
         self.inputs = list(inputs)
         self.column_names = list(column_names)
         self.name = type(self).__name__
+        # error-log scope captured at build time (pw.local_error_log)
+        from pathway_tpu.internals.errors import current_build_scope
+
+        self._error_scope = current_build_scope()
         ALL_NODES.append(self)
 
     def make_exec(self) -> "NodeExec":
@@ -251,7 +255,8 @@ class FilterExec(NodeExec):
                 if isinstance(p, Error):
                     mask[i] = False
                     record_error(
-                        ValueError("filter predicate evaluated to Error"),
+                        "Error value encountered in filter condition, "
+                        "skipping the row",
                         str(self.node),
                     )
                 else:
@@ -558,6 +563,16 @@ class GroupByExec(NodeExec):
                 vals = tuple(c[i] for c in cols)
                 k = int(keys_a[i])
                 d = int(diffs_a[i])
+                if any(vals[j] is ERROR for j in self.g_idx) or (
+                    self.inst_idx is not None
+                    and vals[self.inst_idx] is ERROR
+                ):
+                    record_error(
+                        "Error value encountered in grouping columns, "
+                        "skipping the row",
+                        str(self.node),
+                    )
+                    continue
                 gk = int(gks[i]) if gks is not None else self._group_key(vals)
                 gs = self.groups.get(gk)
                 if gs is None:
@@ -571,16 +586,26 @@ class GroupByExec(NodeExec):
                 for acc, idx in zip(gs.accs, self.arg_idx):
                     args = tuple(vals[j] for j in idx)
                     if any(a is ERROR for a in args):
-                        # aggregating a poisoned value poisons the aggregate
-                        # while the poisoned row is present; retraction
-                        # un-poisons (reference: Value::Error propagation,
-                        # src/engine/error.rs)
-                        acc.poisoned_count += d
+                        # skip_errors (the groupby default) drops ERROR
+                        # args; otherwise they poison the aggregate while
+                        # present and a retraction un-poisons (reference:
+                        # Value::Error propagation, src/engine/error.rs).
+                        # Stateful reducers are append-only and cannot
+                        # retract: their poison is permanent (reference:
+                        # stateful reducers do not recover from errors)
+                        if not acc.spec.skip_errors:
+                            acc.poisoned_count += (
+                                abs(d) if acc.spec.kind == "stateful" else d
+                            )
                         continue
                     try:
                         acc.update(args, d, order, t)
                     except Exception as exc:
-                        record_error(exc, str(self.node))
+                        # a failing stateful combine poisons its aggregate
+                        # permanently (reference: stateful reducers do not
+                        # recover from errors)
+                        record_error(exc, str(self.node), user=True)
+                        acc.poisoned_count += abs(d)
                 touched[gk] = None
         out_rows: list[tuple[int, int, tuple]] = []
         from pathway_tpu.engine.batch import _values_eq
@@ -916,16 +941,84 @@ class JoinExec(NodeExec):
         self.right.defer_bulk(jks_r, rb.keys, list(rb.columns.values()))
         return out
 
+    def _drop_error_keys(
+        self, b: DiffBatch, on_idx: list[int]
+    ) -> tuple[DiffBatch, DiffBatch | None]:
+        """Rows whose join-key columns hold ERROR are skipped and logged
+        (reference: join condition error handling, dataflow.rs join
+        arrangement Error filtering)."""
+        from pathway_tpu.internals.api import Error
+
+        cols = list(b.columns.values())
+        bad = None
+        for i in on_idx:
+            col = cols[i]
+            if col.dtype == object:
+                m = np.fromiter(
+                    (isinstance(v, Error) for v in col), bool, count=len(b)
+                )
+                bad = m if bad is None else (bad | m)
+        if bad is None or not bad.any():
+            return b, None
+        for _ in range(int(bad.sum())):
+            record_error(
+                "Error value encountered in join condition, "
+                "skipping the row",
+                str(self.node),
+            )
+        return b.mask(~bad), b.mask(bad)
+
+    def _outer_rows_for_dropped(
+        self, dropped: DiffBatch, side: str
+    ) -> list[tuple[int, int, tuple]]:
+        """Error-keyed rows never match, but outer joins still surface
+        them as unmatched rows of their side (reference: left join keeps
+        the Error row with nulls on the other side)."""
+        node = self.node
+        out = []
+        for k, d, vals in dropped.iter_rows():
+            if side == "left":
+                okey = k if node.id_from == "left" else int(
+                    ref_scalar(Pointer(k), None)
+                )
+                out.append(
+                    (okey, d, vals + (None,) * self.n_r + (Pointer(k), None))
+                )
+            else:
+                okey = k if node.id_from == "right" else int(
+                    ref_scalar(None, Pointer(k))
+                )
+                out.append(
+                    (okey, d, (None,) * self.n_l + vals + (None, Pointer(k)))
+                )
+        return out
+
     def process(self, t, inputs):
         lb = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
         rb = _concat_inputs(inputs[1], self.node.inputs[1].column_names)
+        outer_rows: list[tuple[int, int, tuple]] = []
+        if len(lb):
+            lb, dropped = self._drop_error_keys(lb, self.l_on_idx)
+            if dropped is not None and self.node.mode in ("left", "outer"):
+                outer_rows.extend(self._outer_rows_for_dropped(dropped, "left"))
+        if len(rb):
+            rb, dropped = self._drop_error_keys(rb, self.r_on_idx)
+            if dropped is not None and self.node.mode in ("right", "outer"):
+                outer_rows.extend(
+                    self._outer_rows_for_dropped(dropped, "right")
+                )
+        extra = (
+            [DiffBatch.from_rows(outer_rows, self.node.column_names)]
+            if outer_rows
+            else []
+        )
         if not len(lb) and not len(rb):
-            return []
+            return extra
         jks_l = self._batch_jks(lb, self.l_on_idx) if len(lb) else np.empty(0, np.uint64)
         jks_r = self._batch_jks(rb, self.r_on_idx) if len(rb) else np.empty(0, np.uint64)
         bulk = self._try_bulk(lb, rb, jks_l, jks_r)
         if bulk is not None:
-            return bulk
+            return extra + bulk
         touched: dict[int, None] = {}
         jl = jks_l.tolist()
         l_updates = []
@@ -959,8 +1052,8 @@ class JoinExec(NodeExec):
                 if old is None or not _values_eq(old, vals):
                     out_rows.append((okey, 1, vals))
         if not out_rows:
-            return []
-        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+            return extra
+        return extra + [DiffBatch.from_rows(out_rows, self.node.column_names)]
 
 
 # ---------------------------------------------------------------------------

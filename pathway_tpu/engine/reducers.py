@@ -25,6 +25,9 @@ class ReducerSpec:
     kind: str
     arg_cols: tuple[str, ...] = ()
     skip_nones: bool = False
+    # reference groupby(_skip_errors=True) default: ERROR args are simply
+    # skipped; with False they poison the aggregate while present
+    skip_errors: bool = True
     fn: Callable | None = None  # stateful combine fn
     extra: dict = field(default_factory=dict)
 
@@ -157,13 +160,15 @@ class _MultisetAcc(Accumulator):
         state mutates in place."""
         items = self.items
         skip = self.spec.skip_nones
+        skip_err = self.spec.skip_errors
         for k in zip(*argcols, diffs):
             d = k[-1]
             args = k[:-1]
             if skip and args[0] is None:
                 continue
             if any(a is ERROR for a in args):
-                self.poisoned_count += d
+                if not skip_err:
+                    self.poisoned_count += d
                 continue
             c = items.get(args, 0) + d
             if c == 0:

@@ -393,9 +393,15 @@ class Runtime:
                 ex.inject(b)
         inputs = [produced.get(inp.id, []) for inp in node.inputs]
         t0 = _time.perf_counter_ns()
-        out = ex.process(t, inputs)
-        if final:
-            out = list(out) + list(ex.on_end())
+        from pathway_tpu.internals.errors import set_exec_scope
+
+        set_exec_scope(getattr(node, "_error_scope", None))
+        try:
+            out = ex.process(t, inputs)
+            if final:
+                out = list(out) + list(ex.on_end())
+        finally:
+            set_exec_scope(None)
         produced[node.id] = out
         nrows = sum(len(b) for b in out)
         if nrows:
@@ -656,6 +662,23 @@ class Runtime:
         # "alt-neu" steps (reference: src/engine/timestamp.rs:20-32)
         return (int(_time.time() * 1000) // 2) * 2
 
+    def _drain_error_logs(self) -> None:
+        """One extra NON-final pass after the END tick: errors recorded
+        DURING the final tick (on_end flushes hitting filters/joins) would
+        otherwise be stranded — the error-log node may sit before the
+        erroring branch in topo order. Runs whenever the graph contains an
+        error-log node (unconditional, so multi-process lockstep groups
+        take the same number of passes)."""
+        from pathway_tpu.internals.error_log_table import ErrorLogExec
+
+        if not any(isinstance(e, ErrorLogExec) for e in self.execs.values()):
+            return
+        produced: dict[int, list] = {}
+        for node in self.order:
+            self._process_node(
+                node, END_OF_TIME, produced, None, False, self.stats
+            )
+
     def run(self) -> None:
         has_streaming = any(
             isinstance(node, InputNode)
@@ -667,6 +690,7 @@ class Runtime:
                 self.run_streaming()
             else:
                 self.run_static()
+            self._drain_error_logs()
         finally:
             if self._pool is not None:
                 self._pool.shutdown(wait=True, cancel_futures=True)

@@ -1,11 +1,17 @@
 """Error log exposed as a table (reference: global_error_log,
-python/pathway/internals/errors.py)."""
+python/pathway/internals/errors.py).
+
+The log node DRAINS newly-recorded errors every tick (and at the final
+tick), so errors produced during the same run appear in the table —
+matching the reference, where the error log is itself a streaming table
+fed by the engine. Scoped logs (pw.local_error_log) see only entries
+tagged with their scope id; the global log sees untagged entries.
+"""
 
 from __future__ import annotations
 
 from pathway_tpu.engine.batch import DiffBatch
-from pathway_tpu.engine.nodes import InputNode
-from pathway_tpu.engine.runtime import StaticSource
+from pathway_tpu.engine.nodes import Node, NodeExec
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.api import sequential_key
 from pathway_tpu.internals.errors import peek_errors
@@ -15,22 +21,51 @@ from pathway_tpu.internals.universe import Universe
 _COLS = ["message", "operator_id", "trace"]
 
 
-class _ErrorLogSource(StaticSource):
-    def __init__(self):
-        super().__init__(_COLS)
+class ErrorLogNode(Node):
+    def __init__(self, scope: int | None):
+        super().__init__([], _COLS)
+        self.scope = scope
 
-    def events(self):
+    def make_exec(self):
+        return ErrorLogExec(self)
+
+
+class ErrorLogExec(NodeExec):
+    def __init__(self, node: ErrorLogNode):
+        super().__init__(node)
+        self._pos = 0  # position in the FULL log (all scopes)
+
+    def _drain(self) -> list[DiffBatch]:
         errs = peek_errors()
-        rows = [
-            (int(sequential_key(i)), 1, (e["message"], e["operator_id"], e["trace"]))
-            for i, e in enumerate(errs)
-        ]
-        if rows:
-            yield 0, DiffBatch.from_rows(rows, _COLS)
+        rows = []
+        for i in range(self._pos, len(errs)):
+            e = errs[i]
+            if e.get("log_id") != self.node.scope:
+                continue
+            rows.append(
+                (
+                    int(sequential_key(i)),
+                    1,
+                    (e["message"], e["operator_id"], e["trace"]),
+                )
+            )
+        self._pos = len(errs)
+        if not rows:
+            return []
+        return [DiffBatch.from_rows(rows, _COLS)]
+
+    def process(self, t, inputs):
+        return self._drain()
+
+    def on_end(self):
+        return self._drain()
+
+    def state_dict(self):
+        return None  # the log is process-transient, never snapshotted
 
 
-def error_log_table() -> Table:
-    node = InputNode(_ErrorLogSource(), _COLS)
+def error_log_table(scope: int | None = None) -> Table:
+    node = ErrorLogNode(scope)
     return Table._from_node(
         node,
         {"message": dt.STR, "operator_id": dt.STR, "trace": dt.STR},

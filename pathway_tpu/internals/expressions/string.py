@@ -145,7 +145,19 @@ class StringNamespace:
                     return None
 
         else:
-            fn = int
+
+            def fn(s):
+                try:
+                    return int(s)
+                except (ValueError, TypeError):
+                    from pathway_tpu.internals.errors import EngineError
+
+                    # reference wording (rust i64::from_str error)
+                    raise EngineError(
+                        f'parse error: cannot parse "{s}" to int: '
+                        "invalid digit found in string"
+                    )
+
         return _m("str.parse_int", fn, ret, self._expr)
 
     def parse_float(self, optional: bool = False):

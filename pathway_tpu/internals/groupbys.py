@@ -30,12 +30,14 @@ class GroupedTable:
         instance: ColumnExpression | None = None,
         set_id: bool = False,
         sort_by: Any = None,
+        skip_errors: bool = True,
     ):
         self._table = table
         self._grouping = list(grouping)
         self._instance = instance
         self._set_id = set_id
         self._sort_by = sort_by
+        self._skip_errors = skip_errors
 
     def reduce(self, *args: Any, **kwargs: Any) -> Any:
         from pathway_tpu.internals.table import Table, infer_dtype
@@ -142,6 +144,7 @@ class GroupedTable:
                 kind=desc.kind,
                 arg_cols=tuple(arg_cols),
                 skip_nones=desc.skip_nones,
+                skip_errors=self._skip_errors,
                 fn=desc.fn,
                 extra=desc.extra,
             )

@@ -574,6 +574,18 @@ class Table(Joinable):
         }
         return self.select(**exprs)
 
+    def remove_errors(self) -> "Table":
+        """Drop rows containing an ERROR value in any column (reference:
+        Table.remove_errors, internals/table.py; engine filter_out_errors).
+        """
+        from pathway_tpu.internals.common import apply_with_type
+
+        cols = [self[n] for n in self.column_names()]
+        probe = apply_with_type(lambda *_v: True, bool, *cols)
+        import pathway_tpu as pw
+
+        return self.filter(pw.fill_error(probe, False))
+
     def filter(self, filter_expression: Any) -> "Table":
         e = self._desugar(filter_expression)
         tables = _collect_tables([e])
@@ -638,7 +650,7 @@ class Table(Joinable):
             grouping = [self._desugar(id)]
         return GroupedTable(
             self, grouping, instance=self._desugar(instance) if instance is not None else None,
-            set_id=id is not None, sort_by=sort_by
+            set_id=id is not None, sort_by=sort_by, skip_errors=_skip_errors
         )
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":

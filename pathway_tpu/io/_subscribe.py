@@ -30,7 +30,13 @@ def subscribe(
     col_names = table.column_names()
 
     def on_batch(t: int, batch: DiffBatch) -> None:
+        from pathway_tpu.internals.api import Error
+
         for k, d, vals in batch.iter_rows():
+            if any(isinstance(v, Error) for v in vals):
+                # reference: output connectors skip rows carrying Error
+                # values (the error is already in the log)
+                continue
             row = dict(zip(col_names, vals))
             on_change(key=Pointer(k), row=row, time=t, is_addition=d > 0)
         if on_time_end is not None:

@@ -16,10 +16,7 @@ def _capture(table) -> dict[int, tuple]:
 
 
 def _both(t1, t2):
-    from pathway_tpu.debug import _run_capture
-
-    c1, c2 = _run_capture([t1, t2])
-    return c1.rows, c2.rows
+    return _pairs(t1, t2)[0]
 
 
 def _norm(v: Any) -> Any:
@@ -38,32 +35,48 @@ def _norm(v: Any) -> Any:
     return v
 
 
-def assert_table_equality(t1, t2) -> None:
-    """Same keys AND same values per key."""
-    r1, r2 = _both(t1, t2)
-    n1 = {k: tuple(_norm(x) for x in v) for k, v in r1.items()}
-    n2 = {k: tuple(_norm(x) for x in v) for k, v in r2.items()}
-    assert n1 == n2, (
-        f"\nleft:  {sorted(n1.items(), key=str)}"
-        f"\nright: {sorted(n2.items(), key=str)}"
-    )
+def _pairs(t1, t2):
+    """Support the reference's tuple form: comparing N table pairs in ONE
+    graph run (tests/utils.py passes e.g. (result, error_log) vs
+    (expected, expected_errors))."""
+    from pathway_tpu.debug import _run_capture
+
+    lefts = list(t1) if isinstance(t1, (tuple, list)) else [t1]
+    rights = list(t2) if isinstance(t2, (tuple, list)) else [t2]
+    assert len(lefts) == len(rights)
+    caps = _run_capture(lefts + rights)
+    n = len(lefts)
+    return [(caps[i].rows, caps[n + i].rows) for i in range(n)]
 
 
-def assert_table_equality_wo_index(t1, t2) -> None:
+def assert_table_equality(t1, t2, **kwargs) -> None:
+    """Same keys AND same values per key. Extra kwargs
+    (terminate_on_error=...) are accepted for reference-test parity; the
+    debug capture path never terminates on ERROR rows."""
+    for r1, r2 in _pairs(t1, t2):
+        n1 = {k: tuple(_norm(x) for x in v) for k, v in r1.items()}
+        n2 = {k: tuple(_norm(x) for x in v) for k, v in r2.items()}
+        assert n1 == n2, (
+            f"\nleft:  {sorted(n1.items(), key=str)}"
+            f"\nright: {sorted(n2.items(), key=str)}"
+        )
+
+
+def assert_table_equality_wo_index(t1, t2, **kwargs) -> None:
     """Same multiset of rows, ignoring keys."""
-    r1, r2 = _both(t1, t2)
+    for r1, r2 in _pairs(t1, t2):
 
-    def multiset(rows):
-        out: dict = {}
-        for v in rows.values():
-            key = tuple(_norm(x) for x in v)
-            out[key] = out.get(key, 0) + 1
-        return out
+        def multiset(rows):
+            out: dict = {}
+            for v in rows.values():
+                key = tuple(_norm(x) for x in v)
+                out[key] = out.get(key, 0) + 1
+            return out
 
-    m1, m2 = multiset(r1), multiset(r2)
-    assert m1 == m2, (
-        f"\nleft:  {sorted(m1, key=str)}\nright: {sorted(m2, key=str)}"
-    )
+        m1, m2 = multiset(r1), multiset(r2)
+        assert m1 == m2, (
+            f"\nleft:  {sorted(m1, key=str)}\nright: {sorted(m2, key=str)}"
+        )
 
 
 assert_table_equality_wo_index_types = assert_table_equality_wo_index
@@ -121,3 +134,194 @@ def assert_stream_equality(t1, t2, **kwargs) -> None:
         f"\nleft:  {sorted(c1.items(), key=str)}"
         f"\nright: {sorted(c2.items(), key=str)}"
     )
+
+
+# --- streaming test utilities (reference: tests/utils.py DiffEntry,
+# CheckKeyConsistentInStreamCallback, assert_split_into_time_groups,
+# CsvPathwayChecker) --------------------------------------------------------
+
+
+class DiffEntry:
+    """One expected stream update for a key, ordered by (order, insertion)
+    (reference: tests/utils.py:166)."""
+
+    def __init__(self, key, order: int, insertion: bool, row: dict):
+        self.key = key
+        self.order = order
+        self.insertion = insertion
+        self.row = row
+
+    @staticmethod
+    def create(pk_table, pk_columns: dict, order: int, insertion: bool, row: dict, instance=None):
+        key = DiffEntry.create_id_from(pk_table, pk_columns, instance=instance)
+        return DiffEntry(key, order, insertion, row)
+
+    @staticmethod
+    def create_id_from(pk_table, pk_columns: dict, instance=None):
+        from pathway_tpu.internals import api
+
+        values = list(pk_columns.values())
+        if instance is None:
+            return api.ref_scalar(*values)
+        return api.ref_scalar_with_instance(*values, instance=instance)
+
+    def _sort_key(self):
+        return (int(self.key), self.order, self.insertion)
+
+    def __repr__(self):
+        return (
+            f"DiffEntry(key={self.key}, order={self.order}, "
+            f"insertion={self.insertion}, row={self.row})"
+        )
+
+
+class _CheckKeyConsistentCallback:
+    """For each key: the observed update sequence must be a subsequence of
+    the expected (order, insertion)-sorted sequence, and drain it fully
+    (reference: CheckKeyConsistentInStreamCallback)."""
+
+    def __init__(self, state_list):
+        import collections
+
+        self.state = collections.defaultdict(collections.deque)
+        for entry in sorted(state_list, key=DiffEntry._sort_key):
+            self.state[int(entry.key)].append(entry)
+
+    def __call__(self, key, row, time, is_addition):
+        q = self.state.get(int(key))
+        assert q, (
+            f"Got unexpected entry key={key} row={row} "
+            f"is_addition={is_addition}, expected={dict(self.state)!r}"
+        )
+        while True:
+            entry = q.popleft()
+            if (is_addition, row) == (entry.insertion, entry.row):
+                if not q:
+                    self.state.pop(int(key))
+                break
+            else:
+                assert q, (
+                    "Skipping over entries emptied the expected set for "
+                    f"key={key}, state={dict(self.state)!r}"
+                )
+
+    def on_end(self):
+        assert not self.state, f"Non empty final state = {dict(self.state)!r}"
+
+
+def assert_key_entries_in_stream_consistent(expected, table) -> None:
+    cb = _CheckKeyConsistentCallback(expected)
+
+    def on_change(key, row, time, is_addition):
+        cb(key, row, time, is_addition)
+
+    pw.io.subscribe(table, on_change, cb.on_end)
+
+
+def _assert_split_into_time_groups(s0, s1, transform) -> None:
+    import collections
+
+    result = [transform(k, v, t, d) for k, v, t, d in s0]
+    expected = [transform(k, v, t, d) for k, v, t, d in s1]
+    assert len(result) == len(expected), (len(result), len(expected))
+    counts = collections.Counter(row[0] for row in expected)
+    for key, count in counts.items():
+        if count != 1:
+            raise ValueError(
+                "This utility function does not support cases where the "
+                f"count of (value, diff) pair is !=1, but the count of "
+                f"{key} is {count}."
+            )
+    result.sort(key=repr)
+    expected.sort(key=repr)
+    expected_to_result_time: dict = {}
+    for (res_val, res_time), (ex_val, ex_time) in zip(result, expected):
+        assert res_val == ex_val, (res_val, ex_val)
+        if ex_time not in expected_to_result_time:
+            expected_to_result_time[ex_time] = res_time
+        if res_time != expected_to_result_time[ex_time]:
+            raise AssertionError(
+                f"Expected {res_val} to have time "
+                f"{expected_to_result_time[ex_time]} but it has time "
+                f"{res_time}."
+            )
+
+
+def assert_stream_split_into_groups(t1, t2, **kwargs) -> None:
+    """Streams equal up to a consistent renaming of times; expected may
+    split one result time into several groups (reference:
+    assert_streams_in_time_groups)."""
+    s1, s2 = _capture_streams([t1, t2], **kwargs)
+
+    def transform(k, v, t, d):
+        return (k, tuple(_norm(x) for x in v), d), t
+
+    _assert_split_into_time_groups(s1, s2, transform)
+
+
+def assert_stream_split_into_groups_wo_index(t1, t2, **kwargs) -> None:
+    s1, s2 = _capture_streams([t1, t2], **kwargs)
+
+    def transform(k, v, t, d):
+        return (tuple(_norm(x) for x in v), d), t
+
+    _assert_split_into_time_groups(s1, s2, transform)
+
+
+class CsvPathwayChecker:
+    """Poll an output-csv directory until it folds to the expected table
+    (reference: tests/utils.py:469)."""
+
+    def __init__(self, expected: str, output_path, *, id_from=None):
+        self.expected = expected
+        self.output_path = output_path
+        self.id_from = id_from
+        self.exception: Exception | None = None
+
+    def __call__(self) -> bool:
+        import os
+
+        import pandas as pd
+
+        try:
+            ex = pw.debug.table_from_markdown(self.expected)
+            dfs = []
+            for entry in sorted(os.listdir(self.output_path)):
+                dfs.append(pd.read_csv(os.path.join(self.output_path, entry)))
+            df = pd.concat(dfs, ignore_index=True).rename(
+                columns={"time": "__time__", "diff": "__diff__"}
+            )
+            res = pw.debug.table_from_pandas(df, id_from=self.id_from)
+            assert_table_equality_wo_index(res, ex)
+        except Exception as exception:
+            self.exception = exception
+            return False
+        return True
+
+    def provide_information_on_failure(self):
+        return self.exception
+
+
+def wait_result_with_checker(checker, timeout_s: float = 15.0, step: float = 0.1):
+    """Run the graph in a thread, poll `checker` until it holds, stop the
+    run (reference: tests/utils.py wait_result_with_checker)."""
+    import threading
+    import time
+
+    th = threading.Thread(
+        target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE),
+        daemon=True,
+    )
+    th.start()
+    deadline = time.time() + timeout_s
+    ok = False
+    while time.time() < deadline:
+        if checker():
+            ok = True
+            break
+        time.sleep(step)
+    rt = pw.internals.parse_graph.G.runtime
+    if rt is not None:
+        rt.stop()
+    th.join(timeout=10)
+    assert ok, f"checker never satisfied: {checker.provide_information_on_failure()}"

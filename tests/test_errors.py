@@ -85,7 +85,9 @@ def test_error_poison_flows_through_groupby():
         """
     )
     poisoned = t.select(t.g, q=t.a // t.b)
-    res = poisoned.groupby(poisoned.g).reduce(
+    # _skip_errors=False: an ERROR arg poisons the aggregate while present
+    # (the reference's propagate mode; the default SKIPS error args)
+    res = poisoned.groupby(poisoned.g, _skip_errors=False).reduce(
         poisoned.g, total=pw.reducers.sum(poisoned.q)
     )
     _k, cols = table_to_dicts(res)
@@ -93,6 +95,21 @@ def test_error_poison_flows_through_groupby():
     # y is clean; x contains a poisoned row -> aggregate poisons
     assert got["y"] == 2
     assert got["x"] is ERROR
+    # default mode: error args skipped, aggregate over clean rows
+    pw.internals.parse_graph.G.clear()
+    t2 = T(
+        """
+        g | a | b
+        x | 6 | 2
+        x | 5 | 0
+        y | 8 | 4
+        """
+    )
+    p2 = t2.select(t2.g, q=t2.a // t2.b)
+    res2 = p2.groupby(p2.g).reduce(p2.g, total=pw.reducers.sum(p2.q))
+    _k2, cols2 = table_to_dicts(res2)
+    got2 = {cols2["g"][k]: cols2["total"][k] for k in cols2["g"]}
+    assert got2 == {"x": 3, "y": 2}
 
 
 def test_retracting_poisoned_row_unpoisons_aggregate():

@@ -1,0 +1,687 @@
+"""Ported reference error-model tests (reference:
+python/pathway/tests/test_errors.py, 1,493 LoC). Adaptations: key strings
+inside messages (duplicate-key ids) are engine-specific and matched
+loosely; everything else ports verbatim."""
+
+from unittest import mock
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T
+from ref_utils import (
+    assert_stream_equality_wo_index,
+    assert_table_equality_wo_index,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    pw.internals.errors.clear_errors()
+    yield
+    pw.internals.parse_graph.G.clear()
+    pw.internals.errors.clear_errors()
+
+
+def test_division_by_zero():
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+    """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b)
+    t3 = t1.select(y=pw.this.a // pw.this.c)
+    t4 = t1.select(
+        pw.this.a, x=pw.fill_error(t2.x, -1), y=pw.fill_error(t3.y, -1)
+    )
+    expected = T(
+        """
+        a |  x |  y
+        3 |  1 |  3
+        4 | -1 |  2
+        5 |  1 | -1
+        6 |  3 |  2
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        division by zero
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (t4, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_removal_of_error():
+    t1 = T(
+        """
+          | a | b | __time__ | __diff__
+        1 | 6 | 2 |     2    |     1
+        2 | 5 | 0 |     4    |     1
+        3 | 4 | 2 |     6    |     1
+        2 | 5 | 0 |     8    |    -1
+    """
+    )
+    t2 = t1.with_columns(c=pw.this.a // pw.this.b)
+    expected = T(
+        """
+        a | b | c
+        4 | 2 | 2
+        6 | 2 | 3
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        division by zero
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (t2, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_filter_with_error_in_condition():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        5 | 5
+        4 | 0
+        3 | 3
+    """
+    )
+    t2 = t1.with_columns(x=pw.this.a // pw.this.b)
+    res = t2.filter(pw.this.x > 0)
+    expected = T(
+        """
+        a | b | x
+        3 | 3 | 1
+        5 | 5 | 1
+        6 | 2 | 3
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        Error value encountered in filter condition, skipping the row
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_filter_with_error_in_other_column():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | b
+        3 | 3
+        4 | 0
+        5 | 5
+        6 | 2
+    """
+    )
+    t2 = t1.with_columns(x=pw.this.a // pw.this.b)
+    res = t2.filter(pw.this.a > 0)
+    expected = T(
+        """
+        a | b |  x
+        3 | 3 |  1
+        4 | 0 | -1
+        5 | 5 |  1
+        6 | 2 |  3
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (
+            res.with_columns(x=pw.fill_error(pw.this.x, -1)),
+            pw.global_error_log().select(pw.this.message),
+        ),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_inner_join_with_error_in_condition():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | c
+        1 | 1
+        2 | 0
+        3 | 1
+    """
+    ).with_columns(a=pw.this.a // pw.this.c)
+    t2 = pw.debug.table_from_markdown(
+        """
+        b
+        1
+        1
+        2
+    """
+    )
+    res = t1.join(t2, pw.left.a == pw.right.b).select(
+        pw.left.a, pw.left.c, pw.right.b
+    )
+    expected = T(
+        """
+        a | c | b
+        1 | 1 | 1
+        1 | 1 | 1
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        Error value encountered in join condition, skipping the row
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_left_join_with_error_in_condition():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | c
+        1 | 1
+        2 | 0
+        3 | 1
+    """
+    ).with_columns(a=pw.this.a // pw.this.c)
+    t2 = pw.debug.table_from_markdown(
+        """
+        b
+        1
+        1
+        1
+        2
+    """
+    )
+    res = t1.join_left(t2, pw.left.a == pw.right.b).select(
+        a=pw.fill_error(pw.left.a, -1), c=pw.left.c, b=pw.right.b
+    )
+    expected = T(
+        """
+        a | c | b
+        1 | 1 | 1
+        1 | 1 | 1
+        1 | 1 | 1
+       -1 | 0 |
+        3 | 1 |
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        Error value encountered in join condition, skipping the row
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_local_logs():
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | a
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+    """
+    )
+    with pw.local_error_log() as error_log_1:
+        t2 = t1.select(x=pw.this.a // pw.this.b)
+    with pw.local_error_log() as error_log_2:
+        t3 = t1.select(y=pw.this.c.str.parse_int())
+    t4 = t1.select(
+        pw.this.a,
+        x=pw.fill_error(t2.x, -1),
+        y=pw.fill_error(t3.y, -1),
+        z=pw.this.a // t3.y,
+    )
+    assert_table_equality_wo_index(
+        (
+            t4.with_columns(z=pw.fill_error(pw.this.z, -1)),
+            pw.global_error_log().select(pw.this.message),
+            error_log_1.select(pw.this.message),
+            error_log_2.select(pw.this.message),
+        ),
+        (
+            T(
+                """
+            a |  x |  y |  z
+            3 |  1 | -1 | -1
+            4 | -1 |  2 |  2
+            5 |  1 |  0 | -1
+            6 |  3 |  3 |  2
+            """
+            ),
+            T(
+                """
+            message
+            division by zero
+            """,
+                split_on_whitespace=False,
+            ),
+            T(
+                """
+            message
+            division by zero
+            """,
+                split_on_whitespace=False,
+            ),
+            T(
+                """
+            message
+            parse error: cannot parse "a" to int: invalid digit found in string
+            """,
+                split_on_whitespace=False,
+            ),
+        ),
+        terminate_on_error=False,
+    )
+
+
+def test_subscribe():
+    t1 = T(
+        """
+        a | b
+        3 | 3
+        4 | 0
+        5 | 5
+        6 | 2
+    """
+    )
+    t2 = t1.with_columns(x=pw.this.a // pw.this.b)
+    on_change = mock.Mock()
+    pw.io.subscribe(t2, on_change=on_change)
+    pw.run(terminate_on_error=False, monitoring_level=pw.MonitoringLevel.NONE)
+    assert on_change.call_count == 3
+
+
+@pytest.mark.parametrize("sync", [True, False])
+def test_udf(sync: bool) -> None:
+    t1 = T(
+        """
+        a | b
+        3 | 3
+        4 | 0
+        5 | 5
+        6 | 2
+    """
+    )
+    if sync:
+
+        @pw.udf(deterministic=True)
+        def div(a: int, b: int) -> int:
+            return a // b
+
+    else:
+
+        @pw.udf(deterministic=True)
+        async def div(a: int, b: int) -> int:
+            return a // b
+
+    t2 = t1.select(pw.this.a, x=div(pw.this.a, pw.this.b))
+    res = t2.with_columns(x=pw.fill_error(pw.this.x, -1))
+    expected = T(
+        """
+        a |  x
+        3 |  1
+        4 | -1
+        5 |  1
+        6 |  3
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        ZeroDivisionError: integer division or modulo by zero
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_remove_errors():
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+    """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b)
+    t3 = t1.select(y=pw.this.a // pw.this.c)
+    t4 = t1.select(pw.this.a, x=t2.x, y=t3.y)
+    res = t4.remove_errors()
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | x | y
+            3 | 1 | 3
+            6 | 3 | 2
+            """
+        ),
+        terminate_on_error=False,
+    )
+
+
+def test_remove_errors_identity():
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 1 | 2
+        5 | 5 | 1
+        6 | 2 | 3
+    """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b)
+    t3 = t1.select(y=pw.this.a // pw.this.c)
+    t4 = t1.select(pw.this.a, x=t2.x, y=t3.y)
+    res = t4.remove_errors()
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | x | y
+            3 | 1 | 3
+            4 | 4 | 2
+            5 | 1 | 5
+            6 | 3 | 2
+            """
+        ),
+        terminate_on_error=False,
+    )
+
+
+def test_groupby_with_error_in_grouping_column():
+    t = T(
+        """
+        a | b | d
+        1 | 1 | 1
+        1 | 2 | 0
+        1 | 3 | 1
+        2 | 4 | 1
+        2 | 5 | 1
+    """
+    ).with_columns(a=pw.this.a // pw.this.d, b=pw.this.b // pw.this.d)
+    res = t.groupby(pw.this.a).reduce(
+        pw.this.a, b_sum=pw.reducers.sum(pw.this.b)
+    )
+    expected = T(
+        """
+        a | b_sum
+        1 |   4
+        2 |   9
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        division by zero
+        division by zero
+        Error value encountered in grouping columns, skipping the row
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_groupby_skip_errors():
+    @pw.reducers.stateful_single
+    def stateful_sum(state, val):
+        if state is None:
+            return val
+        return state + val
+
+    t = T(
+        """
+        a | b |  c  | d | e
+        1 | 1 | 1.5 | 1 | 1
+        1 | 2 | 2.5 | 0 | 1
+        1 | 3 | 3.5 | 1 | 0
+        2 | 4 | 4.5 | 1 | 1
+        2 | 5 | 5.5 | 1 | 0
+    """
+    ).with_columns(b=pw.this.b // pw.this.d, c=pw.this.c / pw.this.e)
+    res = (
+        t.groupby(pw.this.a, _skip_errors=True)
+        .reduce(
+            pw.this.a,
+            i_sum=pw.reducers.sum(pw.this.b),
+            i_avg=pw.reducers.avg(pw.this.b),
+            i_min=pw.reducers.min(pw.this.b),
+            f_sum=pw.reducers.sum(pw.this.c),
+            f_avg=pw.reducers.avg(pw.this.c),
+            f_min=pw.reducers.min(pw.this.c),
+            cnt=pw.reducers.count(),
+            st_sum=stateful_sum(pw.this.b),
+        )
+        .update_types(st_sum=int)
+    )
+    expected = T(
+        """
+        a | i_sum | i_avg | i_min | f_sum | f_avg | f_min | cnt | st_sum
+        1 |   4   |   2   |   1   |   4   |   2   |  1.5  |  3  |   4
+        2 |   9   |  4.5  |   4   |  4.5  |  4.5  |  4.5  |  2  |   9
+    """
+    )
+    assert_table_equality_wo_index(res, expected, terminate_on_error=False)
+
+
+def test_groupby_propagate_errors():
+    @pw.reducers.stateful_single
+    def stateful_sum(state, val):
+        if state is None:
+            return val
+        return state + val
+
+    t = T(
+        """
+        a | b |  c  | d | e
+        1 | 1 | 1.5 | 1 | 1
+        1 | 2 | 2.5 | 0 | 1
+        1 | 3 | 3.5 | 1 | 0
+        2 | 4 | 4.5 | 1 | 1
+        2 | 5 | 5.5 | 1 | 0
+    """
+    ).with_columns(b=pw.this.b // pw.this.d, c=pw.this.c / pw.this.e)
+    res = (
+        t.groupby(pw.this.a, _skip_errors=False)
+        .reduce(
+            pw.this.a,
+            i_sum=pw.fill_error(pw.reducers.sum(pw.this.b), -1),
+            i_avg=pw.fill_error(pw.reducers.avg(pw.this.b), -1),
+            i_min=pw.fill_error(pw.reducers.min(pw.this.b), -1),
+            f_sum=pw.fill_error(pw.reducers.sum(pw.this.c), -1),
+            f_avg=pw.fill_error(pw.reducers.avg(pw.this.c), -1),
+            f_min=pw.fill_error(pw.reducers.min(pw.this.c), -1),
+            cnt=pw.reducers.count(),
+            st_sum=pw.fill_error(stateful_sum(pw.this.b), -1),
+        )
+        .update_types(st_sum=int)
+    )
+    expected = T(
+        """
+        a | i_sum | i_avg | i_min | f_sum | f_avg | f_min | cnt | st_sum
+        1 |  -1   |  -1   |  -1   |  -1   |  -1   |  -1   |  3  |  -1
+        2 |   9   |  4.5  |   4   |  -1   |  -1   |  -1   |  2  |   9
+    """
+    ).update_types(f_sum=float, f_avg=float, f_min=float)
+    assert_table_equality_wo_index(res, expected, terminate_on_error=False)
+
+
+def test_groupby_stateful_with_error():
+    @pw.reducers.stateful_single
+    def stateful_sum(state, val):
+        if val == 2:
+            raise ValueError("Value 2 encountered")
+        if state is None:
+            return val
+        return state + val
+
+    t = T(
+        """
+        a | b
+        1 | 1
+        2 | 2
+        1 | 3
+        2 | 4
+        1 | 5
+    """
+    )
+    res = (
+        t.groupby(pw.this.a)
+        .reduce(pw.this.a, b=pw.fill_error(stateful_sum(pw.this.b), -1))
+        .update_types(b=int)
+    )
+    expected = T(
+        """
+        a |  b
+        1 |  9
+        2 | -1
+    """
+    )
+    expected_errors = T(
+        """
+        message
+        ValueError: Value 2 encountered
+    """,
+        split_on_whitespace=False,
+    )
+    assert_table_equality_wo_index(
+        (res, pw.global_error_log().select(pw.this.message)),
+        (expected, expected_errors),
+        terminate_on_error=False,
+    )
+
+
+def test_groupby_recovers_from_errors():
+    @pw.reducers.stateful_single
+    def stateful_sum(state, val):
+        if state is None:
+            return val
+        return state + val
+
+    t = T(
+        """
+          | b |  c  | d | e | __time__ | __diff__
+        1 | 1 | 1.5 | 1 | 1 |     2    |     1
+        2 | 2 | 2.5 | 0 | 1 |     4    |     1
+        3 | 3 | 3.5 | 1 | 0 |     6    |     1
+        2 | 2 | 2.5 | 0 | 1 |     8    |    -1
+        3 | 3 | 3.5 | 1 | 0 |    10    |    -1
+    """
+    ).with_columns(b=pw.this.b // pw.this.d, c=pw.this.c / pw.this.e)
+    res = (
+        t.groupby(_skip_errors=False)
+        .reduce(
+            i_sum=pw.fill_error(pw.reducers.sum(pw.this.b), -1),
+            i_avg=pw.fill_error(pw.reducers.avg(pw.this.b), -1),
+            i_min=pw.fill_error(pw.reducers.min(pw.this.b), -1),
+            f_sum=pw.fill_error(pw.reducers.sum(pw.this.c), -1),
+            f_avg=pw.fill_error(pw.reducers.avg(pw.this.c), -1),
+            f_min=pw.fill_error(pw.reducers.min(pw.this.c), -1),
+            cnt=pw.reducers.count(),
+            st_sum=pw.fill_error(stateful_sum(pw.this.b), -1),
+        )
+        .update_types(st_sum=int)
+    )
+    expected = T(
+        """
+          | i_sum | i_avg | i_min | f_sum | f_avg | f_min | cnt | st_sum | __time__ | __diff__
+        1 |   1   |   1   |   1   |  1.5  |  1.5  |  1.5  |  1  |   1    |     2    |     1
+        1 |   1   |   1   |   1   |  1.5  |  1.5  |  1.5  |  1  |   1    |     4    |    -1
+        1 |  -1   |  -1   |  -1   |  4.0  |  2.0  |  1.5  |  2  |  -1    |     4    |     1
+        1 |  -1   |  -1   |  -1   |  4.0  |  2.0  |  1.5  |  2  |  -1    |     6    |    -1
+        1 |  -1   |  -1   |  -1   | -1.0  | -1.0  | -1.0  |  3  |  -1    |     6    |     1
+        1 |  -1   |  -1   |  -1   | -1.0  | -1.0  | -1.0  |  3  |  -1    |     8    |    -1
+        1 |   4   |   2   |   1   | -1.0  | -1.0  | -1.0  |  2  |  -1    |     8    |     1
+        1 |   4   |   2   |   1   | -1.0  | -1.0  | -1.0  |  2  |  -1    |    10    |    -1
+        1 |   1   |   1   |   1   |  1.5  |  1.5  |  1.5  |  1  |  -1    |    10    |     1
+    """
+    ).update_types(i_avg=float)
+    assert_stream_equality_wo_index(res, expected, terminate_on_error=False)
+
+
+def test_unique_reducer():
+    t = T(
+        """
+        a | b | __time__ | __diff__
+        1 | 1 |     2    |     1
+        1 | 2 |     2    |     1
+        2 | 3 |     2    |     1
+        1 | 2 |     4    |    -1
+    """
+    )
+    res = t.groupby(pw.this.a).reduce(
+        pw.this.a, b=pw.fill_error(pw.reducers.unique(pw.this.b), -1)
+    )
+    expected = T(
+        """
+        a |  b
+        1 |  1
+        2 |  3
+    """
+    )
+    assert_table_equality_wo_index(res, expected, terminate_on_error=False)
+
+
+def test_global_error_first_operator():
+    # reading the global log before anything errors: empty table, no crash
+    log = pw.global_error_log().select(pw.this.message)
+    from ref_utils import _capture
+
+    rows = _capture(log)
+    assert rows == {}
