@@ -718,7 +718,7 @@ def compute_and_print_update_stream(
     cap = _run_capture([table])[0]
     col_names = table.column_names()
     header = ([""] if include_id else []) + col_names + ["__time__", "__diff__"]
-    updates = list(cap.updates[: n_rows if n_rows else None])
+    updates = list(cap.updates)
     # reference stream display order: (time, diff) first, then values,
     # then key; unsortable values keep CAPTURE order (sorted() leaves the
     # original untouched when a comparison raises)
@@ -731,6 +731,8 @@ def compute_and_print_update_stream(
         )
     except (ValueError, TypeError):
         pass
+    if n_rows is not None:
+        updates = updates[:n_rows]
     out_rows = []
     for t, k, d, vals in updates:
         key_s = str(Pointer(k))

@@ -377,9 +377,14 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
             if e._optional and any(v is None for v in vals):
                 out[i] = None
                 continue
-            p = ref_scalar(*vals)
             if inst is not None:
-                p = p.with_shard_of(ref_scalar(inst[i]))
+                # reference Key::for_values_with_instance: the instance is
+                # part of the hashed values AND supplies the shard bits
+                from pathway_tpu.internals.api import ref_scalar_with_instance
+
+                p = ref_scalar_with_instance(*vals, instance=inst[i])
+            else:
+                p = ref_scalar(*vals)
             out[i] = p
         return out
     if isinstance(e, expr.MethodCallExpression):
@@ -479,6 +484,12 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
 def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndarray:
     import asyncio
 
+    # the coroutines may run on a helper thread (run_async_blocking when a
+    # loop already runs here); capture the error-log scope so their errors
+    # still land in the right local log
+    from pathway_tpu.internals import errors as _err
+
+    _scope = _err._active_scope()
     n = ctx.n
     arrays = [eval_expr(a, ctx) for a in e._args]
     kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
@@ -498,7 +509,7 @@ def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndar
             except Exception as exc:
                 from pathway_tpu.internals.errors import record_error
 
-                record_error(exc, user=True)
+                record_error(exc, user=True, scope=_scope)
                 return ERROR
 
         return await asyncio.gather(*[one(i) for i in range(n)])

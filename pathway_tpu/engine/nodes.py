@@ -385,7 +385,7 @@ class GroupByExec(NodeExec):
         return ref_scalars_columns(gcols, len(b))
 
     _BULK_SEMIGROUP = ("count", "sum", "avg")
-    _BULK_MULTISET = ("min", "max", "argmin", "argmax", "unique", "any")
+    _BULK_MULTISET = ("min", "max", "argmin", "argmax", "unique")
 
     # pandas hashes some value pairs equal that ref_scalar distinguishes
     # (True==1==1.0; None merges with NaN in float columns), so the
@@ -601,11 +601,12 @@ class GroupByExec(NodeExec):
                     try:
                         acc.update(args, d, order, t)
                     except Exception as exc:
-                        # a failing stateful combine poisons its aggregate
-                        # permanently (reference: stateful reducers do not
-                        # recover from errors)
+                        # a failing STATEFUL combine poisons its aggregate
+                        # permanently (append-only state cannot retract);
+                        # other reducers just log, matching the bulk path
                         record_error(exc, str(self.node), user=True)
-                        acc.poisoned_count += abs(d)
+                        if acc.spec.kind == "stateful":
+                            acc.poisoned_count += abs(d)
                 touched[gk] = None
         out_rows: list[tuple[int, int, tuple]] = []
         from pathway_tpu.engine.batch import _values_eq

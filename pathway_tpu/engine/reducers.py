@@ -234,11 +234,35 @@ class UniqueAcc(_MultisetAcc):
         return next(iter(vals))
 
 
-class AnyAcc(_MultisetAcc):
+class AnyAcc(Accumulator):
+    """'Some' value — the one belonging to the smallest row key, so every
+    any() column of a group comes from the SAME row (reference relies on
+    this: joining a reduce of any(pet), any(owner), any(age) back against
+    the source matches exactly one row)."""
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.rows: dict[Any, list] = {}  # row key -> [value, count]
+
+    def update(self, args, diff, key, time):
+        if self.spec.skip_nones and args[0] is None:
+            return
+        e = self.rows.get(key)
+        if e is None:
+            if diff != 0:
+                self.rows[key] = [args[0], diff]
+        else:
+            e[1] += diff
+            if diff > 0:
+                e[0] = args[0]
+            if e[1] == 0:
+                del self.rows[key]
+
     def value(self):
-        if not self.items:
+        if not self.rows:
             return ERROR
-        return min((k[0] for k in self.items), key=_sort_key)
+        k = min(self.rows, key=_sort_key)
+        return self.rows[k][0]
 
 
 class _KeyedMultisetAcc(Accumulator):

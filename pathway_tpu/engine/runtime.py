@@ -264,6 +264,21 @@ class Runtime:
         distributed: bool | None = None,
     ):
         self.order = collect_nodes(outputs)
+        # error-log nodes (and everything downstream of them) run LAST:
+        # at the final tick every other node processes + flushes first, so
+        # the log drain sees final-tick errors and its consumers' on_end
+        # callbacks still fire after their last on_change (stable
+        # partition — moved nodes only consume already-processed outputs)
+        _late = set()
+        for node in self.order:
+            if type(node).__name__ == "ErrorLogNode" or any(
+                inp.id in _late for inp in node.inputs
+            ):
+                _late.add(node.id)
+        if _late:
+            self.order = [n for n in self.order if n.id not in _late] + [
+                n for n in self.order if n.id in _late
+            ]
         annotate_live_columns(self.order)
         # multi-process engine (DCN rung): stateful sharded execs exchange
         # host rows over the TCP mesh and ticks run in lockstep across the
@@ -662,23 +677,6 @@ class Runtime:
         # "alt-neu" steps (reference: src/engine/timestamp.rs:20-32)
         return (int(_time.time() * 1000) // 2) * 2
 
-    def _drain_error_logs(self) -> None:
-        """One extra NON-final pass after the END tick: errors recorded
-        DURING the final tick (on_end flushes hitting filters/joins) would
-        otherwise be stranded — the error-log node may sit before the
-        erroring branch in topo order. Runs whenever the graph contains an
-        error-log node (unconditional, so multi-process lockstep groups
-        take the same number of passes)."""
-        from pathway_tpu.internals.error_log_table import ErrorLogExec
-
-        if not any(isinstance(e, ErrorLogExec) for e in self.execs.values()):
-            return
-        produced: dict[int, list] = {}
-        for node in self.order:
-            self._process_node(
-                node, END_OF_TIME, produced, None, False, self.stats
-            )
-
     def run(self) -> None:
         has_streaming = any(
             isinstance(node, InputNode)
@@ -690,7 +688,6 @@ class Runtime:
                 self.run_streaming()
             else:
                 self.run_static()
-            self._drain_error_logs()
         finally:
             if self._pool is not None:
                 self._pool.shutdown(wait=True, cancel_futures=True)

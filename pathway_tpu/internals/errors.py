@@ -61,8 +61,14 @@ def _normalize_message(exc: Exception | str, user: bool) -> str:
     return str(exc)
 
 
+_SCOPE_ACTIVE = object()  # sentinel: use the thread's active scope
+
+
 def record_error(
-    exc: Exception | str, operator: str | None = None, user: bool = False
+    exc: Exception | str,
+    operator: str | None = None,
+    user: bool = False,
+    scope: Any = _SCOPE_ACTIVE,
 ) -> None:
     if isinstance(exc, BaseException):
         # drop traceback frames before retaining: each frame pins the
@@ -81,7 +87,7 @@ def record_error(
                 "message": _normalize_message(exc, user),
                 "operator_id": operator or "",
                 "trace": "",
-                "log_id": _active_scope(),
+                "log_id": _active_scope() if scope is _SCOPE_ACTIVE else scope,
                 # original exception object so terminate_on_error re-raises
                 # with its real type (reference: engine propagates DataError
                 # as the user's exception when terminate_on_error=true)

@@ -34,6 +34,13 @@ class GroupedTable:
     ):
         self._table = table
         self._grouping = list(grouping)
+        # the instance is part of the group identity: reference ids come
+        # from ref_scalar_with_instance(*grouping, instance) — the hash
+        # covers the instance value, the shard bits come from it too. It
+        # also makes the instance selectable in reduce() like any
+        # grouping column.
+        if instance is not None:
+            self._grouping = self._grouping + [instance]
         self._instance = instance
         self._set_id = set_id
         self._sort_by = sort_by
@@ -56,6 +63,18 @@ class GroupedTable:
                     else arg
                 )
                 out_exprs[name] = ref
+            elif isinstance(arg, ReducerExpression):
+                # positional reducer: named after its (single) column arg
+                # (reference: reduce(pw.reducers.any(t.pet)) -> column 'pet')
+                ref_args = [
+                    a for a in arg._args if isinstance(a, ColumnReference)
+                ]
+                if len(ref_args) != 1:
+                    raise TypeError(
+                        "positional reducer in reduce() must take exactly "
+                        "one column argument (name it with kwarg= instead)"
+                    )
+                out_exprs[ref_args[0].name] = arg
             else:
                 raise TypeError(f"positional reduce argument {arg!r}")
         for name, e in kwargs.items():

@@ -82,6 +82,14 @@ class JoinResult:
                 s = "r"
             elif isinstance(tbl, ThisPlaceholder):
                 s = None
+            elif (id(tbl), ref.name) in getattr(
+                self._left, "_join_aliases", {}
+            ):
+                s = "l"  # a table folded into the left side by a chained join
+            elif (id(tbl), ref.name) in getattr(
+                self._right, "_join_aliases", {}
+            ):
+                s = "r"
             else:
                 # resolve tables same-universe: assume left
                 s = "l" if tbl._universe is self._left._universe else "r"
@@ -114,7 +122,21 @@ class JoinResult:
 
         l_e = desugar(a, {left_ph: self._left, this_ph: self._left})
         r_e = desugar(b, {right_ph: self._right, this_ph: self._right})
-        return l_e, r_e
+
+        def apply_aliases(e, table):
+            aliases = getattr(table, "_join_aliases", None)
+            if not aliases:
+                return e
+
+            def sub(ref):
+                al = aliases.get((id(ref.table), ref.name))
+                if al is not None:
+                    return table[al]
+                return None
+
+            return wrap_expr(e)._substitute(sub)
+
+        return apply_aliases(l_e, self._left), apply_aliases(r_e, self._right)
 
     # --- materialization ------------------------------------------------------
 
@@ -215,7 +237,23 @@ class JoinResult:
         self._joined_cache = joined
         return joined, self._make_sub(joined)
 
+    def _equated_names(self) -> set[str]:
+        """Column names equated by the join condition (l.x == r.x): safe to
+        reference through pw.this even though both sides carry them."""
+        out = set()
+        for l_e, r_e in zip(self._left_on, self._right_on):
+            if (
+                isinstance(l_e, ColumnReference)
+                and isinstance(r_e, ColumnReference)
+                and l_e.name == r_e.name
+            ):
+                out.add(l_e.name)
+        return out
+
     def _make_sub(self, joined):
+        left_aliases = getattr(self._left, "_join_aliases", {})
+        right_aliases = getattr(self._right, "_join_aliases", {})
+
         def sub(ref: ColumnReference) -> ColumnReference | None:
             tbl = ref.table
             if tbl is joined:
@@ -228,13 +266,32 @@ class JoinResult:
                 if ref.name == "id":
                     return ColumnReference(joined, "_right_id")
                 return ColumnReference(joined, "r." + ref.name)
+            al = left_aliases.get((id(tbl), ref.name))
+            if al is not None:
+                return ColumnReference(joined, "l." + al)
+            ar = right_aliases.get((id(tbl), ref.name))
+            if ar is not None:
+                return ColumnReference(joined, "r." + ar)
             if isinstance(tbl, ThisPlaceholder):
                 if ref.name == "id":
                     return ColumnReference(joined, "id")
                 in_l = ref.name in self._left.column_names()
                 in_r = ref.name in self._right.column_names()
                 if in_l and in_r:
-                    raise ValueError(
+                    if ref.name in self._equated_names():
+                        # the join condition equates both copies; outer
+                        # joins leave one side None on unmatched rows, so
+                        # pw.this unifies them via coalesce (reference:
+                        # join condition columns unify)
+                        from pathway_tpu.internals.expression import (
+                            CoalesceExpression,
+                        )
+
+                        return CoalesceExpression(
+                            ColumnReference(joined, "l." + ref.name),
+                            ColumnReference(joined, "r." + ref.name),
+                        )
+                    raise KeyError(
                         f"column {ref.name!r} is ambiguous in join; "
                         "use pw.left/pw.right"
                     )
@@ -286,6 +343,48 @@ class JoinResult:
         out = copy.copy(self)
         out._joined_cache = filtered
         return out
+
+    # --- chained joins --------------------------------------------------------
+
+    def _flatten(self):
+        """Fold this join into one table carrying every column of both
+        sides, with an alias map so references to the ORIGINAL tables
+        still resolve in further joins/selects (reference: chained joins,
+        internals/joins.py JoinResult.join chaining)."""
+        joined, _sub = self._joined_with_sub()
+        exprs: dict[str, ColumnReference] = {}
+        aliases: dict[tuple[int, str], str] = {}
+        for tbl, prefix in ((self._left, "l."), (self._right, "r.")):
+            sub_aliases = getattr(tbl, "_join_aliases", {})
+            for n in tbl.column_names():
+                if n.startswith("_on") or n.startswith("_pw_"):
+                    continue
+                out_name = n
+                while out_name in exprs:
+                    out_name = "_" + out_name
+                exprs[out_name] = ColumnReference(joined, prefix + n)
+                aliases[(id(tbl), n)] = out_name
+                for key, v in sub_aliases.items():
+                    if v == n:
+                        aliases[key] = out_name
+        flat = joined.select(**exprs)
+        flat._join_aliases = aliases
+        return flat
+
+    def join(self, other, *on, id=None, how=JoinMode.INNER):
+        return JoinResult(self._flatten(), other, on, how, id_expr=id)
+
+    def join_inner(self, other, *on, id=None):
+        return self.join(other, *on, id=id, how=JoinMode.INNER)
+
+    def join_left(self, other, *on, id=None):
+        return self.join(other, *on, id=id, how=JoinMode.LEFT)
+
+    def join_right(self, other, *on, id=None):
+        return self.join(other, *on, id=id, how=JoinMode.RIGHT)
+
+    def join_outer(self, other, *on, id=None):
+        return self.join(other, *on, id=id, how=JoinMode.OUTER)
 
 
 class OuterJoinResult(JoinResult):

@@ -449,7 +449,8 @@ class Table(Joinable):
         raise TypeError(arg)
 
     def __iter__(self):
-        raise TypeError("Table is not iterable; use pw.debug.table_to_pandas")
+        # *table expands to its column references (reference: Table.__iter__)
+        return iter([self[n] for n in self.column_names()])
 
     # --- internal constructors ------------------------------------------------
 
@@ -555,12 +556,20 @@ class Table(Joinable):
         return self.rename_columns(**kwargs)
 
     def rename_columns(self, **kwargs: Any) -> "Table":
-        # new_name=old_ref
-        mapping = {}
+        # new_name=old_ref; reference column order: untouched columns stay
+        # in place, renamed ones append in kwargs order
+        renamed_src = {
+            (old if isinstance(old, str) else old.name): new
+            for new, old in kwargs.items()
+        }
+        exprs = {
+            n: self[n]
+            for n in self.column_names()
+            if n not in renamed_src
+        }
         for new, old in kwargs.items():
-            old_name = old if isinstance(old, str) else old.name
-            mapping[old_name] = new
-        return self.rename_by_dict(mapping)
+            exprs[new] = self[old if isinstance(old, str) else old.name]
+        return self.select(**exprs)
 
     def rename_by_dict(self, names_mapping: Mapping) -> "Table":
         mapping = {
@@ -573,6 +582,19 @@ class Table(Joinable):
             mapping.get(n, n): self[n] for n in self.column_names()
         }
         return self.select(**exprs)
+
+    @staticmethod
+    def from_columns(*args: Any, **kwargs: Any) -> "Table":
+        """Build a table from same-universe columns (reference:
+        Table.from_columns, internals/table.py)."""
+        cols: dict[str, Any] = {}
+        for arg in args:
+            cols[arg.name] = arg
+        cols.update(kwargs)
+        if not cols:
+            raise ValueError("Table.from_columns() requires columns")
+        first = next(iter(cols.values())).table
+        return first.select(**cols)
 
     def remove_errors(self) -> "Table":
         """Drop rows containing an ERROR value in any column (reference:
@@ -706,6 +728,11 @@ class Table(Joinable):
         **kwargs,
     ):
         from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+        if isinstance(other, JoinResult):
+            # joining against an unfinished join chains it: fold the inner
+            # join into one table first (reference: join chaining)
+            other = other._flatten()
 
         mode = how if how is not None else JoinMode.INNER
         if (left_instance is None) != (right_instance is None):

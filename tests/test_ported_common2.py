@@ -1,0 +1,908 @@
+"""More ported reference core tests (reference:
+python/pathway/tests/test_common.py — set ops, concat, flatten, filter,
+from_columns, if_else/coalesce, update_rows/cells, groupby variants,
+join composition)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_from_pandas
+from ref_utils import assert_table_equality, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_intersect():
+    t1 = T(
+        """
+            | col
+        1   | 11
+        2   | 12
+        3   | 13
+        """
+    )
+    t2 = T(
+        """
+            | col
+        2   | 11
+        3   | 11
+        4   | 11
+        """
+    )
+    assert_table_equality(
+        t1.intersect(t2),
+        T(
+            """
+                | col
+            2   | 12
+            3   | 13
+            """
+        ),
+    )
+
+
+def test_intersect_empty():
+    t1 = T(
+        """
+            | col
+        1   | 11
+        2   | 12
+        3   | 13
+        """
+    )
+    ret = t1.intersect()
+    assert_table_equality(ret, t1)
+
+
+def test_intersect_many_tables():
+    t1 = T(
+        """
+            | col
+        1   | 11
+        2   | 12
+        3   | 13
+        4   | 14
+        """
+    )
+    t2 = T(
+        """
+            | col
+        2   | 11
+        3   | 11
+        4   | 11
+        5   | 11
+        """
+    )
+    t3 = T(
+        """
+            | col
+        1   | 11
+        3   | 11
+        4   | 11
+        5   | 11
+        """
+    )
+    assert_table_equality(
+        t1.intersect(t2, t3),
+        T(
+            """
+                | col
+            3   | 13
+            4   | 14
+            """
+        ),
+    )
+
+
+def test_difference():
+    t1 = T(
+        """
+            | col
+        1   | 11
+        2   | 12
+        3   | 13
+        """
+    )
+    t2 = T(
+        """
+            | col
+        2   | 11
+        3   | 11
+        4   | 11
+        """
+    )
+    assert_table_equality(
+        t1.difference(t2),
+        T(
+            """
+                | col
+            1   | 11
+            """
+        ),
+    )
+
+
+def test_concat():
+    t1 = T(
+        """
+    lower | upper
+    a     | A
+    b     | B
+    """
+    )
+    t2 = T(
+        """
+    lower | upper
+    c     | C
+    """
+    )
+    res = pw.Table.concat_reindex(t1, t2)
+    expected = T(
+        """
+    lower | upper
+    a     | A
+    b     | B
+    c     | C
+        """,
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_concat_unsafe():
+    t1 = T(
+        """
+       | lower | upper
+    1  | a     | A
+    2  | b     | B
+    """
+    )
+    t2 = T(
+        """
+       | lower | upper
+    3  | c     | C
+    """
+    )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    res = pw.Table.concat(t1, t2)
+    expected = T(
+        """
+       | lower | upper
+    1  | a     | A
+    2  | b     | B
+    3  | c     | C
+        """,
+    )
+    assert_table_equality(res, expected)
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_flatten(dtype):
+    df = pd.DataFrame(
+        {
+            "array": [
+                np.array([1, 2], dtype=dtype),
+                np.array([], dtype=dtype),
+                np.array([3, 4], dtype=dtype),
+                np.array([10, 11, 12], dtype=dtype),
+                np.array([4, 5, 6, 1, 2], dtype=dtype),
+            ],
+            "other": [-1, -2, -3, -4, -5],
+        }
+    )
+    expected_df = pd.DataFrame(
+        {
+            "array": np.array(
+                [1, 2, 3, 4, 10, 11, 12, 4, 5, 6, 1, 2], dtype=dtype
+            ),
+            "other": [-1, -1, -3, -3, -4, -4, -4, -5, -5, -5, -5, -5],
+        }
+    )
+    t1 = table_from_pandas(df)
+    t1 = t1.flatten(t1.array)
+    expected = table_from_pandas(expected_df)
+    assert_table_equality_wo_index(t1, expected)
+
+
+def test_filter():
+    t_latin = T(
+        """
+            | lower | upper
+        1  | a     | A
+        2  | b     | B
+        26 | z     | Z
+        """
+    )
+    t_tmp = T(
+        """
+            | bool
+        1   | True
+        2   | True
+        26  | False
+        """
+    )
+    res = t_latin.filter(t_tmp["bool"])
+    assert_table_equality(
+        res,
+        T(
+            """
+                | lower | upper
+            1  | a     | A
+            2  | b     | B
+            """
+        ),
+    )
+
+
+def test_from_columns():
+    first = T(
+        """
+    pet | owner | age
+     1  | Alice | 10
+     1  | Bob   | 9
+     2  | Alice | 8
+    """
+    )
+    second = T(
+        """
+    foo | aux | baz
+    a   | 70  | a
+    b   | 80  | c
+    c   | 90  | b
+    """
+    )
+    expected = T(
+        """
+    pet | foo
+    1   | a
+    1   | b
+    2   | c
+        """
+    )
+    assert_table_equality(
+        pw.Table.from_columns(first.pet, second.foo), expected
+    )
+
+
+def test_if_else_int_float():
+    table = T(
+        """
+        a |  b
+        1 | 1.2
+        2 | 2.3
+        3 | 3.4
+        4 | 4.5
+        """
+    )
+    expected = T(
+        """
+        res
+        1.3
+        2.4
+        3.1
+        4.1
+    """
+    )
+    ret = table.select(
+        res=pw.if_else(pw.this.a > 2, pw.this.a, pw.this.b) + 0.1
+    )
+    assert_table_equality_wo_index(ret, expected)
+
+
+def test_if_else_optional_int_float():
+    table = T(
+        """
+          | a |  b  | c
+        1 | 1 | 1.2 | False
+        2 | 2 | 2.3 | False
+        3 | 3 | 3.4 | True
+        4 |   | 4.5 | True
+    """
+    )
+    expected = T(
+        """
+          | res
+        1 | 1.2
+        2 | 2.3
+        3 | 3.0
+        4 |
+    """
+    )
+    ret = table.select(res=pw.if_else(pw.this.c, pw.this.a, pw.this.b))
+    assert_table_equality(ret, expected)
+
+
+def test_coalesce_optional_int_float():
+    table = T(
+        """
+          | a |  b
+        1 | 1 | 1.2
+        2 |   | 2.3
+        3 | 3 | 3.4
+        4 |   | 4.5
+    """
+    )
+    expected = T(
+        """
+          | res
+        1 | 1.5
+        2 | 2.8
+        3 | 3.5
+        4 | 5.0
+    """
+    )
+    ret = table.select(res=pw.coalesce(pw.this.a, pw.this.b) + 0.5)
+    assert_table_equality(ret, expected)
+
+
+def test_update_rows():
+    old = T(
+        """
+            | pet  |  owner  | age
+        1   |  1   | Alice   | 10
+        2   |  1   | Bob     | 9
+        3   |  2   | Alice   | 8
+        4   |  1   | Bob     | 7
+        """
+    )
+    update = T(
+        """
+            | pet |  owner  | age
+        1   | 7   | Bob     | 11
+        5   | 0   | Eve     | 10
+        """
+    )
+    expected = T(
+        """
+            | pet  |  owner  | age
+        1   |  7   | Bob     | 11
+        2   |  1   | Bob     | 9
+        3   |  2   | Alice   | 8
+        4   |  1   | Bob     | 7
+        5   |  0   | Eve     | 10
+        """
+    )
+    new = old.update_rows(update)
+    assert_table_equality(new, expected)
+
+
+def test_update_cells():
+    old = T(
+        """
+            | pet  |  owner  | age
+        1   |  1   | Alice   | 10
+        2   |  1   | Bob     | 9
+        3   |  2   | Alice   | 8
+        4   |  1   | Bob     | 7
+        """
+    )
+    update = T(
+        """
+            | owner  | age
+        1   | Eve    | 10
+        4   | Eve    | 3
+        """
+    )
+    expected = T(
+        """
+            | pet  |  owner  | age
+        1   |  1   | Eve     | 10
+        2   |  1   | Bob     | 9
+        3   |  2   | Alice   | 8
+        4   |  1   | Eve     | 3
+        """
+    )
+    pw.universes.promise_is_subset_of(update, old)
+    new = old.update_cells(update)
+    assert_table_equality(new, expected)
+    assert_table_equality(old << update, expected)
+
+
+def test_groupby_instance():
+    t = T(
+        """
+        a | b | col
+        0 | 0 |   1
+        0 | 0 |   2
+        1 | 0 |   3
+        1 | 0 |   4
+        0 | 1 |   5
+        0 | 1 |   6
+        """
+    )
+    expected = T(
+        """
+        a | b | col
+        0 | 0 |   3
+        1 | 0 |   7
+        0 | 1 |  11
+        """
+    ).with_id_from(pw.this.b, instance=pw.this.a)
+    res = t.groupby(pw.this.b, instance=pw.this.a).reduce(
+        pw.this.a, pw.this.b, col=pw.reducers.sum(pw.this.col)
+    )
+    assert_table_equality(res, expected)
+
+
+def test_groupby_setid():
+    left = T(
+        """
+      | pet  |  owner  | age
+    1 |  1   | Alice   | 10
+    2 |  1   | Bob     | 9
+    3 |  2   | Alice   | 8
+    4 |  1   | Bob     | 7
+    """
+    ).with_columns(pet=pw.this.pointer_from(pw.this.pet))
+    res = left.groupby(id=left.pet).reduce(
+        left.pet,
+        agesum=pw.reducers.sum(left.age),
+    )
+    expected = T(
+        """
+          | pet | agesum
+        1 | 1   | 26
+        2 | 2   | 8
+        """
+    ).with_columns(pet=left.pointer_from(pw.this.pet))
+    assert_table_equality(res, expected)
+
+
+def test_join_filter_1():
+    left = T(
+        """
+            val
+            10
+            11
+            12
+        """
+    )
+    right = T(
+        """
+            val
+            10
+            11
+            12
+        """,
+    )
+    joined = (
+        left.join(right)
+        .filter(pw.left.val < pw.right.val)
+        .select(left_val=pw.left.val, right_val=pw.right.val)
+    )
+    assert_table_equality_wo_index(
+        joined,
+        T(
+            """
+            left_val | right_val
+                  10 |        11
+                  10 |        12
+                  11 |        12
+            """
+        ),
+    )
+
+
+def test_join_groupby_1():
+    left = T(
+        """
+            a  | lcol
+            10 |    1
+            11 |    1
+            12 |    2
+            13 |    2
+        """
+    )
+    right = T(
+        """
+            b  | rcol
+            11 |    1
+            12 |    1
+            13 |    2
+            14 |    2
+        """,
+    )
+    result = (
+        left.join(right)
+        .groupby(pw.this.lcol, pw.this.rcol)
+        .reduce(
+            pw.this.lcol,
+            pw.this.rcol,
+            res=pw.reducers.sum(pw.this.a * pw.this.b),
+        )
+    )
+    expected = T(
+        f"""
+    lcol | rcol | res
+       1 |    1 | {(10 + 11) * (11 + 12)}
+       1 |    2 | {(10 + 11) * (13 + 14)}
+       2 |    1 | {(12 + 13) * (11 + 12)}
+       2 |    2 | {(12 + 13) * (13 + 14)}
+    """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_apply_more_args():
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+    b = T(
+        """
+        bar
+        2
+        -1
+        4
+        """
+    )
+
+    def add(x: int, y: int) -> int:
+        return x + y
+
+    result = a.select(ret=pw.apply(add, x=a.foo, y=b.bar))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            3
+            1
+            7
+            """
+        ),
+    )
+
+
+def test_apply_consts():
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+
+    def inc(x: int) -> int:
+        return x + 1
+
+    result = a.select(ret=pw.apply(inc, 1))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            2
+            2
+            """
+        ),
+    )
+
+
+def test_apply_async():
+    import asyncio
+
+    async def inc(a: int) -> int:
+        await asyncio.sleep(0.1)
+        return a + 1
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    result = input.select(ret=pw.apply_async(inc, pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            """,
+        ),
+    )
+
+
+def test_apply_async_more_args():
+    import asyncio
+
+    async def add(a: int, b: int, *, c: int) -> int:
+        await asyncio.sleep(0.1)
+        return a + b + c
+
+    input = pw.debug.table_from_markdown(
+        """
+        a | b  | c
+        1 | 10 | 100
+        2 | 20 | 200
+        3 | 30 | 300
+        """
+    )
+    result = input.select(
+        ret=pw.apply_async(add, pw.this.a, pw.this.b, c=pw.this.c)
+    )
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            111
+            222
+            333
+            """,
+        ),
+    )
+
+
+@pytest.mark.parametrize("limit", [2, 10])
+def test_iterate_with_limit(limit):
+    def iteration_step(iterated):
+        iterated = iterated.select(foo=iterated.foo + 1)
+        return iterated
+
+    ret = pw.iterate(
+        iteration_step,
+        iteration_limit=limit,
+        iterated=T(
+            """
+                | foo
+            1   | 0
+            """
+        ),
+    )
+    expected_ret = T(
+        f"""
+            | foo
+        1   | {limit}
+        """
+    )
+    assert_table_equality(ret, expected_ret)
+
+
+def test_join_chain_1():
+    edges1 = T(
+        """
+        u | v
+        a | b
+        b | c
+        c | d
+        d | e
+        e | f
+        f | g
+        g | a
+    """
+    )
+    edges2 = edges1.copy()
+    edges3 = edges1.copy()
+    path3 = (
+        edges1.join(edges2, edges1.v == edges2.u)
+        .join(edges3, edges2.v == edges3.u)
+        .select(edges1.u, edges3.v)
+    )
+    assert_table_equality_wo_index(
+        path3,
+        T(
+            """
+        u | v
+        a | d
+        b | e
+        c | f
+        d | g
+        e | a
+        f | b
+        g | c
+        """
+        ),
+    )
+
+
+def test_join_chain_2():
+    edges1 = T(
+        """
+        u | v
+        a | b
+        b | c
+        c | d
+        d | e
+        e | f
+        f | g
+        g | a
+    """
+    )
+    edges2 = edges1.copy()
+    edges3 = edges1.copy()
+    path3 = edges1.join(
+        edges2.join(edges3, edges2.v == edges3.u), edges1.v == edges2.u
+    ).select(edges1.u, edges3.v)
+    assert_table_equality_wo_index(
+        path3,
+        T(
+            """
+        u | v
+        a | d
+        b | e
+        c | f
+        d | g
+        e | a
+        f | b
+        g | c
+        """
+        ),
+    )
+
+
+def test_join_leftrightthis():
+    left_table = T(
+        """
+           | a | b | c
+        1  | 1 | 2 | 3
+        """
+    )
+    right_table = T(
+        """
+           | b | c | d
+        1  | 2 | 3 | 4
+        """
+    )
+    assert_table_equality_wo_index(
+        left_table.join(right_table, pw.left.b == pw.right.b).select(
+            pw.left.a, pw.this.b, pw.right.c, pw.right.d
+        ),
+        T(
+            """
+        a | b | c | d
+        1 | 2 | 3 | 4
+        """
+        ),
+    )
+    with pytest.raises(KeyError):
+        left_table.join(right_table, pw.left.b == pw.right.b).select(
+            pw.this.c
+        )
+
+
+def test_any():
+    left = T(
+        """
+    pet  |  owner  | age
+    dog  | Bob     | 10
+    cat  | Alice   | 9
+    cat  | Alice   | 8
+    dog  | Bob     | 7
+    foo  | Charlie | 6
+    """
+    )
+    left_res = left.reduce(
+        pw.reducers.any(left.pet),
+        pw.reducers.any(left.owner),
+        pw.reducers.any(left.age),
+    )
+    joined = left.join(
+        left_res,
+        left.pet == left_res.pet,
+        left.owner == left_res.owner,
+        left.age == left_res.age,
+    ).reduce(cnt=pw.reducers.count())
+    assert_table_equality_wo_index(
+        joined,
+        T(
+            """
+    cnt
+    1
+    """
+        ),
+    )
+
+
+def test_wildcard_basic_usage():
+    tab1 = T(
+        """
+           | a | b
+        1  | 1 | 2
+        """
+    )
+    tab2 = T(
+        """
+           | c | d
+        1  | 3 | 4
+        """
+    )
+    left = tab1.select(*tab1, *tab2)
+    right = tab1.select(tab1.a, tab1.b, tab2.c, tab2.d)
+    assert_table_equality(left, right)
+
+
+def test_wildcard_shadowing():
+    tab = T(
+        """
+           | a | b | c | d
+        1  | 1 | 2 | 3 | 4
+        """
+    )
+    left = tab.select(*tab.without(tab.a, "b"), e=pw.this.a)
+    right = tab.select(tab.c, tab.d, e=tab.a)
+    assert_table_equality(left, right)
+
+
+def test_rename_columns_1():
+    old = T(
+        """
+    pet  |  owner  | age
+     1   | Alice   | 10
+     1   | Bob     | 9
+    """
+    )
+    expected = T(
+        """
+    owner   | animal | winters
+    Alice   |  1     | 10
+    Bob     |  1     | 9
+    """
+    )
+    new = old.rename_columns(animal=old.pet, winters=old.age)
+    assert_table_equality(new, expected)
+
+
+def test_rename_by_dict():
+    old = T(
+        """
+    t0  |  t1  | t2
+     1   | Alice   | 10
+     1   | Bob     | 9
+    """
+    )
+    expected = T(
+        """
+    col_0  | col_1   | col_2
+       1   | Alice   | 10
+       1   | Bob     | 9
+    """
+    )
+    new = old.rename_by_dict({f"t{i}": f"col_{i}" for i in range(3)})
+    assert_table_equality(new, expected)
+
+
+def test_with_columns():
+    old = T(
+        """
+            | pet | owner | age
+        1   |  1  | Alice | 10
+        2   |  1  | Bob   | 9
+        3   |  2  | Alice | 8
+        """
+    )
+    update = T(
+        """
+            | owner | age | weight
+        1   | Bob   | 11  | 7
+        2   | Eve   | 10  | 11
+        3   | Eve   | 15  | 13
+        """
+    )
+    expected = T(
+        """
+            | pet | owner | age | weight
+        1   | 1   | Bob   | 11  | 7
+        2   | 1   | Eve   | 10  | 11
+        3   | 2   | Eve   | 15  | 13
+        """
+    )
+    new = old.with_columns(*update)
+    assert_table_equality(new, expected)

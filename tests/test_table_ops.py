@@ -456,7 +456,8 @@ def test_rename_without():
         """
     )
     res = t.without("c").rename_columns(x=pw.this.a)
-    assert res.column_names() == ["x", "b"]
+    # reference order: untouched columns first, renamed appended
+    assert res.column_names() == ["b", "x"]
 
 
 def test_streaming_diffs_groupby():
