@@ -411,30 +411,74 @@ class StatefulAcc(Accumulator):
 
 
 class CustomAccAcc(Accumulator):
-    """BaseCustomAccumulator-driven reducer
-    (reference: udf_reducer, internals/custom_reducers.py)."""
+    """BaseCustomAccumulator-driven reducer (reference: udf_reducer,
+    internals/custom_reducers.py). Accumulators implementing ``retract``
+    apply retractions incrementally; those that don't trigger a full
+    recomputation of the group from the retained row multiset (the
+    reference's non-retractable fallback)."""
 
     def __init__(self, spec):
         super().__init__(spec)
         self.cls = spec.extra["cls"]
         self.acc: Any = None
+        from pathway_tpu.internals.custom_reducers import (
+            BaseCustomAccumulator,
+        )
+
+        self._has_retract = (
+            getattr(self.cls, "retract", None) is not None
+            and self.cls.retract is not BaseCustomAccumulator.retract
+        )
+        self.n = 0  # net row count (emptiness signal, O(1))
+        # retained row multiset — ONLY for the non-retract rebuild path
+        self.rows: dict[tuple, int] = {}
+        self._dirty = False
+
+    def _apply_rows(self, args, diff):
+        k = tuple(_hashable(a) for a in args)
+        c = self.rows.get(k, 0) + diff
+        if c == 0:
+            self.rows.pop(k, None)
+        else:
+            self.rows[k] = c
 
     def update(self, args, diff, key, time):
-        other = self.cls.from_row(list(args))
-        if diff > 0:
+        self.n += diff
+        if not self._has_retract:
+            self._apply_rows(args, diff)
+        if diff > 0 and not self._dirty:
+            other = self.cls.from_row(list(args))
             for _ in range(diff):
                 if self.acc is None:
                     self.acc = self.cls.from_row(list(args))
                 else:
                     self.acc.update(other)
-        else:
+        elif diff < 0 and self._has_retract:
+            other = self.cls.from_row(list(args))
             for _ in range(-diff):
                 if self.acc is None:
                     raise RuntimeError("retraction before insertion")
                 self.acc.retract(other)
+        elif diff < 0:
+            # no retract: rebuild lazily in value(), at most once per tick
+            self._dirty = True
+
+    def _rebuild(self):
+        self.acc = None
+        for k, c in self.rows.items():
+            row = [_unhashable(x) for x in k]
+            other = self.cls.from_row(row)
+            for _ in range(c):
+                if self.acc is None:
+                    self.acc = self.cls.from_row(row)
+                else:
+                    self.acc.update(other)
+        self._dirty = False
 
     def value(self):
-        if self.acc is None:
+        if self._dirty:
+            self._rebuild()
+        if self.acc is None or self.n <= 0:
             return None
         return self.acc.compute_result()
 
