@@ -149,15 +149,60 @@ def with_cache_strategy(fn, cache_strategy):
     memo: dict = {}
 
     if asyncio.iscoroutinefunction(fn):
+        # coalesce CONCURRENT calls for the same key: rows of one batch
+        # fire simultaneously, and each key must compute exactly once
+        # (reference: async caches share the in-flight future). In-flight
+        # state is scoped per event loop (one asyncio.run per tick) via a
+        # weak mapping, like with_capacity; only the RESULT memo persists
+        # across batches.
+        import weakref
+
+        inflight_by_loop: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
 
         @functools.wraps(fn)
         async def cached_async(*args):
             key = args
-            if key in memo:
-                return memo[key]
-            result = await fn(*args)
-            memo[key] = result
-            return result
+            loop = asyncio.get_running_loop()
+            inflight = inflight_by_loop.setdefault(loop, {})
+            while True:
+                if key in memo:
+                    return memo[key]
+                fut = inflight.get(key)
+                if fut is not None:
+                    try:
+                        return await fut
+                    except asyncio.CancelledError:
+                        if fut.cancelled():
+                            # the OWNER was cancelled (e.g. its timeout):
+                            # retry — this waiter may become the owner and
+                            # still produce a per-row result
+                            continue
+                        raise  # this waiter itself was cancelled
+                fut = loop.create_future()
+                inflight[key] = fut
+                try:
+                    result = await fn(*args)
+                except asyncio.CancelledError:
+                    # do NOT broadcast cancellation as an exception: cancel
+                    # the shared future so waiters recompute; the owner's
+                    # own cancellation propagates (wait_for turns it into
+                    # TimeoutError -> a clean per-row ERROR)
+                    inflight.pop(key, None)
+                    fut.cancel()
+                    raise
+                except BaseException as exc:
+                    inflight.pop(key, None)
+                    fut.set_exception(exc)
+                    # consume so an un-awaited future does not warn;
+                    # waiters re-raise via the shared future
+                    fut.exception()
+                    raise
+                memo[key] = result
+                fut.set_result(result)
+                inflight.pop(key, None)
+                return result
 
         return cached_async
 

@@ -1,0 +1,314 @@
+"""Ported reference UDF suite (reference: python/pathway/tests/test_udf.py):
+decorator/class forms, async executors, propagate_none, timeouts,
+in-memory caching."""
+
+import asyncio
+import threading
+from unittest import mock
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T
+from ref_utils import assert_table_equality, run_all
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_udf():
+    @pw.udf
+    def inc(a: int) -> int:
+        return a + 1
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            """,
+        ),
+    )
+
+
+def test_udf_class():
+    class Inc(pw.UDF):
+        def __init__(self, inc) -> None:
+            super().__init__()
+            self.inc = inc
+
+        def __wrapped__(self, a: int) -> int:
+            return a + self.inc
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    inc = Inc(2)
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            3
+            4
+            5
+            """,
+        ),
+    )
+
+
+def test_udf_async():
+    barrier = asyncio.Barrier(3)
+
+    @pw.udf
+    async def inc(a: int) -> int:
+        await barrier.wait()
+        return a + 3
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            4
+            5
+            6
+            """,
+        ),
+    )
+
+
+def test_udf_sync_with_async_executor():
+    barrier = threading.Barrier(3, timeout=10)
+
+    @pw.udf(executor=pw.udfs.async_executor())
+    def inc(a: int) -> int:
+        barrier.wait()
+        return a + 3
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            4
+            5
+            6
+            """,
+        ),
+    )
+
+
+def test_udf_async_class():
+    class Inc(pw.UDF):
+        def __init__(self, inc, **kwargs) -> None:
+            super().__init__(**kwargs)
+            self.inc = inc
+
+        async def __wrapped__(self, a: int) -> int:
+            await asyncio.sleep(0.1)
+            return a + self.inc
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    inc = Inc(40)
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            41
+            42
+            43
+            """,
+        ),
+    )
+
+
+def test_udf_propagate_none():
+    internal_add = mock.Mock()
+
+    @pw.udf(propagate_none=True)
+    def add(a: int, b: int) -> int:
+        assert a is not None
+        assert b is not None
+        internal_add()
+        return a + b
+
+    input = T(
+        """
+        a | b
+        1 | 6
+        2 |
+          | 8
+        """
+    )
+    result = input.select(ret=add(pw.this.a, pw.this.b))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            7
+            None
+            None
+            """,
+        ),
+    )
+    internal_add.assert_called_once()
+
+
+def test_udf_too_fast_for_timeout():
+    @pw.udf(executor=pw.udfs.async_executor(timeout=10.0))
+    async def inc(a: int) -> int:
+        return a + 1
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            """,
+        ),
+    )
+
+
+@pytest.mark.parametrize("sync", [True, False])
+def test_udf_in_memory_cache(sync: bool) -> None:
+    internal_inc = mock.Mock()
+
+    if sync:
+
+        @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+        def inc(a: int) -> int:
+            internal_inc(a)
+            return a + 1
+
+    else:
+
+        @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+        async def inc(a: int) -> int:
+            await asyncio.sleep(a / 10)
+            internal_inc(a)
+            return a + 1
+
+    input = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        1
+        2
+        """
+    )
+    result = input.select(ret=inc(pw.this.a))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            2
+            3
+            """,
+        ),
+    )
+    assert internal_inc.call_count == 3
+    internal_inc.assert_has_calls(
+        [mock.call(1), mock.call(2), mock.call(3)], any_order=True
+    )
+
+
+def test_async_udf_propagate_none():
+    internal_add = mock.Mock()
+
+    @pw.udf(propagate_none=True)
+    async def add(a: int, b: int) -> int:
+        assert a is not None
+        assert b is not None
+        internal_add()
+        return a + b
+
+    input = T(
+        """
+        a | b
+        1 | 6
+        2 |
+          | 8
+        """
+    )
+    result = input.select(ret=add(pw.this.a, pw.this.b))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            7
+            None
+            None
+            """,
+        ),
+    )
+    internal_add.assert_called_once()
