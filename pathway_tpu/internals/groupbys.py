@@ -80,6 +80,45 @@ class GroupedTable:
         for name, e in kwargs.items():
             out_exprs[name] = table._desugar(e)
 
+        # --- post-aggregation ix lookups (pw.this.ix(argmax(...)).col) --------
+        from pathway_tpu.internals.table import _DeferredIxTable
+
+        deferred_tables: dict[int, Any] = {}
+
+        def find_deferred(e):
+            if isinstance(e, ColumnReference) and isinstance(
+                e.table, _DeferredIxTable
+            ):
+                if e.table._contains_reducer():
+                    deferred_tables.setdefault(id(e.table), e.table)
+                return
+            for c in e._children:
+                find_deferred(c)
+
+        for e in out_exprs.values():
+            find_deferred(e)
+        ix_slots: dict[int, tuple[str, Any, Any]] = {}
+        for k, (key, dtbl) in enumerate(deferred_tables.items()):
+            inners = [table._desugar(p) for p in dtbl._pointer_exprs()]
+            if getattr(dtbl, "_raw_expr", True):
+                # the single expr IS the pointer (this.ix / table.ix paths)
+                ptr_expr = inners[0]
+            else:
+                # ix_ref(a, b, instance=...): the pointer is derived from
+                # the aggregated KEY VALUES, exactly like _materialize
+                from pathway_tpu.internals.expression import (
+                    PointerExpression,
+                )
+
+                inst = dtbl._instance
+                ptr_expr = PointerExpression(
+                    dtbl._source,
+                    *inners,
+                    optional=dtbl._optional,
+                    instance=table._desugar(inst) if inst is not None else None,
+                )
+            ix_slots[key] = (f"_ixptr{k}", ptr_expr, dtbl)
+
         # --- collect reducer subexpressions & grouping references -------------
         reducer_slots: list[ReducerExpression] = []
 
@@ -92,6 +131,8 @@ class GroupedTable:
 
         for e in out_exprs.values():
             collect(e)
+        for _slot, inner, _d in ix_slots.values():
+            collect(inner)
 
         grouping_names = [f"_g{i}" for i in range(len(self._grouping))]
 
@@ -190,9 +231,65 @@ class GroupedTable:
         agg_table = Table._from_node(gb_node, gb_dtypes, Universe())
 
         # --- final select over aggregated table -------------------------------
+        def _expr_matches(a, b) -> bool:
+            """Structural equality for grouping lookup (grouping entries
+            may be composite, e.g. coalesce(l.x, r.x) from join-equated
+            columns). Compares the full non-expression payload — constant
+            values, cast targets, functions — not just shape."""
+            if isinstance(a, ColumnReference) or isinstance(b, ColumnReference):
+                return (
+                    isinstance(a, ColumnReference)
+                    and isinstance(b, ColumnReference)
+                    and a.table is b.table
+                    and a.name == b.name
+                )
+            if type(a) is not type(b):
+                return False
+            ca, cb = a._children, b._children
+            if len(ca) != len(cb):
+                return False
+
+            def payload(x) -> dict:
+                out = {}
+                for k, v in x.__dict__.items():
+                    if isinstance(v, ColumnExpression):
+                        continue
+                    if isinstance(v, (tuple, list)) and any(
+                        isinstance(i, ColumnExpression) for i in v
+                    ):
+                        continue
+                    if isinstance(v, dict) and any(
+                        isinstance(i, ColumnExpression) for i in v.values()
+                    ):
+                        continue
+                    out[k] = v
+                return out
+
+            pa, pb = payload(a), payload(b)
+            if set(pa) != set(pb):
+                return False
+            for k in pa:
+                va, vb = pa[k], pb[k]
+                if callable(va) or callable(vb):
+                    if va is not vb:
+                        return False
+                elif va is not vb and va != vb:
+                    return False
+            return all(_expr_matches(x, y) for x, y in zip(ca, cb))
+
+        def grouping_expr_index(e) -> int | None:
+            for i, g in enumerate(self._grouping):
+                if not isinstance(g, ColumnReference) and _expr_matches(e, g):
+                    return i
+            return None
+
         def rewrite(e: ColumnExpression) -> ColumnExpression:
             if isinstance(e, ReducerExpression):
                 return InternalColRef(0, slot_names[id(e)])
+            if not isinstance(e, ColumnReference):
+                gie = grouping_expr_index(e)
+                if gie is not None:
+                    return InternalColRef(0, grouping_names[gie])
             if isinstance(e, ColumnReference):
                 gi = grouping_index(e)
                 if gi is not None:
@@ -207,19 +304,85 @@ class GroupedTable:
                 )
             return e._rebuild(tuple(rewrite(c) for c in e._children))
 
-        final_exprs = {n: rewrite(e) for n, e in out_exprs.items()}
-        final_dtypes = {}
+        def env2(ref: ColumnReference) -> dt.DType:
+            gi = grouping_index(ref)
+            if gi is not None:
+                return gb_dtypes[grouping_names[gi]]
+            return dt.ANY
+
+        def has_deferred(e) -> bool:
+            if isinstance(e, ColumnReference):
+                return id(e.table) in ix_slots
+            return any(has_deferred(c) for c in e._children)
+
+        if not ix_slots:
+            final_exprs = {n: rewrite(e) for n, e in out_exprs.items()}
+            final_dtypes = {
+                n: infer_dtype(e, env2) for n, e in out_exprs.items()
+            }
+            node = nodes.RowwiseNode([agg_table._node], final_exprs)
+            return Table._from_node(node, final_dtypes, agg_table._universe)
+
+        # stage 1: the plain aggregated columns, every reducer slot +
+        # grouping column (stage 2 may reference them), and the ix pointer
+        # slots
+        stage1_exprs: dict[str, ColumnExpression] = {}
+        stage1_dtypes: dict[str, dt.DType] = {}
         for n, e in out_exprs.items():
+            if not has_deferred(e):
+                stage1_exprs[n] = rewrite(e)
+                stage1_dtypes[n] = infer_dtype(e, env2)
+        for slot in slot_names.values():
+            stage1_exprs.setdefault(slot, InternalColRef(0, slot))
+            stage1_dtypes.setdefault(slot, gb_dtypes[slot])
+        for i, gname in enumerate(grouping_names):
+            stage1_exprs.setdefault(gname, InternalColRef(0, gname))
+            stage1_dtypes.setdefault(gname, gb_dtypes[gname])
+        for slot, inner, _d in ix_slots.values():
+            stage1_exprs[slot] = rewrite(inner)
+            stage1_dtypes[slot] = dt.POINTER
+        node1 = nodes.RowwiseNode([agg_table._node], stage1_exprs)
+        stage1 = Table._from_node(node1, stage1_dtypes, Universe())
 
-            def env2(ref: ColumnReference) -> dt.DType:
-                gi = grouping_index(ref)
+        # stage 2: ix the source table at the aggregated pointers and
+        # substitute the deferred references (reference: in-reduce
+        # ix(argmax) lookups, tests/test_common.py test_groupby_ix)
+        ixed: dict[int, Table] = {}
+        for key, (slot, _inner, dtbl) in ix_slots.items():
+            src = getattr(dtbl, "_source", None) or table
+            ixed[key] = src.ix(
+                stage1[slot],
+                optional=getattr(dtbl, "_optional", False),
+                allow_misses=getattr(dtbl, "_allow_misses", False),
+            )
+
+        def rewrite2(e):
+            if isinstance(e, ColumnReference):
+                if id(e.table) in ixed:
+                    return ixed[id(e.table)][e.name]
+                gi = grouping_index(e)
                 if gi is not None:
-                    return gb_dtypes[grouping_names[gi]]
-                return dt.ANY
+                    return stage1[grouping_names[gi]]
+                if e.table is stage1:
+                    return e
+                raise ValueError(
+                    f"column {e.name!r} used in reduce() is not a "
+                    "grouping column; wrap it in a reducer"
+                )
+            gie = grouping_expr_index(e)
+            if gie is not None:
+                return stage1[grouping_names[gie]]
+            if isinstance(e, ReducerExpression):
+                return stage1[slot_names[id(e)]]
+            return e._rebuild(tuple(rewrite2(c) for c in e._children))
 
-            final_dtypes[n] = infer_dtype(e, env2)
-        node = nodes.RowwiseNode([agg_table._node], final_exprs)
-        return Table._from_node(node, final_dtypes, agg_table._universe)
+        stage2_exprs = {}
+        for n, e in out_exprs.items():
+            if has_deferred(e):
+                stage2_exprs[n] = rewrite2(e)
+            else:
+                stage2_exprs[n] = stage1[n]
+        return stage1.select(**stage2_exprs)
 
 
 class GroupedJoinResult(GroupedTable):

@@ -78,6 +78,10 @@ def desugar(e: Any, mapping: Mapping[ThisPlaceholder, "Table"]) -> ColumnExpress
                 return ColumnReference(target, "id")
             return target[ref.name]
         if isinstance(tbl, _DeferredIxTable):
+            if tbl._contains_reducer():
+                # pointer computed by a reducer: materialization belongs
+                # to GroupedTable.reduce (post-aggregation ix lookup)
+                return None
             caller = mapping.get(this)
             if caller is None:
                 raise ValueError(
@@ -148,6 +152,54 @@ class _DeferredIxTable:
         if name.startswith("_"):
             raise AttributeError(name)
         return ColumnReference(self, name)
+
+    def _pointer_exprs(self):
+        return [wrap_expr(a) for a in self._args]
+
+    def _contains_reducer(self) -> bool:
+        return any(_expr_contains_reducer(e) for e in self._pointer_exprs())
+
+
+def _expr_contains_reducer(e) -> bool:
+    from pathway_tpu.internals.expression import ReducerExpression
+
+    if isinstance(e, ReducerExpression):
+        return True
+    return any(_expr_contains_reducer(c) for c in e._children)
+
+
+class _DeferredThisIxTable(_DeferredIxTable):
+    """``pw.this.ix(expr)`` — both the indexed table AND the pointer
+    expression resolve against the CALLING operation's table (reference:
+    this.ix inside groupby-reduce, e.g.
+    ``reduce(owner=pw.this.ix(pw.reducers.argmax(pw.this.age)).owner)``)."""
+
+    def __init__(self, expr, optional: bool, context, allow_misses: bool):
+        self._expr = expr
+        self._optional = optional
+        self._context = context
+        self._allow_misses = allow_misses
+        self._cache = {}
+
+    def _materialize(self, caller: "Table") -> "Table":
+        key = id(caller)
+        if key not in self._cache:
+            self._keepalive = getattr(self, "_keepalive", [])
+            self._keepalive.append(caller)
+            # resolve pw.this against the caller FIRST — otherwise
+            # Table.ix sees an unresolved placeholder and re-defers
+            self._cache[key] = caller.ix(
+                caller._desugar(self._expr),
+                optional=self._optional,
+                context=self._context,
+                allow_misses=self._allow_misses,
+            )
+        return self._cache[key]
+
+    def _pointer_exprs(self):
+        from pathway_tpu.internals.expression import wrap_expr
+
+        return [wrap_expr(self._expr)]
 
 
 def _collect_tables(exprs: Iterable[ColumnExpression]) -> list["Table"]:
@@ -891,6 +943,13 @@ class Table(Joinable):
         allow_misses: bool = False,
     ) -> "Table":
         e = expression
+        if _expr_contains_reducer(wrap_expr(e)):
+            # pointer computed by a reducer: defer — GroupedTable.reduce
+            # aggregates the pointer first, then indexes THIS table
+            # (reference: in-reduce ix(argmax, context=pw.this))
+            d = _DeferredThisIxTable(e, optional, context, allow_misses)
+            d._source = self
+            return d
         tables = _collect_tables([wrap_expr(e)])
         if tables:
             indexer = tables[0]

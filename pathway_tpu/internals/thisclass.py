@@ -39,6 +39,18 @@ class ThisPlaceholder:
     def pointer_from(self, *args, optional: bool = False, instance=None):
         return PointerExpression(self, *args, optional=optional, instance=instance)
 
+    def ix(
+        self,
+        expression,
+        *,
+        optional: bool = False,
+        context=None,
+        allow_misses: bool = False,
+    ):
+        from pathway_tpu.internals.table import _DeferredThisIxTable
+
+        return _DeferredThisIxTable(expression, optional, context, allow_misses)
+
     def without(self, *columns) -> "ThisSlice":
         names = [c if isinstance(c, str) else c.name for c in columns]
         return ThisSlice(self, None, without=names)

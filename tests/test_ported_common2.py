@@ -906,3 +906,263 @@ def test_with_columns():
     )
     new = old.with_columns(*update)
     assert_table_equality(new, expected)
+
+
+def test_ix_ref_with_primary_keys():
+    indexed_table = T(
+        """
+    colA   | colB
+    10     | A
+    20     | B
+    """
+    )
+    indexed_table = indexed_table.with_id_from(pw.this.colB)
+    tested_table = T(
+        """
+    colC
+    10
+    20
+    """
+    )
+    returned = tested_table.select(
+        *pw.this, new_value=indexed_table.ix_ref("A").colA
+    )
+    expected = T(
+        """
+    colC   | new_value
+    10     | 10
+    20     | 10
+    """
+    )
+    assert_table_equality(returned, expected)
+
+
+def test_groupby_ix_this():
+    left = T(
+        """
+    pet  |  owner  | age
+    dog  | Alice   | 10
+    dog  | Bob     | 9
+    cat  | Alice   | 8
+    cat  | Bob     | 7
+    """
+    )
+    res = left.groupby(left.pet).reduce(
+        age=pw.reducers.max(pw.this.age),
+        owner=pw.this.ix(pw.reducers.argmax(pw.this.age)).owner,
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+        age | owner
+        10  | Alice
+        8   | Alice
+    """
+        ),
+    )
+
+
+def test_join_foreign_col():
+    left = T(
+        """
+           | a
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    right = T(
+        """
+           | b
+        0  | baz
+        1  | foo
+        2  | bar
+        """
+    )
+    joiner = left.join(right, left.id == right.id)
+    t1 = joiner.select(col=left.a * 2)
+    t2 = joiner.select(col=left.a + t1.col)
+    assert_table_equality_wo_index(
+        t2,
+        T(
+            """
+                | col
+            1   | 3
+            2   | 6
+            """
+        ),
+    )
+
+
+def test_cast_optional():
+    from typing import Optional
+
+    tab = T(
+        """
+          | a
+        1 | 1
+        2 |
+        3 | 1
+        """
+    )
+    ret = tab.select(a=pw.cast(Optional[float], pw.this.a))
+    expected = T(
+        """
+          | a
+        1 | 1.0
+        2 |
+        3 | 1.0
+        """
+    ).update_types(a=Optional[float])
+    assert_table_equality(ret, expected)
+
+
+def test_join_filter_2():
+    tA = T(
+        """
+             a
+            10
+            11
+            12
+        """
+    )
+    tB = T(
+        """
+             b
+            10
+            11
+            12
+        """
+    )
+    tC = T(
+        """
+             c
+            10
+            11
+            12
+        """
+    )
+    tD = T(
+        """
+             d
+            10
+            11
+            12
+        """
+    )
+    result = (
+        tA.join(tB)
+        .filter(pw.this.a <= pw.this.b)
+        .join(tC)
+        .join(tD)
+        .filter(pw.this.c <= pw.this.d)
+        .filter(pw.this.a + pw.this.b == pw.this.c + pw.this.d)
+        .select(*pw.this)
+    )
+    expected = T(
+        """
+ a  | b  | c  | d
+ 10 | 10 | 10 | 10
+ 10 | 11 | 10 | 11
+ 10 | 12 | 10 | 12
+ 10 | 12 | 11 | 11
+ 11 | 11 | 10 | 12
+ 11 | 11 | 11 | 11
+ 11 | 12 | 11 | 12
+ 12 | 12 | 12 | 12
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_join_groupby_2():
+    left = T(
+        """
+            a  |  col
+            10 |    1
+            11 |    1
+            12 |    2
+            13 |    2
+        """
+    )
+    right = T(
+        """
+            b  |  col
+            11 |    1
+            12 |    1
+            13 |    2
+            14 |    2
+        """,
+    )
+    result = (
+        left.join(right, left.col == right.col)
+        .groupby(pw.this.col)
+        .reduce(pw.this.col, res=pw.reducers.sum(pw.this.a * pw.this.b))
+    )
+    expected = T(
+        f"""
+    col | res
+      1 | {(10 + 11) * (11 + 12)}
+      2 | {(12 + 13) * (13 + 14)}
+    """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_join_filter_reduce():
+    left = T(
+        """
+            a
+            10
+            11
+            12
+        """
+    )
+    right = T(
+        """
+            b
+            11
+            12
+            13
+        """,
+    )
+    result = (
+        left.join(right)
+        .filter(pw.this.a >= pw.this.b)
+        .reduce(col=pw.reducers.count())
+    )
+    expected = T(
+        """
+        col
+        3
+    """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_groupby_ix():
+    tab = T(
+        """
+        grouper | val | output
+              0 |   1 |    abc
+              0 |   2 |    def
+              1 |   1 |    ghi
+              1 |   2 |    jkl
+              2 |   1 |    mno
+              2 |   2 |    pqr
+        """,
+    ).with_columns(grouper=pw.this.pointer_from(pw.this.grouper))
+    res = tab.groupby(id=tab.grouper).reduce(
+        col=pw.reducers.argmax(tab.val),
+        output=tab.ix(pw.reducers.argmax(tab.val), context=pw.this).output,
+    )
+    expected = T(
+        """
+        col | output
+          1 | def
+          3 | jkl
+          5 | pqr
+        """,
+    ).with_columns(col=tab.pointer_from(pw.this.col))
+    assert_table_equality(res, expected)
