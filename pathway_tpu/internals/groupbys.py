@@ -9,6 +9,7 @@ from typing import Any, Sequence
 from pathway_tpu.engine import nodes
 from pathway_tpu.engine.expression_eval import InternalColRef
 from pathway_tpu.engine.reducers import ReducerSpec
+from pathway_tpu.internals.reducer_descriptors import ReducerDescriptor
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as expr_mod
 from pathway_tpu.internals.expression import (
@@ -20,6 +21,65 @@ from pathway_tpu.internals.expression import (
 from pathway_tpu.internals.reducer_descriptors import ReducerDescriptor
 from pathway_tpu.internals.thisclass import ThisPlaceholder, ThisSlice, this
 from pathway_tpu.internals.universe import Universe
+
+
+def _exprs_structurally_equal(a, b) -> bool:
+    """Structural expression equality: same class tree, same column refs,
+    same non-expression payload (constants, cast targets, functions)."""
+    if isinstance(a, ColumnReference) or isinstance(b, ColumnReference):
+        return (
+            isinstance(a, ColumnReference)
+            and isinstance(b, ColumnReference)
+            and a.table is b.table
+            and a.name == b.name
+        )
+    if type(a) is not type(b):
+        return False
+    ca, cb = a._children, b._children
+    if len(ca) != len(cb):
+        return False
+
+    def payload(x) -> dict:
+        out = {}
+        for k, v in x.__dict__.items():
+            if isinstance(v, ColumnExpression):
+                continue
+            if isinstance(v, (tuple, list)) and any(
+                isinstance(i, ColumnExpression) for i in v
+            ):
+                continue
+            if isinstance(v, dict) and any(
+                isinstance(i, ColumnExpression) for i in v.values()
+            ):
+                continue
+            out[k] = v
+        return out
+
+    pa, pb = payload(a), payload(b)
+    if set(pa) != set(pb):
+        return False
+    for k in pa:
+        va, vb = pa[k], pb[k]
+        if isinstance(va, ReducerDescriptor) and isinstance(
+            vb, ReducerDescriptor
+        ):
+            # each reducers.* call builds a fresh descriptor whose `ret`
+            # lambda differs by identity; compare the semantic fields
+            if not (
+                va.name == vb.name
+                and va.kind == vb.kind
+                and va.n_args == vb.n_args
+                and va.skip_nones == vb.skip_nones
+                and va.fn is vb.fn
+                and va.extra == vb.extra
+            ):
+                return False
+        elif callable(va) or callable(vb):
+            if va is not vb:
+                return False
+        elif va is not vb and va != vb:
+            return False
+    return all(_exprs_structurally_equal(x, y) for x, y in zip(ca, cb))
 
 
 class GroupedTable:
@@ -98,6 +158,16 @@ class GroupedTable:
         for e in out_exprs.values():
             find_deferred(e)
         ix_slots: dict[int, tuple[str, Any, Any]] = {}
+
+        def _same_lookup(d1, d2) -> bool:
+            return (
+                getattr(d1, "_source", None) is getattr(d2, "_source", None)
+                and getattr(d1, "_optional", False)
+                == getattr(d2, "_optional", False)
+                and getattr(d1, "_allow_misses", False)
+                == getattr(d2, "_allow_misses", False)
+            )
+
         for k, (key, dtbl) in enumerate(deferred_tables.items()):
             inners = [table._desugar(p) for p in dtbl._pointer_exprs()]
             if getattr(dtbl, "_raw_expr", True):
@@ -117,7 +187,16 @@ class GroupedTable:
                     optional=dtbl._optional,
                     instance=table._desugar(inst) if inst is not None else None,
                 )
-            ix_slots[key] = (f"_ixptr{k}", ptr_expr, dtbl)
+            # structurally identical lookups share one slot (one reducer,
+            # one IxNode) — the common multi-column argmax-row pattern
+            shared = None
+            for other in ix_slots.values():
+                if _same_lookup(dtbl, other[2]) and _exprs_structurally_equal(
+                    ptr_expr, other[1]
+                ):
+                    shared = other
+                    break
+            ix_slots[key] = shared or (f"_ixptr{k}", ptr_expr, dtbl)
 
         # --- collect reducer subexpressions & grouping references -------------
         reducer_slots: list[ReducerExpression] = []
@@ -131,8 +210,11 @@ class GroupedTable:
 
         for e in out_exprs.values():
             collect(e)
+        _seen_slots: set[str] = set()
         for _slot, inner, _d in ix_slots.values():
-            collect(inner)
+            if _slot not in _seen_slots:
+                _seen_slots.add(_slot)
+                collect(inner)
 
         grouping_names = [f"_g{i}" for i in range(len(self._grouping))]
 
@@ -231,51 +313,7 @@ class GroupedTable:
         agg_table = Table._from_node(gb_node, gb_dtypes, Universe())
 
         # --- final select over aggregated table -------------------------------
-        def _expr_matches(a, b) -> bool:
-            """Structural equality for grouping lookup (grouping entries
-            may be composite, e.g. coalesce(l.x, r.x) from join-equated
-            columns). Compares the full non-expression payload — constant
-            values, cast targets, functions — not just shape."""
-            if isinstance(a, ColumnReference) or isinstance(b, ColumnReference):
-                return (
-                    isinstance(a, ColumnReference)
-                    and isinstance(b, ColumnReference)
-                    and a.table is b.table
-                    and a.name == b.name
-                )
-            if type(a) is not type(b):
-                return False
-            ca, cb = a._children, b._children
-            if len(ca) != len(cb):
-                return False
-
-            def payload(x) -> dict:
-                out = {}
-                for k, v in x.__dict__.items():
-                    if isinstance(v, ColumnExpression):
-                        continue
-                    if isinstance(v, (tuple, list)) and any(
-                        isinstance(i, ColumnExpression) for i in v
-                    ):
-                        continue
-                    if isinstance(v, dict) and any(
-                        isinstance(i, ColumnExpression) for i in v.values()
-                    ):
-                        continue
-                    out[k] = v
-                return out
-
-            pa, pb = payload(a), payload(b)
-            if set(pa) != set(pb):
-                return False
-            for k in pa:
-                va, vb = pa[k], pb[k]
-                if callable(va) or callable(vb):
-                    if va is not vb:
-                        return False
-                elif va is not vb and va != vb:
-                    return False
-            return all(_expr_matches(x, y) for x, y in zip(ca, cb))
+        _expr_matches = _exprs_structurally_equal
 
         def grouping_expr_index(e) -> int | None:
             for i, g in enumerate(self._grouping):
@@ -348,13 +386,16 @@ class GroupedTable:
         # substitute the deferred references (reference: in-reduce
         # ix(argmax) lookups, tests/test_common.py test_groupby_ix)
         ixed: dict[int, Table] = {}
+        ixed_by_slot: dict[str, Table] = {}
         for key, (slot, _inner, dtbl) in ix_slots.items():
-            src = getattr(dtbl, "_source", None) or table
-            ixed[key] = src.ix(
-                stage1[slot],
-                optional=getattr(dtbl, "_optional", False),
-                allow_misses=getattr(dtbl, "_allow_misses", False),
-            )
+            if slot not in ixed_by_slot:
+                src = getattr(dtbl, "_source", None) or table
+                ixed_by_slot[slot] = src.ix(
+                    stage1[slot],
+                    optional=getattr(dtbl, "_optional", False),
+                    allow_misses=getattr(dtbl, "_allow_misses", False),
+                )
+            ixed[key] = ixed_by_slot[slot]
 
         def rewrite2(e):
             if isinstance(e, ColumnReference):

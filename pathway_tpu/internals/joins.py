@@ -272,6 +272,34 @@ class JoinResult:
             ar = right_aliases.get((id(tbl), ref.name))
             if ar is not None:
                 return ColumnReference(joined, "r." + ar)
+            from pathway_tpu.internals.table import _DeferredThisIxTable
+
+            if isinstance(tbl, _DeferredThisIxTable):
+                # pw.this.ix(...) built against the join: resolve the
+                # hidden pointer expression through this substitution, and
+                # translate the looked-up column name to its prefixed form
+                # on the materialized join table
+                new = _DeferredThisIxTable(
+                    wrap_expr(tbl._expr)._substitute(sub),
+                    tbl._optional,
+                    tbl._context,
+                    tbl._allow_misses,
+                )
+                if getattr(tbl, "_source", None) is not None:
+                    new._source = tbl._source
+                name = ref.name
+                in_l = name in self._left.column_names()
+                in_r = name in self._right.column_names()
+                if in_l and in_r and name not in self._equated_names():
+                    raise KeyError(
+                        f"column {name!r} is ambiguous in join; "
+                        "use pw.left/pw.right"
+                    )
+                if in_l:
+                    name = "l." + name
+                elif in_r:
+                    name = "r." + name
+                return ColumnReference(new, name)
             if isinstance(tbl, ThisPlaceholder):
                 if ref.name == "id":
                     return ColumnReference(joined, "id")

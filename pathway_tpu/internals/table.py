@@ -223,7 +223,9 @@ def resolve_to_internal(
             if tbl is t:
                 return InternalColRef(i, ref.name)
         raise ValueError(
-            f"expression references table {tbl!r} which is not an input "
+            "reducers can only be used inside groupby(...).reduce(...)"
+            if isinstance(tbl, _DeferredIxTable) and tbl._contains_reducer()
+            else f"expression references table {tbl!r} which is not an input "
             "of this operation (universes may differ)"
         )
 

@@ -1,0 +1,93 @@
+"""Ported reference flatten suite (reference:
+python/pathway/tests/test_flatten.py)."""
+
+from typing import Any
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import T, table_from_pandas
+from ref_utils import assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+    pw.internals.parse_graph.G.clear()
+
+
+def test_flatten_simple():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]}))
+    assert_table_equality_wo_index(
+        tab.flatten(pw.this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      2 | 0
+      3 | 0
+      4 | 0
+    """,
+        ).with_columns(origin_id=tab.pointer_from(pw.this.origin_id)),
+    )
+
+
+def test_flatten_no_origin():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]}))
+    assert_table_equality_wo_index(
+        tab.flatten(pw.this.col),
+        T(
+            """
+    col
+      1
+      2
+      3
+      4
+    """,
+        ),
+    )
+
+
+def test_flatten_inner_repeats():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[1, 1, 1, 3]]}))
+    assert_table_equality_wo_index(
+        tab.flatten(pw.this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      1 | 0
+      1 | 0
+      3 | 0
+    """,
+        ).with_columns(origin_id=tab.pointer_from(pw.this.origin_id)),
+    )
+
+
+def test_flatten_more_repeats():
+    tab = table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 1, 1, 3], [1]]})
+    )
+    assert_table_equality_wo_index(
+        tab.flatten(pw.this.col, origin_id="origin_id"),
+        T(
+            """
+    col | origin_id
+      1 | 0
+      1 | 0
+      1 | 0
+      3 | 0
+      1 | 1
+    """,
+        ).with_columns(origin_id=tab.pointer_from(pw.this.origin_id)),
+    )
+
+
+def test_flatten_empty_lists():
+    tab = table_from_pandas(pd.DataFrame.from_dict({"col": [[], []]}))
+    assert_table_equality_wo_index(
+        tab.flatten(pw.this.col, origin_id="origin_id"),
+        pw.Table.empty(col=Any, origin_id=pw.Pointer),
+    )
