@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import subprocess
 import sys
 
@@ -22,6 +23,9 @@ def _spawn(args, extra: list[str]) -> int:
     n = args.processes
     env_base = dict(os.environ)
     env_base["PATHWAY_PROCESSES"] = str(n)
+    # per-job shared secret authenticating host-mesh frames (HMAC); see
+    # parallel/host_exchange.py
+    env_base.setdefault("PATHWAY_DCN_SECRET", secrets.token_hex(32))
     env_base["PATHWAY_THREADS"] = str(args.threads)
     env_base["PATHWAY_FIRST_PORT"] = str(args.first_port)
     # -t T workers = T engine key-shards over the device mesh (reference:

@@ -415,6 +415,13 @@ def iterate(
 
     ``func`` receives tables (as keyword args) and returns a Table or a dict /
     namespace of Tables with the same keys; those are fed back until stable.
+
+    Limitation: temporal ``buffer`` / ``forget`` / ``freeze`` operators
+    (windowby behaviors, ``_buffer`` time-column cutoffs) are not supported
+    inside the iterate body and raise ``NotImplementedError`` at build time
+    — the incremental fixpoint engine keeps per-depth runtimes alive across
+    ticks, so there is no final flush tick that would release buffered rows.
+    Apply temporal behaviors before or after the ``iterate`` instead.
     """
     iterated_names = list(kwargs.keys())
     placeholders: list[InputNode] = []

@@ -53,6 +53,22 @@ from pathway_tpu.io._utils import add_writer, jsonable
 _LOG_DIR = "_delta_log"
 
 
+def create_exclusive_local(path: str, data: bytes) -> bool:
+    """Atomically create `path` iff it does not exist (hard-link trick) —
+    the optimistic-commit primitive shared by the delta and iceberg
+    writers. Returns False on collision."""
+    tmp = path + f".tmp-{uuid.uuid4().hex}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        os.remove(tmp)
+
+
 class _Store:
     """Filesystem facade: plain os for local paths, fsspec for URIs with a
     scheme (s3://, memory://, ...). Only the handful of operations the
@@ -129,16 +145,7 @@ class _Store:
         """Atomically create `path` iff it does not exist — the delta
         optimistic-commit primitive. Returns False on collision."""
         if self._local:
-            tmp = path + f".tmp-{uuid.uuid4().hex}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            try:
-                os.link(tmp, path)
-                return True
-            except FileExistsError:
-                return False
-            finally:
-                os.remove(tmp)
+            return create_exclusive_local(path, data)
         if self.fs.exists(path):
             return False
         with self.fs.open(path, "wb") as f:  # best-effort on object stores
@@ -377,6 +384,17 @@ class _DeltaWriter:
         ]
         self.compact_every = compact_every
         self._commits_since_compact = 0
+        if not store._local:
+            import warnings
+
+            warnings.warn(
+                f"deltalake writer over {store.protocol}://: fsspec has no "
+                "atomic create-if-absent, so the optimistic commit degrades "
+                "to exists-check-then-write (TOCTOU). Concurrent writers on "
+                "this store need external coordination (e.g. a DynamoDB-style "
+                "lock) to avoid last-writer-wins on the Delta log.",
+                stacklevel=3,
+            )
         store.makedirs(store.join(_LOG_DIR))
         versions = _list_versions(store)
         self.version = (versions[-1] + 1) if versions else 0

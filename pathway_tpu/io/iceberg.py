@@ -25,7 +25,7 @@ from pathway_tpu.engine.runtime import StaticSource, StreamingSource
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._utils import add_writer, jsonable
-from pathway_tpu.io.deltalake import _rows_from_parquet
+from pathway_tpu.io.deltalake import _rows_from_parquet, create_exclusive_local
 
 
 def _meta_dir(root: str) -> str:
@@ -33,12 +33,22 @@ def _meta_dir(root: str) -> str:
 
 
 def _current_version(root: str) -> int:
+    """Latest committed snapshot version. version-hint.text is advisory
+    (its write is last-writer-wins, so a slow writer can regress it);
+    the truth is the densely-numbered vN.metadata.json files — probe
+    upward from the hint until the next version is absent, exactly how
+    pyiceberg's filesystem catalog recovers from a stale hint."""
     hint = os.path.join(_meta_dir(root), "version-hint.text")
     try:
         with open(hint) as f:
-            return int(f.read().strip())
+            v = int(f.read().strip())
     except (OSError, ValueError):
-        return -1
+        v = -1
+    while os.path.exists(
+        os.path.join(_meta_dir(root), f"v{v + 1}.metadata.json")
+    ):
+        v += 1
+    return v
 
 
 def _snapshot_meta(root: str, version: int) -> dict:
@@ -187,6 +197,7 @@ class _IcebergWriter:
     ):
         self.root = root
         self.column_names = list(column_names)
+        self.mode = mode
         self.schema_desc = schema_desc or [
             {"name": n, "type": "any"} for n in column_names
         ]
@@ -198,6 +209,10 @@ class _IcebergWriter:
         # overwrite: the fresh (files-of-this-writer-only) snapshot is
         # committed WITH the first data batch, not at construction — an
         # aborted pipeline must not have emptied the table
+        # files written since the last successful commit — the rebase unit
+        # on commit races (files already in one of our committed snapshots
+        # must NOT be re-added: a concurrent overwrite may have dropped them)
+        self.pending_files: list[str] = []
         self.files: list[str] = (
             [
                 os.path.relpath(f, os.path.join(root, "data"))
@@ -243,30 +258,43 @@ class _IcebergWriter:
     def _commit_snapshot(self) -> None:
         import time as _time
 
-        prev = _snapshot_meta(self.root, self.version)
-        snapshots = list(prev.get("snapshots", []))
-        self.version += 1
-        snapshots.append(
-            {
-                "snapshot-id": self.version,
+        while True:
+            prev = _snapshot_meta(self.root, self.version)
+            snapshots = list(prev.get("snapshots", []))
+            next_version = self.version + 1
+            snapshot = {
+                "snapshot-id": next_version,
                 "timestamp-ms": int(_time.time() * 1000),
                 "files": list(self.files),
             }
-        )
-        meta = {
-            "files": list(self.files),
-            "schema": {"fields": self.schema_desc},
-            "snapshots": snapshots[-64:],  # bounded history
-        }
-        meta_path = os.path.join(
-            _meta_dir(self.root), f"v{self.version}.metadata.json"
-        )
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(_json.dumps(meta))
-        os.replace(tmp, meta_path)
+            meta = {
+                "files": list(self.files),
+                "schema": {"fields": self.schema_desc},
+                "snapshots": (snapshots + [snapshot])[-64:],  # bounded history
+            }
+            meta_path = os.path.join(
+                _meta_dir(self.root), f"v{next_version}.metadata.json"
+            )
+            if create_exclusive_local(meta_path, _json.dumps(meta).encode()):
+                self.version = next_version
+                self.pending_files = []
+                break
+            # a concurrent writer won version next_version: rebase this
+            # writer's OWN files onto the winner's list (append mode —
+            # unioning our stale base snapshot would resurrect files a
+            # concurrent overwrite just dropped) and retry one version up.
+            # An overwrite snapshot stays authoritative: only its own files.
+            if self.mode != "overwrite":
+                theirs = _snapshot_meta(self.root, next_version).get("files", [])
+                self.files = list(
+                    dict.fromkeys([*theirs, *self.pending_files])
+                )
+            self.version = next_version
+        # the hint is advisory (readers probe upward from it, see
+        # _current_version), so a racing last-writer-wins replace here can
+        # at worst cost readers a few extra stat calls, never data
         hint = os.path.join(_meta_dir(self.root), "version-hint.text")
-        tmp = hint + ".tmp"
+        tmp = hint + f".tmp-{uuid.uuid4().hex}"
         with open(tmp, "w") as f:
             f.write(str(self.version))
         os.replace(tmp, hint)
@@ -287,6 +315,7 @@ class _IcebergWriter:
         fname = f"{uuid.uuid4().hex}.parquet"
         pq.write_table(pa.table(cols), os.path.join(self.root, "data", fname))
         self.files.append(fname)
+        self.pending_files.append(fname)
         self._commit_snapshot()
 
 

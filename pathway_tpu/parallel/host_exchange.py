@@ -16,10 +16,24 @@ barrier; the job exits nonzero and the supervisor restarts the whole
 process group from persisted state — exactly the reference's recovery
 model (whole-cluster restart from the persisted frontier,
 src/persistence/state.rs:291).
+
+Authentication: frames carry pickled payloads, which execute code on
+load, so the mesh authenticates under a per-job shared secret
+(PATHWAY_DCN_SECRET — the CLI `spawn` generates one per job; manual
+launches must export it on every process). The hello is a
+challenge-response (acceptor sends a random nonce, dialer answers with
+an HMAC over it — a captured hello cannot be replayed to frame a peer
+as dead), and every frame MAC covers (src, dst, sequence number, body),
+so frames cannot be forged, reflected to a different peer, or replayed
+out of order. Unauthenticated bytes are dropped before they ever reach
+pickle.loads. The reference's timely mesh is unauthenticated but
+deserializes data-only bincode; pickle needs the stronger gate.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import pickle
 import socket
@@ -28,7 +42,27 @@ import threading
 import time
 from typing import Any
 
-_HELLO_MAGIC = b"PWHX1"  # protocol version tag (networking.rs handshake analog)
+_HELLO_MAGIC = b"PWHX3"  # protocol version tag (networking.rs handshake analog)
+_MAC_LEN = 32  # HMAC-SHA256
+_NONCE_LEN = 32
+
+
+def _frame_mac(key: bytes, src: int, dst: int, seq: int, body: bytes) -> bytes:
+    ctx = struct.pack("<iiQ", src, dst, seq)
+    return hmac.new(key, ctx + body, "sha256").digest()
+
+
+def _job_key() -> bytes:
+    secret = os.environ.get("PATHWAY_DCN_SECRET", "")
+    if not secret:
+        raise HostMeshError(
+            "PATHWAY_DCN_SECRET is not set. The host mesh moves pickled "
+            "frames between processes and refuses to run unauthenticated; "
+            "launch the job with `pathway-tpu spawn` (which generates a "
+            "per-job secret) or export the same random PATHWAY_DCN_SECRET "
+            "on every process."
+        )
+    return hashlib.sha256(("pathway-dcn:" + secret).encode()).digest()
 
 
 class HostMeshError(RuntimeError):
@@ -69,6 +103,7 @@ class HostMesh:
         self.pid = pid
         self.base_port = base_port
         self.host = host
+        self._key = _job_key()
         self._cv = threading.Condition()
         # (channel, tick) -> {src: payload}
         self._data: dict[tuple[str, int], dict[int, Any]] = {}
@@ -78,6 +113,7 @@ class HostMesh:
         self._dead: set[int] = set()
         self._send_locks: dict[int, threading.Lock] = {}
         self._out: dict[int, socket.socket] = {}
+        self._send_seq: dict[int, int] = {}  # per-destination frame counter
         self._closed = False
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -92,22 +128,41 @@ class HostMesh:
                 continue
             self._out[peer] = self._dial(peer, deadline)
             self._send_locks[peer] = threading.Lock()
+            self._send_seq[peer] = 0
 
     # --- wiring -----------------------------------------------------------
 
     def _dial(self, peer: int, deadline: float) -> socket.socket:
         last_err: Exception | None = None
         while time.time() < deadline:
+            s: socket.socket | None = None
             try:
                 s = socket.create_connection(
                     (self.host, self.base_port + peer), timeout=5.0
                 )
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(10.0)
+                # challenge-response: answer the acceptor's random nonce so
+                # a captured hello cannot be replayed. The hello names BOTH
+                # endpoints — the acceptor checks dst == its own pid, so a
+                # rogue listener cannot relay our answer to a third peer
+                # (HMAC-oracle connection forwarding).
+                nonce = self._read_exact(s, _NONCE_LEN)
+                if nonce is None:
+                    raise OSError("peer closed during handshake")
+                hello = _HELLO_MAGIC + struct.pack("<ii", self.pid, peer)
+                s.sendall(
+                    hello + hmac.new(self._key, hello + nonce, "sha256").digest()
+                )
                 s.settimeout(None)
-                s.sendall(_HELLO_MAGIC + struct.pack("<i", self.pid))
                 return s
             except OSError as e:
                 last_err = e
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
                 time.sleep(0.1)
         raise HostMeshError(
             f"process {self.pid}: could not reach peer {peer} at "
@@ -137,19 +192,43 @@ class HostMesh:
     def _reader(self, conn: socket.socket) -> None:
         src = -1
         try:
-            hello = self._read_exact(conn, len(_HELLO_MAGIC) + 4)
+            nonce = os.urandom(_NONCE_LEN)
+            conn.settimeout(30.0)  # handshake must complete promptly
+            conn.sendall(nonce)
+            hello = self._read_exact(conn, len(_HELLO_MAGIC) + 8 + _MAC_LEN)
             if hello is None or hello[: len(_HELLO_MAGIC)] != _HELLO_MAGIC:
                 conn.close()
                 return
-            src = struct.unpack("<i", hello[len(_HELLO_MAGIC) :])[0]
+            claimed, mac = hello[:-_MAC_LEN], hello[-_MAC_LEN:]
+            if not hmac.compare_digest(
+                mac, hmac.new(self._key, claimed + nonce, "sha256").digest()
+            ):
+                conn.close()
+                return
+            hello_src, dst = struct.unpack(
+                "<ii", hello[len(_HELLO_MAGIC) : -_MAC_LEN]
+            )
+            if dst != self.pid:
+                # answer relayed from a different handshake; close WITHOUT
+                # assigning src — the genuine peer must not be framed dead
+                conn.close()
+                return
+            src = hello_src
+            conn.settimeout(None)
+            recv_seq = 0
             while True:
-                head = self._read_exact(conn, 4)
+                head = self._read_exact(conn, 4 + _MAC_LEN)
                 if head is None:
                     break
-                (length,) = struct.unpack("<I", head)
+                (length,) = struct.unpack("<I", head[:4])
                 body = self._read_exact(conn, length)
                 if body is None:
                     break
+                if not hmac.compare_digest(
+                    head[4:], _frame_mac(self._key, src, self.pid, recv_seq, body)
+                ):
+                    break  # forged/reflected/replayed frame: drop the link
+                recv_seq += 1
                 frame = pickle.loads(body)
                 kind = frame[0]
                 with self._cv:
@@ -175,9 +254,13 @@ class HostMesh:
 
     def _send_frame(self, dst: int, frame: tuple) -> None:
         body = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
-        msg = struct.pack("<I", len(body)) + body
         try:
             with self._send_locks[dst]:
+                mac = _frame_mac(
+                    self._key, self.pid, dst, self._send_seq[dst], body
+                )
+                self._send_seq[dst] += 1
+                msg = struct.pack("<I", len(body)) + mac + body
                 self._out[dst].sendall(msg)
         except OSError as e:
             raise HostMeshError(

@@ -153,12 +153,14 @@ _DCN_WORDCOUNT = textwrap.dedent(
 
 def _spawn_group(script_path, n, port, extra_env=None, timeout=150):
     procs = []
+    job_secret = "test-job-secret-%d" % port
     for pid in range(n):
         env = dict(os.environ)
         env.update(
             PATHWAY_PROCESSES=str(n),
             PATHWAY_PROCESS_ID=str(pid),
             PATHWAY_DCN_PORT=str(port),
+            PATHWAY_DCN_SECRET=job_secret,
             JAX_PLATFORMS="cpu",
             PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
         )
@@ -438,3 +440,53 @@ def test_two_process_join_dcn(tmp_path):
     merged = sorted(tuple(x) for r in results for x in r)
     expected = sorted((i, (i % 5) * 100) for i in range(40))
     assert merged == expected
+
+
+def test_host_mesh_rejects_unauthenticated_frames(monkeypatch):
+    """A client without the per-job PATHWAY_DCN_SECRET must not get its
+    bytes anywhere near pickle.loads (ADVICE r4: pickle over TCP is RCE
+    without authentication)."""
+    import pickle
+    import struct
+    import threading
+
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "mesh-auth-test")
+    base = _free_port()
+    meshes = [None, None]
+
+    def build(pid):
+        meshes[pid] = hx.HostMesh(2, pid, base, connect_timeout=30.0)
+
+    threads = [threading.Thread(target=build, args=(pid,)) for pid in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    m0, m1 = meshes
+    assert m0 is not None and m1 is not None
+    try:
+        # rogue client: reads the challenge but answers with a garbage MAC
+        rogue_payload = ("data", 1, "evil", 0, "boom")
+        body = pickle.dumps(rogue_payload)
+        rogue = socket.create_connection(("127.0.0.1", base), timeout=5)
+        rogue.settimeout(5)
+        nonce = rogue.recv(hx._NONCE_LEN)
+        assert len(nonce) == hx._NONCE_LEN
+        rogue.sendall(hx._HELLO_MAGIC + struct.pack("<ii", 1, 0) + b"\0" * hx._MAC_LEN)
+        rogue.sendall(struct.pack("<I", len(body)) + b"\0" * hx._MAC_LEN + body)
+        rogue.close()
+        # legitimate traffic still flows
+        m0.send(1, "ch", 0, {"ok": True})
+        got = m1.gather("ch", 0, timeout=30)
+        assert got == {0: {"ok": True}}
+        time.sleep(0.3)
+        assert ("evil", 0) not in m1._data and ("evil", 0) not in m0._data
+        # a mesh without the secret refuses to construct at all
+        monkeypatch.delenv("PATHWAY_DCN_SECRET")
+        with pytest.raises(hx.HostMeshError, match="PATHWAY_DCN_SECRET"):
+            hx.HostMesh(2, 0, _free_port())
+    finally:
+        m0.close()
+        m1.close()
