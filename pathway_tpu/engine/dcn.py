@@ -51,18 +51,39 @@ class _DcnRouter:
                     parts[p].append(b if m.all() else b.mask(m))
         return parts
 
-    def exchange(
+    def exchange_keep_src(
         self, t: int, parts: list[list[DiffBatch]]
-    ) -> list[DiffBatch]:
+    ) -> list[tuple[int, list[DiffBatch]]]:
+        """Swap partitions; result is (src, batches) in GLOBAL pid order —
+        every process then applies one tick's rows in the identical order,
+        so order-sensitive state (last-write-wins triplets, acceptors)
+        agrees group-wide. The src tags let ops route results back home."""
         self.exchanges += 1
         for p in range(self.n):
             if p != self.pid:
                 self.mesh.send(p, self.channel, t, parts[p])
         got = self.mesh.gather(self.channel, t)
-        merged = list(parts[self.pid])
-        for src in sorted(got):
-            merged.extend(got[src])
-        return merged
+        return [
+            (p, parts[p] if p == self.pid else got.get(p, []))
+            for p in range(self.n)
+        ]
+
+    def exchange(
+        self, t: int, parts: list[list[DiffBatch]]
+    ) -> list[DiffBatch]:
+        return [
+            b for _src, bs in self.exchange_keep_src(t, parts) for b in bs
+        ]
+
+    def exchange_scalar(self, t: int, value: Any) -> list[Any]:
+        """All-gather one picklable value per process (pid order)."""
+        self.exchanges += 1
+        for p in range(self.n):
+            if p != self.pid:
+                self.mesh.send(p, self.channel, t, value)
+        got = self.mesh.gather(self.channel, t)
+        got[self.pid] = value
+        return [got[p] for p in sorted(got)]
 
 
 class DcnGroupByExec(NodeExec):
@@ -172,6 +193,372 @@ class DcnJoinExec(NodeExec):
         if t <= self.replay_floor:
             return []  # restored state already covers this tick
         return self.inner.process(t, [local_l, local_r])
+
+    def on_end(self):
+        return self.inner.on_end()
+
+    def state_dict(self):
+        return {"inner": self.inner.state_dict()}
+
+    def load_state(self, state):
+        if state.get("inner"):
+            self.inner.load_state(state["inner"])
+
+
+# ---------------------------------------------------------------------------
+# Generic stateful exchange (VERDICT r4 item 2): every remaining stateful
+# operator type gets a cross-process wrapper, mirroring the reference's
+# universal Exchange pact (external/timely-dataflow/timely/src/dataflow/
+# channels/pact.rs:56-59; src/engine/dataflow/operators.rs:415 Reshard).
+# Routing disciplines:
+#   "key"   — partition rows by an operator-specific key hash; the inner
+#             exec owns a disjoint key range (groupby/join discipline)
+#   "bcast" — replicate this input on every process (small side inputs:
+#             gradual_broadcast thresholds, external-index corpus)
+#   "p0"    — centralize this input on process 0 (inherently global state:
+#             instance-less sort, iterate fixpoints)
+#   "local" — no exchange (rows already live where their state lives)
+#
+# Placement contract: an op whose output universe is FRESH (dedup, iterate,
+# update_rows — new keys or a new key set) may leave results on the process
+# that computed them; union across processes is the result. An op whose
+# output universe is an INPUT's universe (ix, set-ops, sort, buffer,
+# gradual_broadcast, external_index) must emit each row on the process
+# where that input row lives, or downstream aligned row-wise execs would
+# see half a row — so those ops either keep the universe-owning side local
+# (replicating the other side) or exchange results back to their origin.
+
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+class _DcnStatefulExec(NodeExec):
+    """Shared plumbing: build the node's local exec, route each input per
+    its spec, feed the merged partitions through. Output rows are emitted
+    on the process owning their key — per-process outputs union to the
+    single-process result, the same contract as DcnGroupByExec."""
+
+    def __init__(self, node, specs, tag: str):
+        super().__init__(node)
+        self.inner = node._make_local_exec()
+        self.replay_floor = -1  # see DcnGroupByExec.replay_floor
+        if getattr(self.inner, "persist_standalone", False):
+            self.persist_standalone = True
+        self.specs = list(specs)
+        self.routers = [
+            None if s == "local" else _DcnRouter(f"{tag}{i}n{node.id}")
+            for i, s in enumerate(self.specs)
+        ]
+        self.n = next((r.n for r in self.routers if r is not None), 1)
+
+    def _dests(self, i: int, b: DiffBatch) -> np.ndarray:
+        raise NotImplementedError
+
+    def process(self, t, inputs):
+        local: list[list[DiffBatch]] = []
+        for i, (spec, router, batches) in enumerate(
+            zip(self.specs, self.routers, inputs)
+        ):
+            if spec == "local":
+                local.append(list(batches))
+                continue
+            if spec == "bcast":
+                nonempty = [b for b in batches if len(b)]
+                parts = [list(nonempty) for _ in range(router.n)]
+            elif spec == "p0":
+                parts = [[] for _ in range(router.n)]
+                parts[0] = [b for b in batches if len(b)]
+            else:  # "key"
+                parts = router.partition(
+                    batches, lambda b, i=i: self._dests(i, b)
+                )
+            local.append(router.exchange(t, parts))
+        if t <= self.replay_floor:
+            return []
+        return self.inner.process(t, local)
+
+    def on_end(self):
+        return self.inner.on_end()
+
+    def state_dict(self):
+        return {"inner": self.inner.state_dict()}
+
+    def load_state(self, state):
+        if state.get("inner"):
+            self.inner.load_state(state["inner"])
+
+
+def _rowkey_dests(b: DiffBatch, n: int) -> np.ndarray:
+    return shard_of(np.asarray(b.keys, dtype=np.uint64), n)
+
+
+class _OriginTracker:
+    """row key -> feeding process, maintained by diffs: insert after full
+    retraction re-homes the key, full retraction frees the entry (deferred
+    to flush_dead so the retraction's own output row still routes home)."""
+
+    def __init__(self):
+        self.entries: dict[int, list] = {}  # key -> [origin_pid, count]
+
+    def observe(self, src: int, batches: list[DiffBatch]) -> None:
+        entries = self.entries
+        for b in batches:
+            for k, d in zip(b.keys.tolist(), b.diffs.tolist()):
+                e = entries.get(k)
+                if e is None:
+                    entries[k] = [src, d]
+                else:
+                    if e[1] <= 0 and d > 0:
+                        e[0] = src
+                    e[1] += d
+
+    def flush_dead(self) -> None:
+        dead = [k for k, e in self.entries.items() if e[1] <= 0]
+        for k in dead:
+            del self.entries[k]
+
+    def dests(self, b: DiffBatch, default: int) -> np.ndarray:
+        entries = self.entries
+        return np.fromiter(
+            (
+                e[0] if (e := entries.get(k)) is not None else default
+                for k in b.keys.tolist()
+            ),
+            dtype=np.int32,
+            count=len(b),
+        )
+
+    def state_dict(self) -> dict:
+        return {k: list(v) for k, v in self.entries.items()}
+
+    def load_state(self, state: dict) -> None:
+        self.entries = {int(k): list(v) for k, v in state.items()}
+
+
+class DcnDeduplicateExec(_DcnStatefulExec):
+    """Rows route by instance-key hash — the process owning an instance
+    holds its accepted value (reference: deduplicate over Exchange,
+    src/engine/dataflow.rs:3514). Output keys ARE instance hashes (a fresh
+    universe), so results may stay on their owner."""
+
+    def __init__(self, node):
+        super().__init__(node, ["key"], "dd")
+        self._inst_cols = list(node.instance_cols)
+
+    def _dests(self, i, b):
+        from pathway_tpu.internals.api import ref_scalar
+
+        cols = [b.columns[c] for c in self._inst_cols]
+        ks = np.fromiter(
+            (
+                int(ref_scalar(*(col[r] for col in cols))) & _U64
+                for r in range(len(b))
+            ),
+            dtype=np.uint64,
+            count=len(b),
+        )
+        return shard_of(ks, self.n)
+
+
+class _DcnReturnHomeExec(NodeExec):
+    """Base for ops whose OUTPUT universe preserves input row keys while
+    their state needs exchanged inputs: inputs route per `dest_for`, every
+    arrival records its feeding process in an _OriginTracker, and output
+    rows are exchanged BACK to that process so downstream aligned selects
+    see whole rows (placement contract above)."""
+
+    def __init__(self, node, tag: str):
+        super().__init__(node)
+        self.inner = node._make_local_exec()
+        self.replay_floor = -1
+        if getattr(self.inner, "persist_standalone", False):
+            self.persist_standalone = True
+        self.routers = [
+            _DcnRouter(f"{tag}{i}n{node.id}") for i in range(len(node.inputs))
+        ]
+        self.back = _DcnRouter(f"{tag}bn{node.id}")
+        self.n = self.routers[0].n
+        self.origins = _OriginTracker()
+
+    def dest_for(self, i: int, b: DiffBatch) -> np.ndarray:
+        raise NotImplementedError
+
+    def process(self, t, inputs):
+        local: list[list[DiffBatch]] = []
+        for i, (router, batches) in enumerate(zip(self.routers, inputs)):
+            parts = router.partition(
+                batches, lambda b, i=i: self.dest_for(i, b)
+            )
+            merged: list[DiffBatch] = []
+            for src, bs in router.exchange_keep_src(t, parts):
+                self.origins.observe(src, bs)
+                merged.extend(bs)
+            local.append(merged)
+        out = (
+            [] if t <= self.replay_floor else list(self.inner.process(t, local))
+        )
+        homed = self.back.exchange(
+            t,
+            self.back.partition(
+                out, lambda b: self.origins.dests(b, self.back.pid)
+            ),
+        )
+        self.origins.flush_dead()
+        return homed
+
+    def on_end(self):
+        # runs after the lockstep cadence ends — no exchange possible; the
+        # wrapped ops emit nothing new on flush
+        return self.inner.on_end()
+
+    def state_dict(self):
+        return {
+            "inner": self.inner.state_dict(),
+            "origin": self.origins.state_dict(),
+        }
+
+    def load_state(self, state):
+        if state.get("inner"):
+            self.inner.load_state(state["inner"])
+        self.origins.load_state(state.get("origin", {}))
+
+
+class DcnSortExec(_DcnReturnHomeExec):
+    """Each instance's sorted order lives wholly on the process owning the
+    instance hash (reference: prev_next instance co-location,
+    src/engine/dataflow/operators/prev_next.rs); an instance-less sort is
+    one global order, centralized on process 0. prev/next rows return to
+    the process each input row arrived from."""
+
+    def __init__(self, node):
+        super().__init__(node, "srt")
+
+    def dest_for(self, i, b):
+        if self.node.instance_col is None:
+            return np.zeros(len(b), dtype=np.int32)
+        from pathway_tpu.internals.api import ref_scalar
+
+        col = b.columns[self.node.instance_col]
+        ks = np.fromiter(
+            (int(ref_scalar(v)) & _U64 for v in col),
+            dtype=np.uint64,
+            count=len(b),
+        )
+        return shard_of(ks, self.n)
+
+
+class DcnUpdateRowsExec(_DcnReturnHomeExec):
+    """Both sides route by row key so the left/right rows of one key
+    co-locate for the override decision; the merged row then returns to
+    the process that fed the key (output keys are the UNION of the input
+    key sets, so downstream aligned consumers need them home)."""
+
+    def __init__(self, node):
+        super().__init__(node, "ur")
+
+    def dest_for(self, i, b):
+        return _rowkey_dests(b, self.n)
+
+
+class DcnUniverseSetOpExec(_DcnStatefulExec):
+    """The left (universe-owning) side stays local; the other key sets
+    replicate, so membership counting is process-local and output rows
+    stay where their left row lives (placement contract above)."""
+
+    def __init__(self, node):
+        super().__init__(
+            node, ["local"] + ["bcast"] * (len(node.inputs) - 1), "us"
+        )
+
+
+class DcnIxExec(_DcnStatefulExec):
+    """The indexer (universe-owning) side stays local; the indexed table
+    replicates on every process, so each lookup answers locally and the
+    result row stays on its indexer row's process (placement contract
+    above — the reference instead exchanges both sides and re-exchanges
+    the result, operators.rs ix arrange+join)."""
+
+    def __init__(self, node):
+        super().__init__(node, ["local", "bcast"], "ix")
+
+
+class DcnGradualBroadcastExec(_DcnStatefulExec):
+    """Data rows stay local; the tiny (lower, value, upper) threshold table
+    replicates everywhere so every process sweeps the same triplet
+    (reference: gradual_broadcast's broadcasted apx counter,
+    src/engine/dataflow/operators/gradual_broadcast.rs)."""
+
+    def __init__(self, node):
+        super().__init__(node, ["local", "bcast"], "gb")
+
+
+class DcnExternalIndexExec(_DcnStatefulExec):
+    """The index side replicates on every process (each holds the full
+    corpus, device-mesh sharded locally); queries stay local and answer
+    as-of-now against the replica (reference: external index operator,
+    src/engine/dataflow/operators/external_index.rs)."""
+
+    def __init__(self, node):
+        super().__init__(node, ["bcast", "local"], "xi")
+
+
+class DcnIterateExec(_DcnReturnHomeExec):
+    """Fixpoint iteration centralizes on process 0: iterate bodies are
+    arbitrary subgraphs whose per-depth runtimes cannot yet join the
+    lockstep cadence, so inputs funnel to one process and the fixpoint
+    runs there. Bodies commonly PRESERVE input keys, so result rows are
+    exchanged back to each key's feeding process (keys the body invented
+    stay on process 0). Correct, not scale-out — iterate-heavy jobs
+    should shard by instance upstream."""
+
+    def __init__(self, node):
+        super().__init__(node, "it")
+
+    def dest_for(self, i, b):
+        return np.zeros(len(b), dtype=np.int32)
+
+
+class DcnWatermarkExec(NodeExec):
+    """Buffer/Forget/Freeze: per-row state needs no co-location (a row and
+    its retraction always arrive on the same process), but the release
+    watermark — max over the current-time column — is GLOBAL. Every tick
+    the local watermark is all-gathered and the inner exec advanced to the
+    group max, then re-released (reference: time_column.rs postpone/forget
+    consult the broadcast frontier of the time column)."""
+
+    def __init__(self, node):
+        super().__init__(node)
+        self.inner = node._make_local_exec()
+        self.router = _DcnRouter(f"wm{node.id}")
+        self.replay_floor = -1
+
+    def _shards(self):
+        inner = self.inner
+        return inner.shards if hasattr(inner, "shards") else [inner]
+
+    def process(self, t, inputs):
+        out = [] if t <= self.replay_floor else list(
+            self.inner.process(t, inputs)
+        )
+        local_wm = None
+        for ex in self._shards():
+            wm = ex.max_seen
+            if wm is not None and (local_wm is None or wm > local_wm):
+                local_wm = wm
+        for wm in self.router.exchange_scalar(t, local_wm):
+            if wm is not None and (local_wm is None or wm > local_wm):
+                local_wm = wm
+        advanced = False
+        for ex in self._shards():
+            if local_wm is not None and (
+                ex.max_seen is None or local_wm > ex.max_seen
+            ):
+                ex.max_seen = local_wm
+                advanced = True
+        if advanced and t > self.replay_floor:
+            # an empty process() re-runs the release scan under the
+            # advanced watermark (Freeze has no release scan: no-op)
+            out.extend(self.inner.process(t, [[]]))
+        return out
 
     def on_end(self):
         return self.inner.on_end()

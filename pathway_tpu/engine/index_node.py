@@ -52,8 +52,15 @@ class ExternalIndexNode(Node):
         self.index_factory = index_factory
         self.as_of_now = as_of_now
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return ExternalIndexExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnExternalIndexExec
+
+            return DcnExternalIndexExec(self)
+        return self._make_local_exec()
 
 
 class ExternalIndexExec(NodeExec):

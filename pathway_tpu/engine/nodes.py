@@ -1087,8 +1087,15 @@ class UpdateRowsNode(Node):
     def __init__(self, left: Node, right: Node):
         super().__init__([left, right], left.column_names)
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return UpdateRowsExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnUpdateRowsExec
+
+            return DcnUpdateRowsExec(self)
+        return self._make_local_exec()
 
 
 class UpdateRowsExec(NodeExec):
@@ -1242,7 +1249,7 @@ class SortNode(Node):
         self.key_col = key_col
         self.instance_col = instance_col
 
-    def make_exec(self):
+    def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
 
         em = get_engine_mesh()
@@ -1253,6 +1260,13 @@ class SortNode(Node):
 
             return ShardedSortExec(self, em[0], em[1])
         return SortExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnSortExec
+
+            return DcnSortExec(self)
+        return self._make_local_exec()
 
 
 class SortExec(NodeExec):
@@ -1407,8 +1421,15 @@ class GradualBroadcastNode(Node):
     def __init__(self, data: Node, thr: Node):
         super().__init__([data, thr], ["apx_value"])
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return GradualBroadcastExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnGradualBroadcastExec
+
+            return DcnGradualBroadcastExec(self)
+        return self._make_local_exec()
 
 
 _KEY_SPACE = float(1 << 64)
@@ -1544,8 +1565,15 @@ class DeduplicateNode(Node):
         self.acceptor = acceptor
         self.value_col = value_col
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return DeduplicateExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnDeduplicateExec
+
+            return DcnDeduplicateExec(self)
+        return self._make_local_exec()
 
 
 class DeduplicateExec(NodeExec):
@@ -1635,8 +1663,15 @@ class IxNode(Node):
         self.ptr_col = ptr_col
         self.optional = optional
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return IxExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnIxExec
+
+            return DcnIxExec(self)
+        return self._make_local_exec()
 
 
 class IxExec(NodeExec):
@@ -1715,8 +1750,15 @@ class UniverseSetOpNode(Node):
         super().__init__([left] + list(others), left.column_names)
         self.mode = mode  # 'intersect' | 'difference' | 'restrict'
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return UniverseSetOpExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnUniverseSetOpExec
+
+            return DcnUniverseSetOpExec(self)
+        return self._make_local_exec()
 
 
 class UniverseSetOpExec(NodeExec):
@@ -1822,7 +1864,7 @@ class BufferNode(Node):
         self.current_time_col = current_time_col
         self.flush_on_end = flush_on_end
 
-    def make_exec(self):
+    def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
 
         em = get_engine_mesh()
@@ -1831,6 +1873,13 @@ class BufferNode(Node):
 
             return ShardedBufferExec(self, em[0], em[1])
         return BufferExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnWatermarkExec
+
+            return DcnWatermarkExec(self)
+        return self._make_local_exec()
 
 
 class BufferExec(NodeExec):
@@ -1908,8 +1957,15 @@ class ForgetNode(Node):
         self.threshold_col = threshold_col
         self.current_time_col = current_time_col
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return ForgetExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnWatermarkExec
+
+            return DcnWatermarkExec(self)
+        return self._make_local_exec()
 
 
 class ForgetExec(NodeExec):
@@ -1957,8 +2013,15 @@ class FreezeNode(Node):
         self.threshold_col = threshold_col
         self.current_time_col = current_time_col
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return FreezeExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnWatermarkExec
+
+            return DcnWatermarkExec(self)
+        return self._make_local_exec()
 
 
 class FreezeExec(NodeExec):

@@ -296,38 +296,14 @@ class Runtime:
             from pathway_tpu.parallel.host_exchange import get_host_mesh
 
             self.host_mesh = get_host_mesh()
-            # fail loudly rather than compute silently-wrong results:
-            # stateful operators without a cross-process exchange keep
-            # purely process-local state, so e.g. deduplicate would emit
-            # one survivor PER PROCESS for the same key
-            _dcn_unsupported = {
-                "DeduplicateNode",
-                "SortNode",
-                "BufferNode",
-                "ForgetNode",
-                "FreezeNode",
-                "GradualBroadcastNode",
-                "IxNode",
-                "UniverseSetOpNode",
-                "IterateNode",
-                "ExternalIndexNode",
-                "UpdateRowsNode",
-            }
-            bad = sorted(
-                {
-                    type(n).__name__
-                    for n in self.order
-                    if type(n).__name__ in _dcn_unsupported
-                }
-            )
-            if bad:
-                raise NotImplementedError(
-                    f"multi-process engine (PATHWAY_PROCESSES>1) does not "
-                    f"yet exchange state for: {', '.join(bad)}. These "
-                    "operators would keep process-local state and return "
-                    "wrong results. Run single-process, or restructure "
-                    "around groupby/join (which are exchanged)."
-                )
+            # EVERY stateful operator type has a cross-process exchange
+            # wrapper (engine/dcn.py), mirroring the reference's universal
+            # Exchange pact — groupby/join partition by key, dedup by
+            # instance, sort by instance (global sorts centralize),
+            # ix by pointer target, update_rows/set-ops by row key,
+            # buffer/forget/freeze all-gather their watermark,
+            # gradual_broadcast/external_index replicate the small side,
+            # iterate centralizes its fixpoint.
         for node in self.order:
             node._dcn = self.dcn
         self.execs: dict[int, NodeExec] = {

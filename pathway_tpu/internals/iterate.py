@@ -65,8 +65,15 @@ class IterateNode(Node):
         self.out_name = out_name
         self.iteration_limit = iteration_limit
 
-    def make_exec(self):
+    def _make_local_exec(self):
         return IterateExec(self)
+
+    def make_exec(self):
+        if getattr(self, "_dcn", False):
+            from pathway_tpu.engine.dcn import DcnIterateExec
+
+            return DcnIterateExec(self)
+        return self._make_local_exec()
 
 
 class _Depth:
