@@ -1894,13 +1894,12 @@ class BufferExec(NodeExec):
 
     def process(self, t, inputs):
         out_rows = []
+        batch_max = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 cur = vals[self.cur_idx]
-                if self.max_seen is None or (
-                    cur is not None and cur > self.max_seen
-                ):
-                    self.max_seen = cur
+                if cur is not None and (batch_max is None or cur > batch_max):
+                    batch_max = cur
                 if k in self.released:
                     out_rows.append((k, d, vals))
                     if d < 0:
@@ -1914,7 +1913,16 @@ class BufferExec(NodeExec):
                         del self.held[k]
                     else:
                         out_rows.append((k, d, vals))
-        # release rows whose threshold <= max time seen
+        # release is IMMEDIATE within a tick (a row whose threshold the
+        # same batch's time column already passes flows straight through —
+        # reference: postpone_core releases against `now` including the
+        # current batch, and delay=0 must not hold rows a tick); contrast
+        # ForgetExec/FreezeExec, whose watermarks genuinely lag
+        if batch_max is not None and (
+            self.max_seen is None or batch_max > self.max_seen
+        ):
+            self.max_seen = batch_max
+        # release rows whose threshold <= watermark
         if self.max_seen is not None:
             ready = [
                 k
@@ -1976,22 +1984,25 @@ class ForgetExec(NodeExec):
         self.cur_idx = in_cols.index(node.current_time_col)
         self.live: dict[int, list] = {}
         self.max_seen: Any = None
+        self._scanned_at: Any = None  # watermark value at the last scan
 
     def process(self, t, inputs):
         out_rows = []
-        for b in inputs[0]:
-            for k, d, vals in b.iter_rows():
-                cur = vals[self.cur_idx]
-                if self.max_seen is None or (
-                    cur is not None and cur > self.max_seen
-                ):
-                    self.max_seen = cur
-                out_rows.append((k, d, vals))
-                if d > 0:
-                    self.live[k] = [vals[self.thr_idx], vals]
-                else:
-                    self.live.pop(k, None)
-        if self.max_seen is not None:
+        # Forgetting is DATA-driven, lagged one tick: rows stale against
+        # the watermark of STRICTLY EARLIER ticks retract when new data
+        # (or an externally advanced DCN watermark) arrives — never at the
+        # end-of-stream flush tick, which carries no time advancement
+        # (reference: TimeColumnForget reacts to input batches,
+        # time_column.rs:426; batch mode forgets nothing).
+        has_rows = any(len(b) for b in inputs[0])
+        externally_advanced = (
+            self.max_seen is not None and self.max_seen != self._scanned_at
+        )
+        if (
+            (has_rows or externally_advanced)
+            and t < END_OF_TIME
+            and self.max_seen is not None
+        ):
             stale = [
                 k
                 for k, (thr, _v) in self.live.items()
@@ -2000,6 +2011,22 @@ class ForgetExec(NodeExec):
             for k in stale:
                 thr, vals = self.live.pop(k)
                 out_rows.append((k, -1, vals))
+        self._scanned_at = self.max_seen
+        batch_max = None
+        for b in inputs[0]:
+            for k, d, vals in b.iter_rows():
+                cur = vals[self.cur_idx]
+                if cur is not None and (batch_max is None or cur > batch_max):
+                    batch_max = cur
+                out_rows.append((k, d, vals))
+                if d > 0:
+                    self.live[k] = [vals[self.thr_idx], vals]
+                else:
+                    self.live.pop(k, None)
+        if batch_max is not None and (
+            self.max_seen is None or batch_max > self.max_seen
+        ):
+            self.max_seen = batch_max
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]
@@ -2034,9 +2061,14 @@ class FreezeExec(NodeExec):
 
     def process(self, t, inputs):
         out_rows = []
+        batch_max = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 thr = vals[self.thr_idx]
+                # lateness is judged against the watermark of STRICTLY
+                # EARLIER ticks (reference: TimeColumnFreeze,
+                # time_column.rs:509) — same-tick rows never freeze each
+                # other out
                 if (
                     self.max_seen is not None
                     and thr is not None
@@ -2045,10 +2077,12 @@ class FreezeExec(NodeExec):
                     continue  # late — frozen out
                 out_rows.append((k, d, vals))
                 cur = vals[self.cur_idx]
-                if self.max_seen is None or (
-                    cur is not None and cur > self.max_seen
-                ):
-                    self.max_seen = cur
+                if cur is not None and (batch_max is None or cur > batch_max):
+                    batch_max = cur
+        if batch_max is not None and (
+            self.max_seen is None or batch_max > self.max_seen
+        ):
+            self.max_seen = batch_max
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]

@@ -106,6 +106,20 @@ class GroupedTable:
         self._sort_by = sort_by
         self._skip_errors = skip_errors
 
+    def __getattr__(self, name: str) -> Any:
+        # source columns are addressable on the grouped table itself, for
+        # reduce expressions like values.ix(grouped.ptr).v (reference:
+        # GroupedJoinable column access, internals/groupbys.py)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self._table[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __getitem__(self, name: str) -> Any:
+        return self._table[name]
+
     def reduce(self, *args: Any, **kwargs: Any) -> Any:
         from pathway_tpu.internals.table import Table, infer_dtype
 

@@ -43,23 +43,64 @@ _HIDDEN = (
 )
 
 
+def _instance_col_name(instance, flat) -> str | None:
+    """Name of the original instance column when windowby's instance= was a
+    plain column still present on the flattened table."""
+    if isinstance(instance, ColumnReference) and instance.name in (
+        flat.column_names()
+    ):
+        return instance.name
+    return None
+
+
 def _default_origin(t: Any) -> Any:
     if isinstance(t, datetime.datetime):
         return datetime.datetime(1970, 1, 1, tzinfo=t.tzinfo)
     return 0
 
 
-def _windowed_grouped(flat, *, instance: bool, sort_by: str = "_pw_key"):
+class _WindowedGroupedTable(GroupedTable):
+    """Warns when processing-time reducers meet data-time windows
+    (reference: windowby reduce latest-reducer warning,
+    stdlib/temporal/_window.py)."""
+
+    def reduce(self, *args: Any, **kwargs: Any):
+        import warnings
+
+        from pathway_tpu.internals.expression import ReducerExpression
+
+        for e in list(args) + list(kwargs.values()):
+            name = getattr(
+                getattr(e, "_reducer", None), "name", None
+            ) if isinstance(e, ReducerExpression) else None
+            if name in ("latest", "earliest"):
+                warnings.warn(
+                    f"{name} reducer uses processing time to choose elements"
+                    " while windowby uses data time to assign entries to"
+                    " windows. Maybe it is not the behavior you want. To"
+                    " choose elements according to their data time, you may"
+                    f" use {'max' if name == 'latest' else 'min'} reducer.",
+                    stacklevel=2,
+                )
+        return super().reduce(*args, **kwargs)
+
+
+def _windowed_grouped(
+    flat, *, instance: bool, sort_by: str = "_pw_key", extra_group=None
+):
     """GroupedTable over the flattened (row, window) table, grouped by the
-    window identity columns."""
+    window identity columns. `extra_group` names the ORIGINAL instance
+    column when windowby was given a plain column — the reference lets
+    reduce() select it directly (it is constant within a window)."""
     grouping = [
         flat._pw_window,
         flat._pw_window_start,
         flat._pw_window_end,
+        flat._pw_instance,  # constant None without an instance
     ]
-    if instance:
-        grouping.append(flat._pw_instance)
-    return GroupedTable(flat, grouping, sort_by=flat[sort_by])
+    if extra_group is not None:
+        grouping.append(flat[extra_group])
+    return _WindowedGroupedTable(flat, grouping, sort_by=flat[sort_by])
 
 
 class Window(ABC):
@@ -81,21 +122,35 @@ class _SlidingWindow(Window):
     hop: Any
     duration: Any
     origin: Any | None
+    ratio: int | None = None  # window length = ratio * hop (stable bounds)
 
     def _assign_fn(self) -> Callable[[Any], tuple]:
         hop, duration, origin0 = self.hop, self.duration, self.origin
+        ratio = self.ratio
 
         def assign(t):
             if t is None:
                 return ()
             origin = origin0 if origin0 is not None else _default_origin(t)
-            # all k with origin + k*hop <= t < origin + k*hop + duration
-            k_max = math.floor((t - origin) / hop)
-            k_min = math.floor((t - origin - duration) / hop) + 1
+            # candidate k range, then STABLE bounds ((k+ratio)*hop computed
+            # fresh per window — a ratio-specified window end never drifts
+            # from the (k+ratio)-th window start) filtered by actual
+            # membership; windows before an explicit origin are dropped —
+            # reference: SlidingWindow._window_assignment_function
+            last_k = int((t - origin) // hop) + 1
+            if ratio is not None:
+                first_k = last_k - ratio - 2
+            else:
+                first_k = last_k - int(duration // hop) - 2
             out = []
-            for k in range(k_min, k_max + 1):
-                start = origin + k * hop
-                out.append((start, start + duration))
+            for k in range(first_k, last_k + 1):
+                start = k * hop + origin
+                if ratio is not None:
+                    end = (k + ratio) * hop + origin
+                else:
+                    end = k * hop + origin + duration
+                if start <= t < end and (origin0 is None or start >= origin0):
+                    out.append((start, end))
             return tuple(out)
 
         return assign
@@ -122,8 +177,11 @@ class _SlidingWindow(Window):
         out_exprs["_pw_window"] = make_tuple(
             inst_expr, flat._pw_windows[0], flat._pw_windows[1]
         )
-        if has_instance:
-            out_exprs["_pw_instance"] = flat._pw_instance
+        # _pw_instance is ALWAYS exposed (None without an instance), as in
+        # the reference's windowby output schema
+        out_exprs["_pw_instance"] = (
+            flat._pw_instance if has_instance else None
+        )
         return flat.select(**out_exprs), has_instance
 
     def _apply(self, table, key, behavior, instance):
@@ -131,7 +189,11 @@ class _SlidingWindow(Window):
         flat = apply_behavior(
             flat, "_pw_key", "_pw_window_start", "_pw_window_end", behavior
         )
-        return _windowed_grouped(flat, instance=has_instance)
+        return _windowed_grouped(
+            flat,
+            instance=has_instance,
+            extra_group=_instance_col_name(instance, flat),
+        )
 
     def _join(self, left, right, left_time, right_time, on, mode, behavior):
         from pathway_tpu.internals.table import desugar
@@ -161,7 +223,57 @@ def tumbling(duration, origin=None) -> Window:
     """Fixed-size non-overlapping windows of `duration`, aligned to
     `origin` (default: 0 / epoch)."""
     _check_window_params(duration, duration, origin)
-    return _SlidingWindow(hop=duration, duration=duration, origin=origin)
+    w = _SlidingWindow(hop=duration, duration=None, origin=origin, ratio=1)
+    w._tumbling = True  # build-time validation names only window.hop
+    return w
+
+
+def _validate_window_types(table, key, window) -> None:
+    """Build-time dtype validation of the time column against the window's
+    parameters (reference: check_joint_types over eval_type in every
+    window's _apply, stdlib/temporal/_window.py)."""
+    from pathway_tpu.stdlib.temporal.utils import (
+        check_joint_kinds,
+        dtype_kind,
+        value_kind,
+    )
+
+    kk = dtype_kind(
+        table._build_rowwise({"_pw_key": key})._schema["_pw_key"].dtype
+    )
+    if isinstance(window, _SlidingWindow):
+        params = {
+            "time_expr": (kk, "time"),
+            "window.hop": (value_kind(window.hop), "interval"),
+        }
+        if not getattr(window, "_tumbling", False) and window.duration is not None:
+            params["window.duration"] = (
+                value_kind(window.duration),
+                "interval",
+            )
+        params["window.origin"] = (value_kind(window.origin), "time")
+        check_joint_kinds(params)
+    elif isinstance(window, _SessionWindow):
+        check_joint_kinds(
+            {
+                "time_expr": (kk, "time"),
+                "window.max_gap": (value_kind(window.max_gap), "interval"),
+            }
+        )
+    elif isinstance(window, _IntervalsOverWindow):
+        check_joint_kinds(
+            {
+                "time_expr": (kk, "time"),
+                "window.lower_bound": (
+                    value_kind(window.lower_bound),
+                    "interval",
+                ),
+                "window.upper_bound": (
+                    value_kind(window.upper_bound),
+                    "interval",
+                ),
+            }
+        )
 
 
 def _check_window_params(hop, duration, origin):
@@ -192,10 +304,8 @@ def sliding(hop, duration=None, ratio=None, origin=None) -> Window:
         raise ValueError(
             "exactly one of `duration` or `ratio` should be provided"
         )
-    if duration is None:
-        duration = hop * ratio
-    _check_window_params(hop, duration, origin)
-    return _SlidingWindow(hop=hop, duration=duration, origin=origin)
+    _check_window_params(hop, duration if duration is not None else hop, origin)
+    return _SlidingWindow(hop=hop, duration=duration, origin=origin, ratio=ratio)
 
 
 # ---------------------------------------------------------------------------
@@ -237,8 +347,9 @@ class _SessionWindow(Window):
             sess._pw_window_start,
             sess._pw_window_end,
         )
-        if has_instance:
-            out_exprs["_pw_instance"] = prep._pw_instance
+        out_exprs["_pw_instance"] = (
+            prep._pw_instance if has_instance else None
+        )
         return prep.select(**out_exprs), has_instance
 
     def _apply(self, table, key, behavior, instance):
@@ -246,7 +357,11 @@ class _SessionWindow(Window):
         flat = apply_behavior(
             flat, "_pw_key", "_pw_window_start", "_pw_window_end", behavior
         )
-        return _windowed_grouped(flat, instance=has_instance)
+        return _windowed_grouped(
+            flat,
+            instance=has_instance,
+            extra_group=_instance_col_name(instance, flat),
+        )
 
     def _join(self, left, right, left_time, right_time, on, mode, behavior):
         from pathway_tpu.stdlib.temporal._window_join import (
@@ -405,15 +520,43 @@ class _IntervalsOverGrouped(GroupedTable):
                     return make_tuple(None, loc)
             return None
 
+        def reducer_null_fill(e: Any):
+            """An empty outer window behaves like an outer join's null row:
+            COLLECTION reducers materialize that row ((None,) — reference:
+            intervals_over is_outer with sorted_tuple), scalar aggregates
+            stay None."""
+            desc = e._reducer
+            if desc.kind not in ("tuple", "sorted_tuple", "ndarray"):
+                return None
+            from pathway_tpu.engine.reducers import ReducerSpec
+
+            try:
+                spec = ReducerSpec(
+                    kind=desc.kind,
+                    arg_cols=(0,) * max(1, len(e._args)),
+                    skip_nones=desc.skip_nones,
+                    fn=desc.fn,
+                    extra=desc.extra,
+                )
+                acc = spec.make()
+                acc.update((None,) * max(1, len(e._args)), 1, 0, 0)
+                return acc.value()
+            except Exception:
+                return None
+
         names = list(reduced.column_names())
         empty_exprs = {}
         for n in names:
             src = out_exprs.get(n)
-            # grouping-derived outputs get their probe-side value; anything
-            # touching data columns or reducers becomes None
-            empty_exprs[n] = (
-                probe_side_expr(n, src) if src is not None else None
-            )
+            # grouping-derived outputs get their probe-side value; reducers
+            # aggregate over the outer join's null row; anything else
+            # touching data columns becomes None
+            if isinstance(src, ReducerExpression):
+                empty_exprs[n] = reducer_null_fill(src)
+            else:
+                empty_exprs[n] = (
+                    probe_side_expr(n, src) if src is not None else None
+                )
         # probes that currently have no matching rows = probes minus the
         # locations present in `reduced`
         reduced_locs = None
@@ -468,4 +611,5 @@ def windowby(
         instance = shard
     key = self._desugar(time_expr)
     inst = self._desugar(instance) if instance is not None else None
+    _validate_window_types(self, key, window)
     return window._apply(self, key, behavior, inst)

@@ -74,3 +74,76 @@ __all__ = [
     "check_joint_types",
     "zero_length_interval",
 ]
+
+
+# --- build-time dtype validation (reference: utils.check_joint_types over
+# eval_type; error format "Arguments (...) have to be of types ... but are
+# of types ...", tests/temporal/test_windows.py test_incorrect_args) ------
+
+_TIME_POSSIBLE = ("int", "float", "naive", "utc")
+_INTERVAL_POSSIBLE = ("int", "float", "duration", "duration")
+_KIND_REPR = {
+    "int": "INT",
+    "float": "FLOAT",
+    "naive": "DATE_TIME_NAIVE",
+    "utc": "DATE_TIME_UTC",
+    "duration": "DURATION",
+}
+
+
+def dtype_kind(dtype: Any) -> str | None:
+    """Map an engine dtype to a time-kind string, or None when unknown
+    (ANY columns skip validation — markdown fixtures stay permissive)."""
+    from pathway_tpu.internals import dtype as dt
+
+    strip = getattr(dtype, "strip_optional", None)
+    if strip is not None:  # Optional_[x] validates as its inner type
+        dtype = strip()
+    mapping = {
+        dt.INT: "int",
+        dt.FLOAT: "float",
+        dt.DATE_TIME_NAIVE: "naive",
+        dt.DATE_TIME_UTC: "utc",
+        dt.DURATION: "duration",
+    }
+    if dtype in mapping:
+        return mapping[dtype]
+    if dtype == dt.ANY:
+        return None
+    return str(dtype)  # e.g. 'str' — always fails, named in the message
+
+
+def check_joint_kinds(params: dict[str, tuple[str | None, str]]) -> None:
+    """params: name -> (kind, role) with role in {'time', 'interval'}.
+    Kinds of None (unknown/ANY) are skipped. All remaining args must fit
+    one column of the (time, interval) compatibility table, with int
+    acceptable where float is expected."""
+    live = {n: v for n, v in params.items() if v[0] is not None}
+    if not live:
+        return
+
+    def fits(kind: str, expected: str) -> bool:
+        return kind == expected or (kind == "int" and expected == "float")
+
+    def expected_of(role: str, i: int) -> str:
+        return (_TIME_POSSIBLE if role == "time" else _INTERVAL_POSSIBLE)[i]
+
+    for i in range(len(_TIME_POSSIBLE)):
+        if all(fits(k, expected_of(role, i)) for k, role in live.values()):
+            return
+    expected_str = " or ".join(
+        repr(tuple(_KIND_REPR[expected_of(role, i)] for _k, role in live.values()))
+        for i in range(len(_TIME_POSSIBLE))
+    )
+    actual = repr(
+        tuple(_KIND_REPR.get(k, str(k).upper()) for k, _ in live.values())
+    )
+    raise TypeError(
+        f"Arguments ({', '.join(live)}) have to be of types "
+        f"{expected_str} but are of types {actual}."
+    )
+
+
+def value_kind(value: Any) -> str | None:
+    """_kind for runtime window parameters, None for None."""
+    return None if value is None else _kind(value)

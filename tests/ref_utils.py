@@ -165,6 +165,9 @@ class DiffEntry:
             return api.ref_scalar(*values)
         return api.ref_scalar_with_instance(*values, instance=instance)
 
+    def final_cleanup_entry(self):
+        return DiffEntry(self.key, self.order + 1, False, self.row)
+
     def _sort_key(self):
         return (int(self.key), self.order, self.insertion)
 
@@ -207,6 +210,34 @@ class _CheckKeyConsistentCallback:
 
     def on_end(self):
         assert not self.state, f"Non empty final state = {dict(self.state)!r}"
+
+
+class _CheckStreamEntriesEqualityCallback(_CheckKeyConsistentCallback):
+    """Strict variant: the observed per-key update sequence must EQUAL the
+    expected sequence (reference: CheckStreamEntriesEqualityCallback)."""
+
+    def __call__(self, key, row, time, is_addition):
+        q = self.state.get(int(key))
+        assert q, (
+            f"Got unexpected entry key={key} row={row} "
+            f"is_addition={is_addition}, expected={dict(self.state)!r}"
+        )
+        entry = q.popleft()
+        assert (is_addition, row) == (entry.insertion, entry.row), (
+            f"Got unexpected entry key={key} row={row} "
+            f"is_addition={is_addition}, expected={entry!r}"
+        )
+        if not q:
+            self.state.pop(int(key))
+
+
+def assert_stream_equal(expected, table) -> None:
+    cb = _CheckStreamEntriesEqualityCallback(expected)
+
+    def on_change(key, row, time, is_addition):
+        cb(key, row, time, is_addition)
+
+    pw.io.subscribe(table, on_change, cb.on_end)
 
 
 def assert_key_entries_in_stream_consistent(expected, table) -> None:

@@ -163,3 +163,34 @@ def test_ivf_snapshot_roundtrip():
     restored.load_state(pickle.loads(pickle.dumps(state)))
     q = rng.normal(size=4).astype(np.float32)
     assert index.search([(q, 5, None)]) == restored.search([(q, 5, None)])
+
+
+def test_ivf_device_index_recall_and_speed():
+    """IvfDeviceIndex (cluster-sorted device corpus, spilled assignment,
+    bucketed fine scoring) reaches >=0.95 recall@10 on mixture data — the
+    shape real embedding corpora have (reference ANN tier: usearch HNSW,
+    src/external_integration/usearch_integration.rs:20)."""
+    import numpy as np
+
+    from pathway_tpu.ops.ivf import IvfDeviceIndex
+
+    rng = np.random.default_rng(0)
+    n, dim, k = 20_000, 64, 10
+    centers = rng.normal(size=(200, dim)).astype(np.float32)
+    asn = rng.integers(0, 200, size=n)
+    corpus = (centers[asn] + 0.35 * rng.normal(size=(n, dim))).astype(
+        np.float32
+    )
+    ix = IvfDeviceIndex(corpus, n_probe=16, spill=2)
+    cn = corpus / np.linalg.norm(corpus, axis=1, keepdims=True)
+    qs = corpus[rng.choice(n, 10)] + 0.1 * rng.normal(
+        size=(10, dim)
+    ).astype(np.float32)
+    hits = 0
+    for q in qs:
+        _s, ids = ix.query(q, k)
+        assert len(set(ids.tolist())) == k  # spilled replicas deduped
+        qn = q / np.linalg.norm(q)
+        exact = np.argpartition(-(cn @ qn), k - 1)[:k]
+        hits += len(set(ids.tolist()) & set(exact.tolist()))
+    assert hits / (10 * k) >= 0.95
