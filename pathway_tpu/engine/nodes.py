@@ -1964,6 +1964,13 @@ class ForgetNode(Node):
         super().__init__([input], input.column_names)
         self.threshold_col = threshold_col
         self.current_time_col = current_time_col
+        if mark_forgetting_records:
+            raise NotImplementedError(
+                "mark_forgetting_records=True (tagging retractions caused "
+                "by forgetting with an extra flag column, reference: "
+                "TimeColumnForget) is not implemented yet"
+            )
+        self.mark_forgetting_records = mark_forgetting_records
 
     def _make_local_exec(self):
         return ForgetExec(self)
@@ -1995,6 +2002,9 @@ class ForgetExec(NodeExec):
         # (reference: TimeColumnForget reacts to input batches,
         # time_column.rs:426; batch mode forgets nothing).
         has_rows = any(len(b) for b in inputs[0])
+        # _scanned_at is refreshed at the END of process, so this only
+        # fires when max_seen moved OUTSIDE process() — the DCN watermark
+        # wrapper advancing it from a peer's data
         externally_advanced = (
             self.max_seen is not None and self.max_seen != self._scanned_at
         )
@@ -2011,7 +2021,6 @@ class ForgetExec(NodeExec):
             for k in stale:
                 thr, vals = self.live.pop(k)
                 out_rows.append((k, -1, vals))
-        self._scanned_at = self.max_seen
         batch_max = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
@@ -2027,6 +2036,7 @@ class ForgetExec(NodeExec):
             self.max_seen is None or batch_max > self.max_seen
         ):
             self.max_seen = batch_max
+        self._scanned_at = self.max_seen
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]

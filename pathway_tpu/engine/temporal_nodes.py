@@ -325,7 +325,13 @@ class AsofJoinNode(Node):
         direction: str,
         mode: str,
     ):
-        super().__init__([left, right], _join_out_cols(left, right))
+        # _pw_self_t / _pw_side = the perspective row's OWN time and side
+        # (the reference's synthetic `t` and `side` output columns;
+        # side=False for left-perspective rows, True for right)
+        super().__init__(
+            [left, right],
+            _join_out_cols(left, right) + ["_pw_self_t", "_pw_side"],
+        )
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.left_time = left_time
@@ -337,32 +343,59 @@ class AsofJoinNode(Node):
         return AsofJoinExec(self)
 
 
+def _asof_inclusive(direction: str, mode: str, probe_side: str) -> bool:
+    """Whether an other-side row at the SAME time as the probe matches.
+
+    The reference decides ties by its merged sort order (t, side ^
+    right_first, id) with right_first = (BACKWARD and LEFT) or (FORWARD
+    and RIGHT) — a same-time other-side row only matches when that order
+    puts it on the probed side of the row (_asof_join.py:258-292)."""
+    right_first = (direction == "backward" and mode == "left") or (
+        direction == "forward" and mode == "right"
+    )
+    other_before = right_first if probe_side == "l" else not right_first
+    if direction == "backward":
+        return other_before
+    if direction == "forward":
+        return not other_before
+    return True  # nearest: distance ties resolved in _asof_pick
+
+
 def _asof_pick(
     rows: list[tuple[Any, int, tuple]],
     times: list[Any],
     t: Any,
     direction: str,
+    inclusive: bool = True,
 ):
     """Best match among `rows` (sorted by time) for a probe at time t."""
     if not rows:
         return None
     if direction == "backward":
-        i = bisect.bisect_right(times, t) - 1
+        i = (
+            bisect.bisect_right(times, t) - 1
+            if inclusive
+            else bisect.bisect_left(times, t) - 1
+        )
         return rows[i] if i >= 0 else None
     if direction == "forward":
-        i = bisect.bisect_left(times, t)
+        i = (
+            bisect.bisect_left(times, t)
+            if inclusive
+            else bisect.bisect_right(times, t)
+        )
         return rows[i] if i < len(rows) else None
-    # nearest
-    i = bisect.bisect_right(times, t) - 1
+    # nearest — a distance tie picks the later row (reference:
+    # select_nearest uses prev only when strictly closer)
+    i = bisect.bisect_left(times, t) - 1
     j = bisect.bisect_left(times, t)
-    cand = []
-    if i >= 0:
-        cand.append(rows[i])
-    if j < len(rows):
-        cand.append(rows[j])
-    if not cand:
-        return None
-    return min(cand, key=lambda r: (abs(r[0] - t), r[0], r[1]))
+    prev_r = rows[i] if i >= 0 else None
+    next_r = rows[j] if j < len(rows) else None
+    if prev_r is None:
+        return next_r
+    if next_r is None:
+        return prev_r
+    return prev_r if (t - prev_r[0]) < (next_r[0] - t) else next_r
 
 
 class AsofJoinExec(_TemporalJoinExecBase):
@@ -373,42 +406,44 @@ class AsofJoinExec(_TemporalJoinExecBase):
         rrows = self.right.sorted_rows(jk)
         l_times = [r[0] for r in lrows]
         r_times = [r[0] for r in rrows]
-        matched_right: set[int] = set()
-        inv = {"backward": "forward", "forward": "backward"}.get(
-            node.direction, "nearest"
-        )
         # output keys mix the side into the hash — a left row and a right row
         # can share a raw row id (e.g. two fixture tables), so plain lk/rk
         # keys would collide and silently drop rows
         if node.mode in ("left", "outer"):
             for lt, lk, lvals in lrows:
                 okey = int(ref_scalar(Pointer(lk), 0))
-                m = _asof_pick(rrows, r_times, lt, node.direction)
+                m = _asof_pick(
+                    rrows, r_times, lt, node.direction,
+                    _asof_inclusive(node.direction, node.mode, "l"),
+                )
                 if m is not None:
                     _rt, rk, rvals = m
-                    matched_right.add(rk)
-                    out[okey] = lvals + rvals + (Pointer(lk), Pointer(rk))
-                else:
-                    out[okey] = (
-                        lvals + (None,) * self.n_r + (Pointer(lk), None)
+                    out[okey] = lvals + rvals + (
+                        Pointer(lk), Pointer(rk), lt, False,
                     )
-        if node.mode == "right":
+                else:
+                    out[okey] = lvals + (None,) * self.n_r + (
+                        Pointer(lk), None, lt, False,
+                    )
+        if node.mode in ("right", "outer"):
+            # the direction stays the SAME from the right row's perspective
+            # (backward = latest left at-or-before the right row's time) —
+            # outer emits every right-perspective row, matched or not
+            # (reference: _asof_join merges the m0 and m1 perspectives)
             for rt, rk, rvals in rrows:
                 okey = int(ref_scalar(Pointer(rk), 1))
-                m = _asof_pick(lrows, l_times, rt, inv)
+                m = _asof_pick(
+                    lrows, l_times, rt, node.direction,
+                    _asof_inclusive(node.direction, node.mode, "r"),
+                )
                 if m is not None:
                     _lt, lk, lvals = m
-                    out[okey] = lvals + rvals + (Pointer(lk), Pointer(rk))
-                else:
-                    out[okey] = (
-                        (None,) * self.n_l + rvals + (None, Pointer(rk))
+                    out[okey] = lvals + rvals + (
+                        Pointer(lk), Pointer(rk), rt, True,
                     )
-        elif node.mode == "outer":
-            for rt, rk, rvals in rrows:
-                if rk not in matched_right:
-                    okey = int(ref_scalar(Pointer(rk), 1))
-                    out[okey] = (
-                        (None,) * self.n_l + rvals + (None, Pointer(rk))
+                else:
+                    out[okey] = (None,) * self.n_l + rvals + (
+                        None, Pointer(rk), rt, True,
                     )
         return out
 
@@ -428,11 +463,13 @@ class AsofNowJoinNode(Node):
         left_on: Sequence[str],
         right_on: Sequence[str],
         mode: str,
+        id_from: str | None = None,
     ):
         super().__init__([left, right], _join_out_cols(left, right))
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.mode = mode
+        self.id_from = id_from  # "left": output rows keyed by query row id
 
     def make_exec(self):
         return AsofNowJoinExec(self)
@@ -479,9 +516,21 @@ class AsofNowJoinExec(NodeExec):
                 jk = int(ref_scalar(*(lvals[i] for i in self.l_on_idx)))
                 rrows = self.right.get(jk, {})
                 emitted: list[tuple[int, tuple]] = []
+                use_lk = self.node.id_from == "left"
+                if use_lk and len(rrows) > 1:
+                    # id=left.id promises ONE output row per query row; two
+                    # matches would silently collapse under the same key
+                    # (reference: the engine errors on duplicate ids)
+                    raise ValueError(
+                        "asof_now_join with id=pw.left.id: query row "
+                        f"matched {len(rrows)} rows; the id contract "
+                        "requires at most one match per query"
+                    )
                 if rrows:
                     for rk, (rvals, _c) in rrows.items():
-                        okey = int(ref_scalar(Pointer(lk), Pointer(rk)))
+                        okey = lk if use_lk else int(
+                            ref_scalar(Pointer(lk), Pointer(rk))
+                        )
                         vals = lvals + rvals + (Pointer(lk), Pointer(rk))
                         emitted.append((okey, vals))
                 elif self.node.mode == "left":

@@ -123,6 +123,13 @@ class GroupedTable:
     def reduce(self, *args: Any, **kwargs: Any) -> Any:
         from pathway_tpu.internals.table import Table, infer_dtype
 
+        for e in kwargs.values():
+            if isinstance(e, ThisPlaceholder):
+                raise TypeError(
+                    "`**pw.this` expansion is not supported in reduce(); "
+                    "name the reduced columns explicitly"
+                )
+
         table = self._table
         out_exprs: dict[str, ColumnExpression] = {}
         for arg in args:

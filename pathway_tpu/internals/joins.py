@@ -47,8 +47,8 @@ class JoinResult:
     ):
         if left is right:
             raise ValueError(
-                "joining a table with itself; use <table>.copy() for "
-                "self-joins (reference: join_self)"
+                "Cannot join table with itself. Use <table>.copy() as one "
+                "of the arguments of the join."
             )
         self._left = left
         self._right = right
@@ -192,10 +192,21 @@ class JoinResult:
             else:
                 raise TypeError(arg)
         for name, e in kwargs.items():
+            if isinstance(e, ThisPlaceholder):  # `**pw.left` expansion
+                if e is left_ph or e is this_ph:
+                    add_side(self._left, "l.")
+                if e is right_ph or e is this_ph:
+                    add_side(self._right, "r.")
+                continue
             exprs[name] = wrap_expr(e)
 
         resolved = {n: wrap_expr(e)._substitute(sub) for n, e in exprs.items()}
         return joined.select(**resolved)
+
+    def _result_universe(self) -> Universe:
+        """Universe of the joined table; subclasses override when the
+        output keys provably come from one side (id=left.id)."""
+        return Universe()
 
     def _maybe_opt(self, d: dt.DType, side: str) -> dt.DType:
         m = self._mode
@@ -231,8 +242,16 @@ class JoinResult:
                 },
                 "_left_id": dt.Optional_(dt.POINTER),
                 "_right_id": dt.Optional_(dt.POINTER),
+                # nodes may append synthetic result columns past the ids
+                # (e.g. the asof join's _pw_self_t) — typed ANY
+                **{
+                    n: dt.ANY
+                    for n in node.column_names
+                    if not n.startswith(("l.", "r."))
+                    and n not in ("_left_id", "_right_id")
+                },
             },
-            Universe(),
+            self._result_universe(),
         )
         self._joined_cache = joined
         return joined, self._make_sub(joined)

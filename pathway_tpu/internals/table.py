@@ -583,10 +583,20 @@ class Table(Joinable):
             else:
                 raise TypeError(f"positional select argument {arg!r}")
         for name, e in kwargs.items():
+            if isinstance(e, ThisPlaceholder):  # `**pw.this` expansion
+                for n in self.column_names():
+                    exprs[n] = self[n]
+                continue
             exprs[name] = wrap_expr(e)
         return self._build_rowwise(exprs)
 
     def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        kwargs = {
+            # `**pw.this` is the identity here: all columns already kept
+            n: e
+            for n, e in kwargs.items()
+            if not isinstance(e, ThisPlaceholder)
+        }
         exprs: dict[str, Any] = {n: self[n] for n in self.column_names()}
         for arg in args:
             if isinstance(arg, ColumnReference):
@@ -681,6 +691,60 @@ class Table(Joinable):
 
     def copy(self) -> "Table":
         return self.select(*[self[n] for n in self.column_names()])
+
+    # --- time-column operators (reference: Table._buffer/_forget/_freeze,
+    # internals/table.py:666-737; engine: time_column.rs) ---------------------
+
+    def _buffer(self, threshold_column: Any, time_column: Any) -> "Table":
+        """Postpone rows until `time_column`'s watermark passes their
+        `threshold_column`."""
+        from pathway_tpu.engine.nodes import BufferNode
+        from pathway_tpu.stdlib.temporal.temporal_behavior import (
+            _temporal_table,
+        )
+
+        return _temporal_table(
+            self,
+            BufferNode,
+            self._desugar(threshold_column),
+            self._desugar(time_column),
+        )
+
+    def _forget(
+        self,
+        threshold_column: Any,
+        time_column: Any,
+        mark_forgetting_records: bool = False,
+    ) -> "Table":
+        """Retract rows once `time_column`'s watermark passes their
+        `threshold_column` — bounds state for cutoff behaviors."""
+        from pathway_tpu.engine.nodes import ForgetNode
+        from pathway_tpu.stdlib.temporal.temporal_behavior import (
+            _temporal_table,
+        )
+
+        return _temporal_table(
+            self,
+            ForgetNode,
+            self._desugar(threshold_column),
+            self._desugar(time_column),
+            mark_forgetting_records=mark_forgetting_records,
+        )
+
+    def _freeze(self, threshold_column: Any, time_column: Any) -> "Table":
+        """Drop rows arriving after `time_column`'s watermark passed their
+        `threshold_column` (late data)."""
+        from pathway_tpu.engine.nodes import FreezeNode
+        from pathway_tpu.stdlib.temporal.temporal_behavior import (
+            _temporal_table,
+        )
+
+        return _temporal_table(
+            self,
+            FreezeNode,
+            self._desugar(threshold_column),
+            self._desugar(time_column),
+        )
 
     # --- ids ------------------------------------------------------------------
 

@@ -10,6 +10,9 @@ from typing import Any
 from pathway_tpu.internals.expression import ColumnReference, PointerExpression
 
 
+_KEY_GUARD_COUNTER = 0
+
+
 class ThisPlaceholder:
     def __init__(self, kind: str):
         self._kind = kind
@@ -27,6 +30,8 @@ class ThisPlaceholder:
 
     def __getitem__(self, name) -> Any:
         if isinstance(name, str):
+            if name.startswith("_pw_this_expand_"):
+                return self  # `**pw.left` guard key (see keys())
             return ColumnReference(self, name)
         if isinstance(name, (list, tuple)):
             return ThisSlice(self, [c if isinstance(c, str) else c.name for c in name])
@@ -63,6 +68,15 @@ class ThisPlaceholder:
         # ThisPlaceholder handler does the expansion; iteration just hands
         # the placeholder through)
         return iter([self])
+
+    def keys(self):
+        # `**pw.left` support: the mapping protocol hands select() a
+        # single guarded kwarg whose VALUE is this placeholder; select
+        # handlers detect it and expand to all columns (reference:
+        # thisclass KEY_GUARD keys)
+        global _KEY_GUARD_COUNTER  # unique per expansion: collisions would
+        _KEY_GUARD_COUNTER += 1  # silently drop one side's columns
+        return [f"_pw_this_expand_{_KEY_GUARD_COUNTER}"]
 
 
 class ThisSlice:

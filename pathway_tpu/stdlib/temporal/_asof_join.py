@@ -15,6 +15,7 @@ from pathway_tpu.internals.expression import (
 from pathway_tpu.internals.joins import JoinMode, JoinResult
 from pathway_tpu.internals.table import desugar
 from pathway_tpu.internals.thisclass import (
+    ThisPlaceholder,
     left as left_ph,
     right as right_ph,
     this as this_ph,
@@ -95,10 +96,44 @@ class AsofJoinResult(JoinResult):
     def _make_sub(self, joined):
         base = super()._make_sub(joined)
         defaults = self._defaults
+        n_on = len(self._left_on)
 
         def sub(ref: ColumnReference):
-            out = base(ref)
             tbl = ref.table
+            # synthetic result columns (reference: the asof merge result
+            # exposes `t` — the perspective row's own time — and
+            # `instance` — the equated join-key value — via pw.this,
+            # SHADOWING same-named source columns). pw.left / pw.right are
+            # ThisPlaceholders too: only bare pw.this gets the synthetics.
+            if (
+                isinstance(tbl, ThisPlaceholder)
+                and tbl is not left_ph
+                and tbl is not right_ph
+            ):
+                if ref.name == "t":
+                    return ColumnReference(joined, "_pw_self_t")
+                if ref.name == "side":
+                    return ColumnReference(joined, "_pw_side")
+                if ref.name == "instance":
+                    conds = [
+                        CoalesceExpression(
+                            ColumnReference(joined, f"l._on{i}"),
+                            ColumnReference(joined, f"r._on{i}"),
+                        )
+                        for i in range(n_on)
+                    ]
+                    if not conds:
+                        from pathway_tpu.internals.expression import (
+                            ColumnConstExpression,
+                        )
+
+                        return ColumnConstExpression(None)
+                    if len(conds) == 1:
+                        return conds[0]
+                    from pathway_tpu.internals.common import make_tuple
+
+                    return make_tuple(*conds)
+            out = base(ref)
             if tbl is left_ph:
                 tbl = self._left
             elif tbl is right_ph:
@@ -121,44 +156,84 @@ def asof_join(
     defaults: dict[ColumnReference, Any] | None = None,
     direction: Direction = Direction.BACKWARD,
     behavior: Behavior | None = None,
+    left_instance: ColumnReference | None = None,
+    right_instance: ColumnReference | None = None,
 ) -> AsofJoinResult:
     """For every row, find the single best matching row of the other side by
-    time (per `direction`), within groups given by `on` equalities."""
+    time (per `direction`), within groups given by `on` equalities (and the
+    optional left_instance == right_instance pair)."""
     if how not in (JoinMode.LEFT, JoinMode.RIGHT, JoinMode.OUTER):
         raise ValueError(
             "asof_join supports only LEFT, RIGHT and OUTER modes"
         )
+    if (left_instance is None) != (right_instance is None):
+        raise ValueError(
+            "asof_join requires both left_instance and right_instance, "
+            "or neither"
+        )
+    if left_instance is not None:
+        on = (*on, left_instance == right_instance)
+    _validate_asof_join_types(self, other, self_time, other_time, on)
     return AsofJoinResult(
         self, other, self_time, other_time, on, how, defaults or {},
         direction, behavior,
     )
 
 
+def _validate_asof_join_types(left, right, self_time, other_time, on) -> None:
+    """Build-time validation (reference: asof_join check_joint_types over
+    eval_type — message names t_left / t_right)."""
+    from pathway_tpu.stdlib.temporal.utils import (
+        check_joint_kinds,
+        expr_kind,
+        validate_join_condition_types,
+    )
+
+    def kind_of(table, expr):
+        e = desugar(expr, {left_ph: left, right_ph: right, this_ph: table})
+        return expr_kind(table, e)
+
+    check_joint_kinds(
+        {
+            "t_left": (kind_of(left, self_time), "time"),
+            "t_right": (kind_of(right, other_time), "time"),
+        }
+    )
+    tmp = JoinResult(left, right, on, JoinMode.INNER)
+    validate_join_condition_types(left, right, tmp._left_on, tmp._right_on)
+
+
 def asof_join_left(
     self, other, self_time, other_time, *on,
     defaults=None, direction=Direction.BACKWARD, behavior=None,
+    left_instance=None, right_instance=None,
 ):
     return asof_join(
         self, other, self_time, other_time, *on, how=JoinMode.LEFT,
         defaults=defaults, direction=direction, behavior=behavior,
+        left_instance=left_instance, right_instance=right_instance,
     )
 
 
 def asof_join_right(
     self, other, self_time, other_time, *on,
     defaults=None, direction=Direction.BACKWARD, behavior=None,
+    left_instance=None, right_instance=None,
 ):
     return asof_join(
         self, other, self_time, other_time, *on, how=JoinMode.RIGHT,
         defaults=defaults, direction=direction, behavior=behavior,
+        left_instance=left_instance, right_instance=right_instance,
     )
 
 
 def asof_join_outer(
     self, other, self_time, other_time, *on,
     defaults=None, direction=Direction.BACKWARD, behavior=None,
+    left_instance=None, right_instance=None,
 ):
     return asof_join(
         self, other, self_time, other_time, *on, how=JoinMode.OUTER,
         defaults=defaults, direction=direction, behavior=behavior,
+        left_instance=left_instance, right_instance=right_instance,
     )

@@ -10,6 +10,29 @@ from pathway_tpu.internals.joins import JoinMode, JoinResult
 
 
 class AsofNowJoinResult(JoinResult):
+    def _uses_left_id(self) -> bool:
+        from pathway_tpu.internals.expression import ColumnReference
+        from pathway_tpu.internals.thisclass import left as left_ph
+
+        e = self._id_expr
+        return (
+            isinstance(e, ColumnReference)
+            and e.name == "id"
+            and (e.table is self._left or e.table is left_ph)
+        )
+
+    def _result_universe(self):
+        # id=pw.left.id keys each result row by its query row: LEFT mode
+        # covers every query (same universe), INNER a subset (reference:
+        # asof_now_join id= contract)
+        if self._uses_left_id():
+            if self._mode == JoinMode.LEFT:
+                return self._left._universe
+            return self._left._universe.subset()
+        from pathway_tpu.internals.universe import Universe
+
+        return Universe()
+
     def _build(self):
         lnames = [f"_on{i}" for i in range(len(self._left_on))]
         left_cols = {n: self._left[n] for n in self._left.column_names()}
@@ -26,6 +49,7 @@ class AsofNowJoinResult(JoinResult):
             lnames,
             lnames,
             self._mode.value,
+            id_from="left" if self._uses_left_id() else None,
         )
         return node, left_prep, right_prep
 

@@ -28,24 +28,42 @@ class Interval:
 
 
 def interval(lower_bound, upper_bound) -> Interval:
-    """The allowed difference `other_time - self_time` of matching rows."""
-    from pathway_tpu.stdlib.temporal.utils import _kind
+    """The allowed difference `other_time - self_time` of matching rows.
+    Validation happens at the JOIN, not here: mixed bound kinds get the
+    reference's Arguments-have-to-be-of-types message, and lower > upper
+    the ValueError — both only once the join is built."""
+    return Interval(lower_bound, upper_bound)
 
-    kl, ku = _kind(lower_bound), _kind(upper_bound)
-    numeric = {"int", "float"}
-    if not (
-        (kl in numeric and ku in numeric)
-        or (kl == "duration" and ku == "duration")
-    ):
-        raise TypeError(
-            "interval bounds must both be numbers or both be durations, got "
-            f"{type(lower_bound).__name__} and {type(upper_bound).__name__}"
-        )
-    if lower_bound > upper_bound:
+
+def _validate_interval_join_types(
+    left, right, left_time, right_time, interval, left_on, right_on
+) -> None:
+    """Build-time validation (reference: interval_join check_joint_types
+    over eval_type + join-condition typing)."""
+    from pathway_tpu.stdlib.temporal.utils import (
+        check_joint_kinds,
+        expr_kind,
+        validate_join_condition_types,
+        value_kind,
+    )
+
+    check_joint_kinds(
+        {
+            "self_time_expression": (expr_kind(left, left_time), "time"),
+            "other_time_expression": (expr_kind(right, right_time), "time"),
+            "lower_bound": (value_kind(interval.lower_bound), "interval"),
+            "upper_bound": (value_kind(interval.upper_bound), "interval"),
+        }
+    )
+    try:
+        bad = interval.lower_bound > interval.upper_bound
+    except TypeError:  # unreachable: check_joint_kinds already raised
+        bad = False
+    if bad:
         raise ValueError(
             "interval lower_bound has to be less than or equal to upper_bound"
         )
-    return Interval(lower_bound, upper_bound)
+    validate_join_condition_types(left, right, left_on, right_on)
 
 
 class IntervalJoinResult(JoinResult):
@@ -70,6 +88,10 @@ class IntervalJoinResult(JoinResult):
         )
         self._interval = interval
         self._behavior = behavior
+        _validate_interval_join_types(
+            left, right, self._left_time, self._right_time, interval,
+            self._left_on, self._right_on,
+        )
 
     def _build(self):
         lnames = [f"_on{i}" for i in range(len(self._left_on))]

@@ -234,13 +234,11 @@ def _validate_window_types(table, key, window) -> None:
     window's _apply, stdlib/temporal/_window.py)."""
     from pathway_tpu.stdlib.temporal.utils import (
         check_joint_kinds,
-        dtype_kind,
+        expr_kind,
         value_kind,
     )
 
-    kk = dtype_kind(
-        table._build_rowwise({"_pw_key": key})._schema["_pw_key"].dtype
-    )
+    kk = expr_kind(table, key)
     if isinstance(window, _SlidingWindow):
         params = {
             "time_expr": (kk, "time"),

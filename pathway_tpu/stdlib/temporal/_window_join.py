@@ -32,12 +32,30 @@ class WindowJoinResult:
     """Lazy window-join result: select() with pw.left / pw.right / pw.this
     (pw.this._pw_window_start / _pw_window_end give the shared window)."""
 
-    def __init__(self, inner: JoinResult, orig_left, orig_right, lflat, rflat):
+    def __init__(
+        self,
+        inner: JoinResult,
+        orig_left,
+        orig_right,
+        lflat,
+        rflat,
+        on_pairs=(),
+    ):
         self._inner = inner
         self._orig_left = orig_left
         self._orig_right = orig_right
         self._lflat = lflat
         self._rflat = rflat
+        # names equi-joined on both sides: pw.this.<name> is then the
+        # coalesce of the two (reference: join condition columns are
+        # unambiguous on the join result)
+        self._on_names = {
+            l_e.name
+            for l_e, r_e in on_pairs
+            if isinstance(l_e, ColumnReference)
+            and isinstance(r_e, ColumnReference)
+            and l_e.name == r_e.name
+        }
 
     def _pre_sub(self, e):
         lflat, rflat = self._lflat, self._rflat
@@ -60,6 +78,10 @@ class WindowJoinResult:
                 in_l = ref.name in self._orig_left.column_names()
                 in_r = ref.name in self._orig_right.column_names()
                 if in_l and in_r:
+                    if ref.name in self._on_names:
+                        return CoalesceExpression(
+                            lflat[ref.name], rflat[ref.name]
+                        )
                     raise ValueError(
                         f"column {ref.name!r} is ambiguous in window_join; "
                         "use pw.left/pw.right"
@@ -73,14 +95,31 @@ class WindowJoinResult:
 
         return wrap_expr(e)._substitute(sub)
 
+    def _expand_side(self, exprs: dict, table) -> None:
+        for n in table.column_names():
+            if not n.startswith(("_on", "_pw_")):
+                exprs[n] = table[n]
+
     def select(self, *args: Any, **kwargs: Any):
         exprs: dict[str, Any] = {}
         for arg in args:
             if isinstance(arg, ColumnReference):
                 exprs[arg.name] = arg
+            elif isinstance(arg, ThisPlaceholder):  # `*pw.left` expansion
+                if arg is left_ph or arg is this_ph:
+                    self._expand_side(exprs, self._orig_left)
+                if arg is right_ph or arg is this_ph:
+                    self._expand_side(exprs, self._orig_right)
             else:
                 raise TypeError(f"positional select argument {arg!r}")
-        exprs.update(kwargs)
+        for name, e in kwargs.items():
+            if isinstance(e, ThisPlaceholder):  # `**pw.left` expansion
+                if e is left_ph or e is this_ph:
+                    self._expand_side(exprs, self._orig_left)
+                if e is right_ph or e is this_ph:
+                    self._expand_side(exprs, self._orig_right)
+                continue
+            exprs[name] = e
         resolved = {n: self._pre_sub(e) for n, e in exprs.items()}
         return self._inner.select(**resolved)
 
@@ -105,7 +144,10 @@ def _window_join_flattened(left, right, lflat, rflat, on, mode: JoinMode):
             == r_e._substitute(remap(rflat, right))
         )
     inner = JoinResult(lflat, rflat, conds, mode)
-    return WindowJoinResult(inner, left, right, lflat, rflat)
+    return WindowJoinResult(
+        inner, left, right, lflat, rflat,
+        on_pairs=list(zip(tmp._left_on, tmp._right_on)),
+    )
 
 
 def _session_window_join(
@@ -187,7 +229,50 @@ def _session_window_join(
     rflat = flat_for(right, 1)
     conds = [lflat._pw_window == rflat._pw_window]
     inner = JoinResult(lflat, rflat, conds, mode)
-    return WindowJoinResult(inner, left, right, lflat, rflat)
+    return WindowJoinResult(
+        inner, left, right, lflat, rflat,
+        on_pairs=list(zip(tmp._left_on, tmp._right_on)),
+    )
+
+
+def _validate_window_join_types(
+    left, right, left_time, right_time, window, on
+) -> None:
+    """Build-time validation of both time columns against the window's
+    parameters, plus join-condition typing (reference: window joins'
+    check_joint_types over eval_type)."""
+    from pathway_tpu.stdlib.temporal._window import (
+        _SessionWindow,
+        _SlidingWindow,
+    )
+    from pathway_tpu.stdlib.temporal.utils import (
+        check_joint_kinds,
+        expr_kind,
+        validate_join_condition_types,
+        value_kind,
+    )
+
+    def kind_of(table, expr):
+        e = desugar(expr, {left_ph: left, right_ph: right, this_ph: table})
+        return expr_kind(table, e)
+
+    params = {
+        "left_time_expression": (kind_of(left, left_time), "time"),
+        "right_time_expression": (kind_of(right, right_time), "time"),
+    }
+    if isinstance(window, _SlidingWindow):
+        params["window.hop"] = (value_kind(window.hop), "interval")
+        if not getattr(window, "_tumbling", False) and window.duration is not None:
+            params["window.duration"] = (
+                value_kind(window.duration),
+                "interval",
+            )
+        params["window.origin"] = (value_kind(window.origin), "time")
+    elif isinstance(window, _SessionWindow):
+        params["window.max_gap"] = (value_kind(window.max_gap), "interval")
+    check_joint_kinds(params)
+    tmp = JoinResult(left, right, on, JoinMode.INNER)
+    validate_join_condition_types(left, right, tmp._left_on, tmp._right_on)
 
 
 def window_join(
@@ -196,6 +281,7 @@ def window_join(
 ) -> WindowJoinResult:
     """Pair rows of `self` and `other` that share a window over their
     respective time columns (plus `on` equality conditions)."""
+    _validate_window_join_types(self, other, self_time, other_time, window, on)
     return window._join(self, other, self_time, other_time, on, how, behavior)
 
 

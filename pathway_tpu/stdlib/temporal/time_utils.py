@@ -48,78 +48,51 @@ def inactivity_detection(
     instance=None,
 ):
     """Detect periods with no events: returns `(inactivities,
-    resumed_activities)`. A row lands in `inactivities` when no event arrived
-    for `allowed_inactivity_period` (per `instance` if given); a row lands in
-    `resumed_activities` at the first event after each inactivity period."""
+    resumed_activities)` — `inactive_t` marks the last timestamp before an
+    inactivity longer than `allowed_inactivity_period` (per `instance` if
+    given), `resumed_t` the first event after it (reference:
+    stdlib/temporal/time_utils.py inactivity_detection)."""
     import pathway_tpu as pw
 
-    events = event_time_column.table
-    now = utc_now(refresh_rate=refresh_rate)
+    events_t = event_time_column.table.select(
+        t=event_time_column, instance=instance
+    )
 
-    has_instance = instance is not None
-    if has_instance:
-        last_event = events.groupby(instance).reduce(
-            instance=instance, latest=pw.reducers.max(event_time_column)
-        )
-    else:
-        last_event = events.reduce(
-            latest=pw.reducers.max(event_time_column)
-        )
-    latest_now = now.reduce(now=pw.reducers.max(now.timestamp_utc))
-
-    le = last_event.with_columns(_c=0)
-    ln = latest_now.with_columns(_c=0)
-    sel = {"latest": pw.left.latest, "now": pw.right.now}
-    if has_instance:
-        sel["instance"] = pw.left.instance
-    combined = le.join(ln, pw.left._c == pw.right._c).select(**sel)
-    inactive_sel = {"inactive_since": pw.this.latest}
-    if has_instance:
-        inactive_sel["instance"] = pw.this.instance
-    inactivities = (
-        combined.filter(
-            pw.apply_with_type(
-                lambda latest, now: (
-                    latest is not None
-                    and now is not None
-                    and (now - latest) > allowed_inactivity_period
-                ),
-                bool,
-                combined.latest,
-                combined.now,
+    now_t = utc_now(refresh_rate=refresh_rate)
+    latest_t = (
+        events_t.groupby(pw.this.instance)
+        .reduce(pw.this.instance, latest_t=pw.reducers.max(pw.this.t))
+        .filter(
+            pw.this.latest_t
+            > DateTimeUtc.from_datetime(
+                datetime.datetime.now(datetime.timezone.utc)
             )
+        )  # filter to avoid alerts during backfilling
+    )
+    inactivities = (
+        now_t.asof_now_join(latest_t)
+        .select(pw.left.timestamp_utc, pw.right.instance, pw.right.latest_t)
+        .filter(
+            pw.this.latest_t + allowed_inactivity_period
+            < pw.this.timestamp_utc
         )
-        .select(**inactive_sel)
-        .deduplicate(
-            value=pw.this.inactive_since,
-            instance=pw.this.instance if has_instance else None,
-        )
+        .groupby(pw.this.latest_t, pw.this.instance)
+        .reduce(pw.this.latest_t, pw.this.instance)
+        .select(instance=pw.this.instance, inactive_t=pw.this.latest_t)
     )
 
-    ev_sel = {"_pw_t": event_time_column}
-    if has_instance:
-        ev_sel["_pw_inst"] = instance
-    ev = events.select(**ev_sel)
-    join_on = (
-        (ev._pw_inst == inactivities.instance,) if has_instance else ()
+    latest_inactivity = inactivities.groupby(pw.this.instance).reduce(
+        pw.this.instance, inactive_t=pw.reducers.latest(pw.this.inactive_t)
     )
-    res_sel = {"_pw_t": ev._pw_t, "_pw_since": inactivities.inactive_since}
-    if has_instance:
-        res_sel["instance"] = inactivities.instance
-    out_sel = {
-        "resumed_at": pw.this._pw_t,
-        "inactive_since": pw.this._pw_since,
-    }
-    if has_instance:
-        out_sel["instance"] = pw.this.instance
-    resumed = (
-        ev.asof_now_join(inactivities, *join_on)
-        .select(**res_sel)
-        .filter(pw.this._pw_t > pw.this._pw_since)
-        .deduplicate(
-            value=pw.this._pw_since,
-            instance=pw.this.instance if has_instance else None,
+    resumed_activities = (
+        events_t.asof_now_join(
+            latest_inactivity, events_t.instance == latest_inactivity.instance
         )
-        .select(**out_sel)
+        .select(pw.left.t, pw.left.instance, pw.right.inactive_t)
+        .groupby(pw.this.inactive_t, pw.this.instance)
+        .reduce(pw.this.instance, resumed_t=pw.reducers.min(pw.this.t))
     )
-    return inactivities, resumed
+    if instance is None:
+        inactivities = inactivities.without("instance")
+        resumed_activities = resumed_activities.without("instance")
+    return inactivities, resumed_activities

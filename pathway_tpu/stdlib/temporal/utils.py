@@ -131,12 +131,15 @@ def check_joint_kinds(params: dict[str, tuple[str | None, str]]) -> None:
     for i in range(len(_TIME_POSSIBLE)):
         if all(fits(k, expected_of(role, i)) for k, role in live.values()):
             return
+    def fmt(kinds) -> str:  # reference prints bare names, no quotes
+        return "(" + ", ".join(kinds) + ")"
+
     expected_str = " or ".join(
-        repr(tuple(_KIND_REPR[expected_of(role, i)] for _k, role in live.values()))
+        fmt(_KIND_REPR[expected_of(role, i)] for _k, role in live.values())
         for i in range(len(_TIME_POSSIBLE))
     )
-    actual = repr(
-        tuple(_KIND_REPR.get(k, str(k).upper()) for k, _ in live.values())
+    actual = fmt(
+        _KIND_REPR.get(k, str(k).upper()) for k, _ in live.values()
     )
     raise TypeError(
         f"Arguments ({', '.join(live)}) have to be of types "
@@ -147,3 +150,30 @@ def check_joint_kinds(params: dict[str, tuple[str | None, str]]) -> None:
 def value_kind(value: Any) -> str | None:
     """_kind for runtime window parameters, None for None."""
     return None if value is None else _kind(value)
+
+
+def expr_kind(table, expr) -> str | None:
+    """Time-kind of an expression over `table` (dtype probe via a throwaway
+    rowwise build — the liveness pass prunes it)."""
+    prep = table._build_rowwise({"_pw_probe": expr})
+    return dtype_kind(prep._schema["_pw_probe"].dtype)
+
+
+def validate_join_condition_types(left, right, left_on, right_on) -> None:
+    """Equi-join conditions must relate compatible dtypes (reference: the
+    temporal joins' join-condition typing) — shared by interval, window and
+    asof joins."""
+    from pathway_tpu.internals import dtype as dt
+
+    for l_e, r_e in zip(left_on, right_on):
+        ld = left._build_rowwise({"_pw_probe": l_e})._schema["_pw_probe"].dtype
+        rd = (
+            right._build_rowwise({"_pw_probe": r_e})
+            ._schema["_pw_probe"]
+            .dtype
+        )
+        if ld != dt.ANY and rd != dt.ANY and dt.lub(ld, rd) == dt.ANY:
+            raise TypeError(
+                f"Cannot join on columns of incompatible types {ld} "
+                f"and {rd}."
+            )

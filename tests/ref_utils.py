@@ -112,14 +112,26 @@ def _capture_streams(tables, **kwargs):
 
 
 def assert_stream_equality_wo_index(t1, t2, **kwargs) -> None:
-    """Same multiset of (values, time, diff) updates, ignoring keys
-    (reference: tests/utils.py assert_equal_streams_wo_index)."""
+    """Same multiset of (values, time, diff) updates, ignoring keys.
+    Accepts tuples of tables, compared pairwise in ONE run (reference:
+    tests/utils.py assert_equal_streams_wo_index over run_tables)."""
     from collections import Counter
 
-    s1, s2 = _capture_streams([t1, t2], **kwargs)
-    c1 = Counter((tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s1)
-    c2 = Counter((tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s2)
-    assert c1 == c2, f"\nleft:  {sorted(c1.items(), key=str)}\nright: {sorted(c2.items(), key=str)}"
+    ts1 = t1 if isinstance(t1, tuple) else (t1,)
+    ts2 = t2 if isinstance(t2, tuple) else (t2,)
+    assert len(ts1) == len(ts2)
+    streams = _capture_streams([*ts1, *ts2], **kwargs)
+    for s1, s2 in zip(streams[: len(ts1)], streams[len(ts1) :]):
+        c1 = Counter(
+            (tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s1
+        )
+        c2 = Counter(
+            (tuple(_norm(x) for x in v), t, d) for _k, v, t, d in s2
+        )
+        assert c1 == c2, (
+            f"\nleft:  {sorted(c1.items(), key=str)}"
+            f"\nright: {sorted(c2.items(), key=str)}"
+        )
 
 
 def assert_stream_equality(t1, t2, **kwargs) -> None:
