@@ -448,7 +448,9 @@ class _Capture:
         return list(keys_live.keys()), cols
 
 
-def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
+def _run_capture(
+    tables: Sequence[Table], persistence_config: Any = None
+) -> list[_Capture]:
     captures = []
     outputs = []
     for tbl in tables:
@@ -456,6 +458,10 @@ def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
         captures.append(cap)
         outputs.append(OutputNode(tbl._node, cap.on_batch))
     rt = Runtime(outputs)
+    if persistence_config is not None:
+        from pathway_tpu.persistence._runtime_glue import attach_persistence
+
+        attach_persistence(rt, persistence_config)
     from pathway_tpu.internals import parse_graph
 
     parse_graph.G.last_runtime = rt
@@ -463,8 +469,8 @@ def _run_capture(tables: Sequence[Table]) -> list[_Capture]:
     return captures
 
 
-def table_to_dicts(table: Table):
-    cap = _run_capture([table])[0]
+def table_to_dicts(table: Table, persistence_config: Any = None):
+    cap = _run_capture([table], persistence_config=persistence_config)[0]
     col_names = table.column_names()
     keys, cols = cap.column_dicts()
     columns = {n: cols.get(n, {}) for n in col_names}
@@ -808,3 +814,20 @@ def _show(rows: Mapping) -> str:
     return "{" + ", ".join(f"{k}: {v}" for k, v in items[:20]) + (
         ", ..." if len(items) > 20 else ""
     ) + "}"
+
+
+def _compute_tables(*tables: Table):
+    """Run the graph and return the captured contents of `tables`
+    (reference: debug._compute_tables with terminate_on_error=True —
+    an error recorded during execution raises instead of poisoning)."""
+    from pathway_tpu.internals.errors import clear_errors, peek_errors
+
+    clear_errors()
+    captures = _run_capture(list(tables))
+    errors = peek_errors()
+    if errors:
+        first = errors[0]
+        raise ValueError(
+            f"error during computation: {first.get('message', first)!r}"
+        )
+    return captures

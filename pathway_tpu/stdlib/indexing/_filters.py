@@ -14,7 +14,7 @@ from pathway_tpu.internals.json import Json
 
 _TOKEN = re.compile(
     r"""\s*(
-        (?P<str>'[^']*'|`[^`]*`|"[^"]*") |
+        (?P<str>'(?:\\.|[^'\\])*'|`[^`]*`|"(?:\\.|[^"\\])*") |
         (?P<num>-?\d+(\.\d+)?) |
         (?P<op>&&|\|\||==|!=|<=|>=|<|>|!|\(|\)|,) |
         (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
@@ -122,6 +122,9 @@ class _Parser:
         if k == "str":
             self.eat()
             s = v[1:-1]
+            if v[0] in ("'", '"'):
+                # unescape \' and \" produced by the quote normalization
+                s = re.sub(r"\\(.)", r"\1", s)
             if v[0] == "`":
                 # JMESPath backticks delimit JSON literals: `4` is the
                 # number 4, `"x"` the string "x"; bare words fall back to
@@ -194,6 +197,19 @@ def _make_fn(name: str, args: list[Callable]) -> Callable:
             return fnmatch.fnmatch(str(value), str(pattern))
 
         return globmatch
+    if name == "to_number":
+
+        def to_number(md):
+            val = args[0](md)
+            if val is None:
+                return None
+            try:
+                f = float(val)
+                return int(f) if f.is_integer() else f
+            except (TypeError, ValueError):
+                return None
+
+        return to_number
     if name == "starts_with":
         return lambda md: str(args[1](md) or "").startswith(str(args[0](md)))
     raise ValueError(f"unknown filter function {name!r}")

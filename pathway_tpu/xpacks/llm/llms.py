@@ -17,10 +17,68 @@ def prompt_chat_single_qa(question: str) -> tuple:
     return ({"role": "system", "content": question},)
 
 
+_UDF_SETTING_NAMES = (
+    "return_type",
+    "deterministic",
+    "propagate_none",
+    "executor",
+    "cache_strategy",
+    "retry_strategy",
+    "timeout",
+    "max_batch_size",
+)
+
+# the OpenAI chat-completion parameter surface (reference consults
+# litellm.get_supported_openai_params; that lib is absent here, so the
+# public parameter list is tabled)
+_OPENAI_CALL_ARGS = {
+    "temperature", "top_p", "max_tokens", "max_completion_tokens", "n",
+    "stop", "presence_penalty", "frequency_penalty", "logit_bias",
+    "logprobs", "top_logprobs", "seed", "response_format", "stream",
+    "stream_options", "tools", "tool_choice", "user", "parallel_tool_calls",
+}
+
+# provider prefix -> args NOT accepted (litellm-style routing)
+_PROVIDER_UNSUPPORTED = {
+    "cohere": {"stream_options", "response_format", "logit_bias"},
+}
+
+
 class BaseChat(UDF):
     def __init__(self, **kwargs):
-        super().__init__(return_type=str, **kwargs)
-        self._prepare(self._accept)
+        settings = {
+            k: v for k, v in kwargs.items() if k in _UDF_SETTING_NAMES
+        }
+        # remaining kwargs are API parameters, exposed as `.kwargs`
+        # (reference: BaseChat keeps non-None model kwargs)
+        self.kwargs = {
+            k: v
+            for k, v in kwargs.items()
+            if k not in _UDF_SETTING_NAMES and v is not None
+        }
+        super().__init__(return_type=str, **settings)
+        if not hasattr(self, "__wrapped__"):
+            # subclasses may define __wrapped__ directly (the reference's
+            # BaseChat contract, used by test mocks); _accept is the
+            # default body
+            self._prepare(self._accept)
+
+    @property
+    def executor(self):
+        if self._executor is not None:
+            return self._executor
+        from pathway_tpu.internals.udfs import AutoExecutor
+
+        return AutoExecutor()
+
+    @property
+    def cache_strategy(self):
+        return self._cache_strategy
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        """Whether this model accepts `arg_name` as a per-call parameter
+        (reference: BaseChat._accepts_call_arg via litellm)."""
+        return False
 
     def _accept(self, messages, **kwargs) -> str:
         raise NotImplementedError
@@ -30,12 +88,21 @@ class BaseChat(UDF):
 
 
 def _messages_to_prompt(messages: Any) -> str:
+    from pathway_tpu.internals.json import Json
+
+    if isinstance(messages, Json):
+        messages = messages.value
     if isinstance(messages, str):
         return messages
     parts = []
     for m in messages:
+        if isinstance(m, Json):
+            m = m.value
         if isinstance(m, dict):
-            parts.append(str(m.get("content", "")))
+            content = m.get("content", "")
+            if isinstance(content, Json):
+                content = content.value
+            parts.append(str(content))
         else:
             parts.append(str(m))
     return "\n".join(parts)
@@ -57,6 +124,11 @@ class EchoChat(BaseChat):
 class OpenAIChat(BaseChat):
     """(reference: llms.py:97)"""
 
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        if self.model is None:
+            return False
+        return arg_name in _OPENAI_CALL_ARGS
+
     def __init__(self, model: str | None = "gpt-3.5-turbo", **kwargs):
         self.model = model
         self._api_kwargs = {
@@ -64,10 +136,7 @@ class OpenAIChat(BaseChat):
             for k, v in kwargs.items()
             if k in ("api_key", "base_url", "organization")
         }
-        super().__init__(
-            cache_strategy=kwargs.get("cache_strategy"),
-            retry_strategy=kwargs.get("retry_strategy"),
-        )
+        super().__init__(**kwargs)
 
     async def _accept(self, messages, **kwargs) -> str:
         try:
@@ -82,13 +151,25 @@ class OpenAIChat(BaseChat):
             if isinstance(messages, str)
             else list(messages)
         )
+        params = {
+            k: v
+            for k, v in {**self.kwargs, **kwargs}.items()
+            if self._accepts_call_arg(k) and v is not None
+        }
         ret = await client.chat.completions.create(
-            messages=msgs, model=kwargs.get("model", self.model)
+            messages=msgs, model=kwargs.get("model", self.model), **params
         )
         return ret.choices[0].message.content
 
 
 class LiteLLMChat(BaseChat):
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        if self.model is None:
+            return False
+        provider = self.model.split("/", 1)[0] if "/" in self.model else None
+        unsupported = _PROVIDER_UNSUPPORTED.get(provider, set())
+        return arg_name in _OPENAI_CALL_ARGS and arg_name not in unsupported
+
     """(reference: llms.py:320)"""
 
     def __init__(self, model: str | None = None, **kwargs):
@@ -105,8 +186,13 @@ class LiteLLMChat(BaseChat):
             if isinstance(messages, str)
             else list(messages)
         )
+        params = {
+            k: v
+            for k, v in {**self.kwargs, **kwargs}.items()
+            if self._accepts_call_arg(k) and v is not None
+        }
         ret = await litellm.acompletion(
-            model=kwargs.get("model", self.model), messages=msgs
+            model=kwargs.get("model", self.model), messages=msgs, **params
         )
         return ret["choices"][0]["message"]["content"]
 

@@ -110,6 +110,53 @@ def answer_with_geometric_rag_strategy_from_index(
     return answered.answer
 
 
+def _validate_prompt_template(template: str) -> None:
+    """A string prompt template must use exactly the {context} and {query}
+    placeholders (reference: BaseRAGQuestionAnswerer template check)."""
+    import string as _string
+
+    fields = {
+        f
+        for _, f, _, _ in _string.Formatter().parse(template)
+        if f is not None
+    }
+    if fields != {"context", "query"}:
+        raise ValueError(
+            "prompt_template must contain exactly the {context} and "
+            f"{{query}} placeholders, got {sorted(fields)!r}"
+        )
+
+
+def _get_prompt_udf(prompt_template):
+    """Normalize a str/callable/UDF prompt template into a
+    (query, context) -> prompt UDF."""
+    from pathway_tpu.internals.udfs import UDF as _UDF, udf as _udf
+
+    if prompt_template is None:
+        def default_prompt(query: str, context: str) -> str:
+            # the packaged QA prompt over the joined context (keeps
+            # self.prompt_template and the applied prompt in agreement)
+            return prompt_lib.prompt_qa(query, [context])
+
+        return _udf(default_prompt)
+    if isinstance(prompt_template, str):
+        _validate_prompt_template(prompt_template)
+        template = prompt_template
+
+        def fmt(query: str, context: str) -> str:
+            return template.format(context=context, query=query)
+
+        return _udf(fmt)
+    if isinstance(prompt_template, _UDF):
+        return prompt_template
+    if callable(prompt_template):
+        return _udf(prompt_template)
+    raise ValueError(
+        f"prompt_template must be a string, callable or UDF, got "
+        f"{type(prompt_template)!r}"
+    )
+
+
 class BaseQuestionAnswerer:
     AnswerQuerySchema: Any
     RetrieveQuerySchema: Any
@@ -127,14 +174,18 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         indexer: Any,  # VectorStoreServer | DocumentStore
         *,
         default_llm_name: str | None = None,
-        prompt_template: Callable[[str, Sequence[Any]], str] | None = None,
+        prompt_template: str | Callable[[str, str], str] | Any | None = None,
         summarize_template: Callable | None = None,
         search_topk: int = 6,
     ):
         self.llm = llm
         self.indexer = indexer
+        self.default_llm_name = default_llm_name
         self.search_topk = search_topk
         self.prompt_template = prompt_template or prompt_lib.prompt_qa
+        # normalized (query, context)->prompt UDF (reference: prompt_udf;
+        # string templates validate their placeholders at construction)
+        self.prompt_udf = _get_prompt_udf(prompt_template)
         self.summarize_template = summarize_template or prompt_lib.prompt_summarize
         self.server: Any = None
         self._pending_endpoints: list = []
@@ -149,6 +200,7 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
 
         class SummarizeQuerySchema(pw.Schema):
             text_list: Json
+            model: str | None = column_definition(default_value=None, dtype=str)
 
         self.AnswerQuerySchema = AnswerQuerySchema
         self.SummarizeQuerySchema = SummarizeQuerySchema
@@ -170,20 +222,43 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         combined = pw_ai_queries.with_columns(
             docs=retrieved.with_universe_of(pw_ai_queries).result
         )
-        prompt_template = self.prompt_template
+        prompt_udf = self.prompt_udf
         llm = self.llm
 
         def build_prompt(prompt: str, docs: Json) -> str:
+            from pathway_tpu.xpacks.llm._utils import _coerce_sync, _unwrap_udf
+            from pathway_tpu.xpacks.llm.prompts import _doc_text
+
             doc_list = docs.value if isinstance(docs, Json) else list(docs or [])
-            return prompt_template(prompt, doc_list or [])
+            context = "\n\n".join(_doc_text(d) for d in (doc_list or []))
+            fn = _coerce_sync(_unwrap_udf(prompt_udf))
+            try:
+                return str(fn(query=prompt, context=context))
+            except TypeError:
+                # positional / legacy (query, docs) templates
+                return str(fn(prompt, context))
 
         with_prompt = combined.with_columns(
             _full_prompt=apply_with_type(
                 build_prompt, str, this.prompt, this.docs
             )
         )
+        # the chat receives role/content messages plus the query's model
+        # (falling back to default_llm_name) — reference:
+        # llm(prompt_chat_single_qa(...), model=coalesce(model, default))
+        def to_messages(p: str):
+            return ({"role": "system", "content": p},)
+
+        msgs = apply_with_type(to_messages, Json, this._full_prompt)
+        default_name = self.default_llm_name
+        if default_name is not None:
+            from pathway_tpu.internals.common import coalesce as _coalesce
+
+            model_expr = _coalesce(this.model, default_name)
+        else:
+            model_expr = this.model
         answered = with_prompt.with_columns(
-            response=llm(this._full_prompt)
+            response=llm(msgs, model=model_expr)
         )
 
         def fmt(response, docs, return_context_docs) -> Json:
@@ -208,16 +283,32 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         llm = self.llm
 
         def build(text_list: Json) -> str:
+            from pathway_tpu.xpacks.llm._utils import _coerce_sync, _unwrap_udf
+
             tl = text_list.value if isinstance(text_list, Json) else text_list
-            return template(tl or [])
+            return str(_coerce_sync(_unwrap_udf(template))(tl or []))
 
         with_prompt = summarize_queries.with_columns(
             _prompt=apply_with_type(build, str, this.text_list)
         )
-        answered = with_prompt.with_columns(response=llm(this._prompt))
-        return answered.select(
-            result=apply_with_type(lambda r: Json({"response": r}), Json, this.response)
+
+        def to_messages(p: str):
+            return ({"role": "system", "content": p},)
+
+        msgs = apply_with_type(to_messages, Json, this._prompt)
+        default_name = self.default_llm_name
+        if default_name is not None:
+            from pathway_tpu.internals.common import coalesce as _coalesce
+
+            model_expr = _coalesce(this.model, default_name)
+        else:
+            model_expr = this.model
+        answered = with_prompt.with_columns(
+            response=llm(msgs, model=model_expr)
         )
+        # the summarize result is the response STRING (reference:
+        # summarize_query result column)
+        return answered.select(result=this.response)
 
     def retrieve(self, queries: Table) -> Table:
         return self.indexer.retrieve_query(queries)

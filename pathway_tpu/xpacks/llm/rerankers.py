@@ -124,13 +124,22 @@ class LLMReranker(UDF):
         self._prepare(self._score)
 
     def _score(self, doc: Any, query: str, **kwargs) -> float:
+        from pathway_tpu.xpacks.llm._utils import _coerce_sync
+
         prompt = (
             "Rate the relevance of the document to the query on a scale "
             f"1-5. Respond with a number only.\nQuery: {query}\nDoc: {doc}"
         )
-        out = self.llm.func(prompt)
+        out = _coerce_sync(self.llm.func)(prompt)
+        import json as _json
         import re
 
+        try:
+            parsed = _json.loads(str(out))
+            if isinstance(parsed, dict) and "score" in parsed:
+                return float(parsed["score"])
+        except (ValueError, TypeError):
+            pass
         m = re.search(r"\d+(\.\d+)?", str(out))
         if not m:
             raise ValueError(f"LLM reranker returned no number: {out!r}")

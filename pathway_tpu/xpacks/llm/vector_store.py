@@ -60,7 +60,20 @@ class VectorStoreServer:
         try:
             self.embedding_dimension = embedder.get_embedding_dimension()
         except Exception:
-            self.embedding_dimension = None
+            # detect dimensionality with one raw probe call, bypassing the
+            # UDF cache (reference: vector_store.py:87 —
+            # len(_coerce_sync(embedder.__wrapped__)("."))))
+            try:
+                from pathway_tpu.xpacks.llm._utils import (
+                    _coerce_sync,
+                    _unwrap_udf,
+                )
+
+                self.embedding_dimension = len(
+                    _coerce_sync(_unwrap_udf(embedder))(".")
+                )
+            except Exception:
+                self.embedding_dimension = None
         self._index_params = index_params or {}
         self._graph = self._build_graph()
 
@@ -215,6 +228,16 @@ class VectorStoreServer:
         def combine(metadata_filter, globpattern) -> str | None:
             parts = []
             if metadata_filter:
+                if "`" in metadata_filter or '"' in metadata_filter:
+                    # normalize jmespath-style quoting BEFORE parsing, as
+                    # the reference does (document_store.py:345): backtick
+                    # literals become single-quoted, stray double quotes
+                    # are dropped; plain single-quoted filters pass through
+                    metadata_filter = (
+                        metadata_filter.replace("'", r"\'")
+                        .replace("`", "'")
+                        .replace('"', "")
+                    )
                 parts.append(f"({metadata_filter})")
             if globpattern:
                 parts.append(f"globmatch('{globpattern}', path)")

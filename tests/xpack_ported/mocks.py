@@ -1,0 +1,29 @@
+"""Port of the reference xpack LLM test mocks.py (reference:
+python/pathway/xpacks/llm/tests/mocks.py). Mechanical port:
+package and imports adapted, fixtures kept identical."""
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm import llms
+
+
+class IdentityMockChat(llms.BaseChat):
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return False
+
+    async def __wrapped__(self, messages: list[dict] | pw.Json, model: str) -> str:
+        return model + "," + messages[0]["content"].as_str()
+
+
+class FakeChatModel(llms.BaseChat):
+    """Returns `"Text"` literal."""
+
+    async def __wrapped__(self, *args, **kwargs) -> str:
+        return "Text"
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+
+@pw.udf
+def fake_embeddings_model(x: str) -> list[float]:
+    return [1.0, 1.0, 0.0]

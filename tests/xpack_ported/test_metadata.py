@@ -1,0 +1,86 @@
+"""Port of the reference xpack LLM test test_metadata.py (reference:
+python/pathway/xpacks/llm/tests/test_metadata.py). Mechanical port:
+package and imports adapted, fixtures kept identical."""
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from tests.ref_utils import assert_table_equality
+from pathway_tpu.xpacks.llm.utils import combine_metadata
+
+
+@pytest.mark.parametrize(
+    "clean_from_column",
+    [True, False],
+)
+def test_combine_metadata(clean_from_column):
+    data = {"text": [("Text", {"tag": "test"})], "metadata": [{"meta": "data"}]}
+    expected = {
+        "text": ["Text"] if clean_from_column else [("Text", {"tag": "test"})],
+        "metadata": [{"meta": "data", "tag": "test"}],
+    }
+
+    df = pd.DataFrame(data)
+    table = pw.debug.table_from_pandas(df)
+
+    df_expected = pd.DataFrame(expected)
+    table_expected = pw.debug.table_from_pandas(df_expected)
+
+    table = combine_metadata(
+        table,
+        from_column="text",
+        to_column="metadata",
+        clean_from_column=clean_from_column,
+    )
+    assert_table_equality(table, table_expected)
+
+
+@pytest.mark.parametrize(
+    "clean_from_column",
+    [True, False],
+)
+def test_combine_metadata_no_to_column(clean_from_column):
+    data = {"text": [("Text", {"tag": "test"})]}
+    expected = {
+        "text": ["Text"] if clean_from_column else [("Text", {"tag": "test"})],
+        "metadata": [{"tag": "test"}],
+    }
+
+    df = pd.DataFrame(data)
+    table = pw.debug.table_from_pandas(df)
+
+    df_expected = pd.DataFrame(expected)
+    table_expected = pw.debug.table_from_pandas(df_expected)
+
+    table = combine_metadata(
+        table,
+        from_column="text",
+        to_column="metadata",
+        clean_from_column=clean_from_column,
+    )
+    assert_table_equality(table, table_expected)
+
+
+@pytest.mark.parametrize(
+    "clean_from_column",
+    [True, False],
+)
+def test_combine_metadata_no_metadata(clean_from_column):
+
+    data = {"text": ["Text"]}
+    expected = {"text": ["Text"], "metadata": [{}]}
+
+    df = pd.DataFrame(data)
+    table = pw.debug.table_from_pandas(df)
+
+    df_expected = pd.DataFrame(expected)
+    table_expected = pw.debug.table_from_pandas(df_expected)
+
+    table = combine_metadata(
+        table,
+        from_column="text",
+        to_column="metadata",
+        clean_from_column=clean_from_column,
+    )
+    assert_table_equality(table, table_expected)
