@@ -529,6 +529,70 @@ def _bench_groupby(np):
     return float(n_rows / dt)
 
 
+def _bench_wordcount_stream(np):
+    """5M-row ticked wordcount with 2% retractions through the engine —
+    the reference's 5M-line wordcount CI proxy
+    (integration_tests/wordcount/base.py), measured at the same altitude
+    as _bench_join (engine operators + counting sink)."""
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.nodes import GroupByNode, InputNode, OutputNode
+    from pathway_tpu.engine.reducers import ReducerSpec
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+
+    n, n_vocab, tick_rows = 5_000_000, 10_000, 100_000
+    vocab = np.array([f"word{i}" for i in range(n_vocab)])
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, n_vocab, size=n)
+    words = vocab[idx]
+    keys = np.arange(n, dtype=np.uint64)
+    batches = []
+    for lo in range(0, n, tick_rows):
+        hi = min(n, lo + tick_rows)
+        batches.append(
+            DiffBatch(
+                keys=keys[lo:hi],
+                diffs=np.ones(hi - lo, np.int64),
+                columns={"word": words[lo:hi]},
+            )
+        )
+    retr = rng.choice(n // 2, size=n // 50, replace=False).astype(np.uint64)
+    batches.append(
+        DiffBatch(
+            keys=retr,
+            diffs=-np.ones(len(retr), np.int64),
+            columns={"word": words[retr]},
+        )
+    )
+
+    class Src(StaticSource):
+        def events(self):
+            for i, b in enumerate(batches):
+                yield i, b
+
+    inp = InputNode(Src(["word"]), ["word"])
+    gb = GroupByNode(
+        inp, ["word"], {"count": ReducerSpec(kind="count", arg_cols=())}
+    )
+    counts = {"rows": 0}
+
+    def on_batch(t, b):
+        counts["rows"] += len(b)
+
+    out = OutputNode(gb, on_batch)
+    rt = Runtime([out])
+    import gc
+
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        rt.run()
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    assert counts["rows"] > 0
+    return float((n + len(retr)) / dt)
+
+
 def _bench_join(np):
     """Inner-join rows/s through the engine's columnar hash-join path
     (engine/nodes.py JoinExec._try_bulk; reference bar: differential's
@@ -803,6 +867,13 @@ def main() -> None:
         extra["join_rows_per_sec"] = round(_bench_join(np), 1)
     except Exception as e:
         errors.append(f"join:{type(e).__name__}:{e}")
+
+    try:
+        extra["wordcount_rows_per_sec"] = round(
+            _bench_wordcount_stream(np), 1
+        )
+    except Exception as e:
+        errors.append(f"wordcount:{type(e).__name__}:{e}")
 
     try:
         extra["rag_e2e_qps"] = round(_bench_rag_qps(np, on_accel), 1)

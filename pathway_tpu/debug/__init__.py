@@ -20,6 +20,22 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 
 
+# debug fixtures with IDENTICAL key sets share one Universe object, so
+# row-aligned cross-fixture expressions (select(num=t_num.num)) build,
+# while differing key sets stay unrelated and raise (reference: the test
+# utils' universe deduction over trusted fixture ids)
+_FIXTURE_UNIVERSES: dict[frozenset, Universe] = {}
+
+
+def _fixture_universe(keys: "Iterable[int]") -> Universe:
+    key = frozenset(keys)
+    u = _FIXTURE_UNIVERSES.get(key)
+    if u is None:
+        u = Universe()
+        _FIXTURE_UNIVERSES[key] = u
+    return u
+
+
 class _RowsSource(StaticSource):
     # debug fixtures are not persistable connectors: re-read fresh on every
     # run instead of being offset-suppressed/logged (reference: persistence
@@ -222,7 +238,10 @@ def table_from_markdown(
         dtypes = {n: _dtype_for(col_values[n]) for n in col_names}
     source = _RowsSource(col_names, sorted(events.items()))
     node = InputNode(source, col_names)
-    return Table._from_node(node, dtypes, Universe())
+    all_keys = [k for _t, rows in events.items() for (k, _d, _v) in rows]
+    return Table._from_node(
+        node, dtypes, _fixture_universe(all_keys)
+    )
 
 
 # reference test harness name
@@ -253,7 +272,10 @@ def table_from_rows(
         events.setdefault(int(t), []).append((key, int(d), tuple(vals)))
     source = _RowsSource(col_names, sorted(events.items()))
     node = InputNode(source, col_names)
-    return Table._from_node(node, dict(schema.dtypes()), Universe())
+    all_keys = [k for _t, rows in events.items() for (k, _d, _v) in rows]
+    return Table._from_node(
+        node, dict(schema.dtypes()), _fixture_universe(all_keys)
+    )
 
 
 def table_from_pandas(

@@ -416,7 +416,7 @@ class GroupByExec(NodeExec):
                 ts = set(map(type, arr.tolist()))
                 if ts not in self._SAFE_TYPESETS:
                     return None
-            elif arr.dtype.kind not in "biuf" or arr.ndim != 1:
+            elif arr.dtype.kind not in "biufUS" or arr.ndim != 1:
                 return None
             try:
                 codes_j, uniq_j = pd.factorize(arr, use_na_sentinel=False)
@@ -1143,6 +1143,29 @@ class UpdateRowsExec(NodeExec):
 
 # ---------------------------------------------------------------------------
 # Flatten
+
+
+class RemoveRetractionsNode(Node):
+    """Append-only view: deletions are dropped (reference:
+    Table._remove_retractions, internals/table.py)."""
+
+    def __init__(self, input: Node):
+        super().__init__([input], input.column_names)
+
+    def make_exec(self):
+        return RemoveRetractionsExec(self)
+
+
+class RemoveRetractionsExec(NodeExec):
+    def process(self, t, inputs):
+        out = []
+        for b in inputs[0]:
+            m = b.diffs > 0
+            if m.all():
+                out.append(b)
+            elif m.any():
+                out.append(b.mask(m))
+        return out
 
 
 class FlattenNode(Node):

@@ -230,7 +230,12 @@ class UniqueAcc(_MultisetAcc):
     def value(self):
         vals = {k[0] for k in self.items}
         if len(vals) != 1:
-            return ERROR
+            # recorded by the groupby exec -> poisons the cell AND fails a
+            # terminate_on_error run (reference: unique() panics on
+            # non-unique groups)
+            raise ValueError(
+                "More than one distinct value passed to the unique reducer"
+            )
         return next(iter(vals))
 
 

@@ -12,7 +12,20 @@ from pathway_tpu.internals import expression as expr
 def apply(fn: Callable, *args: Any, **kwargs: Any) -> expr.ColumnExpression:
     """Apply a python function per row. Result type from fn annotations if
     available."""
+    import inspect
     import typing
+
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        sig = None  # builtins without introspectable signatures
+    if sig is not None:
+        try:
+            sig.bind(*args, **kwargs)
+        except TypeError as exc:
+            raise AssertionError(
+                f"pw.apply arguments do not match {fn!r}: {exc}"
+            ) from None
 
     ret = Any
     try:

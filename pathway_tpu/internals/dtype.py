@@ -55,12 +55,13 @@ class OptionalDType(DType):
 
     @property
     def typehint(self) -> Any:
-        return Optional[self.wrapped.typehint]
+        return typing.Optional[self.wrapped.typehint]
 
 
 class TupleDType(DType):
     def __init__(self, args: tuple[DType, ...] | None = None):
         self.args = args
+
         name = (
             "Tuple[...]"
             if args is None
@@ -68,11 +69,21 @@ class TupleDType(DType):
         )
         super().__init__(name, np.dtype(object), tuple)
 
+    @property
+    def typehint(self) -> Any:
+        if self.args is None:
+            return tuple
+        return tuple[tuple(a.typehint for a in self.args)]
+
 
 class ListDType(DType):
     def __init__(self, wrapped: DType):
         self.wrapped = wrapped
         super().__init__(f"List[{wrapped.name}]", np.dtype(object), tuple)
+
+    @property
+    def typehint(self) -> Any:
+        return list[self.wrapped.typehint]
 
 
 class ArrayDType(DType):
@@ -90,6 +101,16 @@ class PointerDType(DType):
         self.wrapped = wrapped
         name = "Pointer" if wrapped is None else f"Pointer[{wrapped}]"
         super().__init__(name, np.dtype(np.uint64), None)
+
+
+def Pointer(*args: Any) -> PointerDType:
+    """Parametrized pointer type: dt.Pointer(dt.INT) is the id type of a
+    groupby over one int column (reference: dt.Pointer)."""
+    if not args:
+        return POINTER
+    if len(args) == 1:
+        return PointerDType(args[0])
+    return PointerDType(tuple(args))
 
 
 class CallableDType(DType):
@@ -126,6 +147,10 @@ def Optional_(wrapped: DType) -> DType:
         return wrapped
     return OptionalDType(wrapped)
 
+
+# reference-style alias: tests spell dt.Optional(dt.INT); the trailing
+# underscore avoided shadowing typing.Optional inside this module
+Optional = Optional_  # noqa: F811
 
 _PY_TO_DTYPE: dict[Any, DType] = {
     int: INT,

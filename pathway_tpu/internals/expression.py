@@ -223,9 +223,16 @@ class ColumnExpression:
     def _children(self) -> tuple["ColumnExpression", ...]:
         return ()
 
-    def _dependencies(self) -> Iterable["ColumnReference"]:
+    def _dependencies(self) -> list["ColumnReference"]:
+        out: list[ColumnReference] = []
+        seen: set[tuple[int, str]] = set()
         for child in self._children:
-            yield from child._dependencies()
+            for ref in child._dependencies():
+                key = (id(ref.table), ref.name)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(ref)
+        return out
 
     def _substitute(
         self, mapping: Callable[["ColumnReference"], "ColumnExpression | None"]
@@ -276,7 +283,7 @@ class ColumnReference(ColumnExpression):
         return self._name
 
     def _dependencies(self):
-        yield self
+        return [self]
 
     def _substitute(self, mapping):
         result = mapping(self)

@@ -133,6 +133,12 @@ class GroupedTable:
         table = self._table
         out_exprs: dict[str, ColumnExpression] = {}
         for arg in args:
+            if isinstance(arg, ThisPlaceholder):
+                # reduce(*pw.this): every source column (legal only when
+                # each is a grouping column, checked below like any ref)
+                for n in table.column_names():
+                    out_exprs[n] = table[n]
+                continue
             if isinstance(arg, ThisSlice):
                 for n, ref in arg.resolve(table).items():
                     out_exprs[n] = ref
@@ -332,6 +338,12 @@ class GroupedTable:
 
             gb_dtypes[name] = reducer_return_dtype(red, env)
         agg_table = Table._from_node(gb_node, gb_dtypes, Universe())
+        # ids of a groupby derive from the grouping values: their type is
+        # the parametrized pointer (reference: Pointer[grouping dtypes])
+        _id_dtype = dt.Pointer(
+            *[gb_dtypes[n] for n in grouping_names]
+        )
+        agg_table._schema.__id_dtype__ = _id_dtype
 
         # --- final select over aggregated table -------------------------------
         _expr_matches = _exprs_structurally_equal
@@ -380,7 +392,11 @@ class GroupedTable:
                 n: infer_dtype(e, env2) for n, e in out_exprs.items()
             }
             node = nodes.RowwiseNode([agg_table._node], final_exprs)
-            return Table._from_node(node, final_dtypes, agg_table._universe)
+            out_tbl = Table._from_node(
+                node, final_dtypes, agg_table._universe
+            )
+            out_tbl._schema.__id_dtype__ = _id_dtype
+            return out_tbl
 
         # stage 1: the plain aggregated columns, every reducer slot +
         # grouping column (stage 2 may reference them), and the ix pointer

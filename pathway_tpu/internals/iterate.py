@@ -57,7 +57,16 @@ class IterateNode(Node):
         # inputs = initial iterated tables, then boundary outer tables the
         # body reads (their diffs stream in from the OUTER runtime; inside
         # the body they are injected as frozen snapshots via proxies)
-        super().__init__(outer_inputs, result_nodes[out_name].column_names)
+        # canonical column order = the PLACEHOLDER (input) order: bodies
+        # may reorder columns in their selects, and every feedback path
+        # (value store, logs, injection) indexes tuples positionally
+        out_idx = iterated_names.index(out_name)
+        ph_cols = placeholder_nodes[out_idx].column_names
+        out_cols = result_nodes[out_name].column_names
+        super().__init__(
+            outer_inputs,
+            ph_cols if set(ph_cols) == set(out_cols) else out_cols,
+        )
         self.placeholder_nodes = placeholder_nodes
         self.boundary_proxies = boundary_proxies
         self.result_nodes = result_nodes
@@ -91,8 +100,23 @@ class _Depth:
         self.tick_out: dict[str, list[DiffBatch]] = {}
         outputs = []
 
+        ph_order = {
+            name: node.placeholder_nodes[i].column_names
+            for i, name in enumerate(node.iterated_names)
+        }
+
         def make_cb(name):
             def cb(t, batch: DiffBatch):
+                # canonicalize to placeholder column order: feedback and
+                # value stores index tuples positionally
+                wanted = ph_order.get(name)
+                if wanted is not None and set(wanted) == set(batch.columns):
+                    if list(batch.columns) != wanted:
+                        batch = DiffBatch(
+                            keys=batch.keys,
+                            diffs=batch.diffs,
+                            columns={n: batch.columns[n] for n in wanted},
+                        )
                 self.tick_out.setdefault(name, []).append(batch)
                 store = self.value[name]
                 for k, d, vals in batch.iter_rows():
@@ -430,6 +454,8 @@ def iterate(
     ticks, so there is no final flush tick that would release buffered rows.
     Apply temporal behaviors before or after the ``iterate`` instead.
     """
+    if iteration_limit is not None and iteration_limit < 1:
+        raise ValueError("wrong iteration limit")
     iterated_names = list(kwargs.keys())
     placeholders: list[InputNode] = []
     ph_tables: dict[str, Table] = {}
@@ -523,7 +549,8 @@ def iterate(
         )
         out_tables[out_name] = Table._from_node(
             it_node,
-            {n: rtbl._schema[n].dtype for n in rtbl.column_names()},
+            # the node may canonicalize to the placeholder column order
+            {n: rtbl._schema[n].dtype for n in it_node.column_names},
             Universe(),
         )
     if single:
@@ -533,5 +560,11 @@ def iterate(
     return types.SimpleNamespace(**out_tables)
 
 
-def iterate_universe(func: Callable, **kwargs: Table) -> Any:
-    return iterate(func, **kwargs)
+def iterate_universe(arg: Any = None, **kwargs: Table) -> Any:
+    """`pw.iterate_universe(table)` marks an iterated table whose key set
+    changes across iterations (reference: iterate_universe). The
+    incremental engine handles changing universes natively, so the marker
+    is a passthrough; the legacy callable form aliases iterate()."""
+    if callable(arg):
+        return iterate(arg, **kwargs)
+    return arg

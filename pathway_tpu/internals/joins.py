@@ -11,6 +11,7 @@ from pathway_tpu.engine import nodes
 from pathway_tpu.engine.expression_eval import InternalColRef
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.expression import (
+    CoalesceExpression,
     ColumnBinaryOpExpression,
     ColumnExpression,
     ColumnReference,
@@ -59,7 +60,9 @@ class JoinResult:
             and id_expr.name == "id"
             and id_expr.table in (left, right, left_ph, right_ph)
         ):
-            raise TypeError(
+            # AssertionError to match the reference's contract, raised
+            # explicitly so python -O cannot strip it
+            raise AssertionError(
                 "join id= must be the id column of one side "
                 "(left.id or right.id)"
             )
@@ -181,10 +184,21 @@ class JoinResult:
                     continue
                 exprs[n] = ColumnReference(joined, prefix + n)
 
+        def add_slice(sl: ThisSlice):
+            if sl._parent is right_ph:
+                sides = [self._right]
+            elif sl._parent is left_ph:
+                sides = [self._left]
+            else:  # pw.this: both sides, right winning collisions like
+                sides = [self._left, self._right]  # bare pw.this expansion
+            for side in sides:
+                for out_name, ref in sl.resolve(side).items():
+                    exprs[out_name] = ref
+
         for arg in args:
             if isinstance(arg, ThisSlice):
-                raise NotImplementedError("slices in join select")
-            if isinstance(arg, ThisPlaceholder):
+                add_slice(arg)
+            elif isinstance(arg, ThisPlaceholder):
                 add_side(self._left, "l.")
                 add_side(self._right, "r.")
             elif isinstance(arg, ColumnReference):
@@ -192,6 +206,9 @@ class JoinResult:
             else:
                 raise TypeError(arg)
         for name, e in kwargs.items():
+            if isinstance(e, ThisSlice):  # `**pw.left.without(...)` etc.
+                add_slice(e)
+                continue
             if isinstance(e, ThisPlaceholder):  # `**pw.left` expansion
                 if e is left_ph or e is this_ph:
                     add_side(self._left, "l.")
@@ -204,9 +221,29 @@ class JoinResult:
         return joined.select(**resolved)
 
     def _result_universe(self) -> Universe:
-        """Universe of the joined table; subclasses override when the
-        output keys provably come from one side (id=left.id)."""
+        """Universe of the joined table: fresh by default; with id= the
+        keys come from one side, so the result lives in (a subset of) that
+        side's universe — LEFT join keyed by left.id covers every left
+        row and keeps the full universe."""
+        ref = self._id_expr
+        if isinstance(ref, ColumnReference):
+            if ref.table is self._left or ref.table is left_ph:
+                side, side_is_left = self._left, True
+            else:
+                side, side_is_left = self._right, False
+            side_u = getattr(side, "_universe", None)
+            if side_u is not None:
+                keeps_all = (
+                    self._mode == JoinMode.LEFT and side_is_left
+                ) or (self._mode == JoinMode.RIGHT and not side_is_left)
+                return side_u if keeps_all else side_u.subset()
         return Universe()
+
+    def promise_universe_is_subset_of(self, other) -> "JoinResult":
+        return self
+
+    def promise_universes_are_equal(self, other) -> "JoinResult":
+        return self
 
     def _maybe_opt(self, d: dt.DType, side: str) -> dt.DType:
         m = self._mode
@@ -399,12 +436,35 @@ class JoinResult:
         still resolve in further joins/selects (reference: chained joins,
         internals/joins.py JoinResult.join chaining)."""
         joined, _sub = self._joined_with_sub()
+        # a column equi-joined under the same name on both sides is ONE
+        # column of the result (values match): keep the left copy and
+        # alias the right side to it (reference: chained select(*pw.this)
+        # yields each on-column once)
+        equi_names = {
+            l_e.name
+            for l_e, r_e in zip(self._left_on, self._right_on)
+            if isinstance(l_e, ColumnReference)
+            and isinstance(r_e, ColumnReference)
+            and l_e.name == r_e.name
+        }
         exprs: dict[str, ColumnReference] = {}
         aliases: dict[tuple[int, str], str] = {}
         for tbl, prefix in ((self._left, "l."), (self._right, "r.")):
             sub_aliases = getattr(tbl, "_join_aliases", {})
             for n in tbl.column_names():
                 if n.startswith("_on") or n.startswith("_pw_"):
+                    continue
+                if prefix == "r." and n in exprs and n in equi_names:
+                    if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
+                        # left copy is None on right-only rows: keep
+                        # whichever side has the value
+                        exprs[n] = CoalesceExpression(
+                            exprs[n], ColumnReference(joined, "r." + n)
+                        )
+                    aliases[(id(tbl), n)] = n
+                    for key, v in sub_aliases.items():
+                        if v == n:
+                            aliases[key] = n
                     continue
                 out_name = n
                 while out_name in exprs:

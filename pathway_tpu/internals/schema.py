@@ -148,6 +148,16 @@ class SchemaMetaclass(type):
     def dtypes(cls) -> dict[str, dt.DType]:
         return {name: c.dtype for name, c in cls.__columns__.items()}
 
+    # reference spelling used by tests (schema._dtypes())
+    _dtypes = dtypes
+
+    @property
+    def id(cls) -> ColumnSchema:
+        """Type of the table's id column (reference: schema.id —
+        parametrized by the grouping columns for groupby outputs)."""
+        id_dtype = getattr(cls, "__id_dtype__", None) or dt.POINTER
+        return ColumnSchema(name="id", dtype=id_dtype)
+
     def __getitem__(cls, name: str) -> ColumnSchema:
         return cls.__columns__[name]
 

@@ -70,6 +70,12 @@ def desugar(e: Any, mapping: Mapping[ThisPlaceholder, "Table"]) -> ColumnExpress
 
     def sub(ref: ColumnReference) -> ColumnExpression | None:
         tbl = ref.table
+        if isinstance(tbl, ThisSlice):
+            target = mapping.get(tbl._parent) or mapping.get(this)
+            if target is None:
+                raise ValueError(f"cannot resolve {tbl!r} in this context")
+            # sliced-away names fail loudly (reference: slice access error)
+            return tbl.resolve_ref(target, ref.name)
         if isinstance(tbl, ThisPlaceholder):
             target = mapping.get(tbl)
             if target is None:
@@ -200,6 +206,20 @@ class _DeferredThisIxTable(_DeferredIxTable):
         from pathway_tpu.internals.expression import wrap_expr
 
         return [wrap_expr(self._expr)]
+
+
+def _require_related_universes(primary: "Table", other: "Table") -> None:
+    """Row-aligned multi-table expressions need provably related key sets:
+    the same universe or a promised subset relation either way (reference:
+    the universe solver rejects cross-universe column mixing)."""
+    pu, ou = primary._universe, other._universe
+    if pu is ou or ou.is_subset_of(pu) or pu.is_subset_of(ou):
+        return
+    raise ValueError(
+        "expression mixes columns of tables over unrelated universes; "
+        "use with_universe_of / pw.universes.promise_is_subset_of to "
+        "assert how their key sets relate"
+    )
 
 
 def _collect_tables(exprs: Iterable[ColumnExpression]) -> list["Table"]:
@@ -411,6 +431,51 @@ def infer_dtype(e: ColumnExpression, env) -> dt.DType:
                         f"found {ddt.typehint}."
                     )
             return dt.Optional_(dt.JSON) if e._check_if_exists else dt.JSON
+        # tuple / list sequence access (reference type_interpreter
+        # SequenceGet rules, tests/test_common.py sequence_get_*)
+        idx_e = e._index
+        static_idx = (
+            idx_e._value
+            if isinstance(idx_e, expr_mod.ColumnConstExpression)
+            and isinstance(idx_e._value, int)
+            else None
+        )
+        default_dt = infer_dtype(e._default, env)
+        if isinstance(inner, dt.TupleDType) and inner.args is not None:
+            args = inner.args
+            if static_idx is not None:
+                in_range = -len(args) <= static_idx < len(args)
+                if in_range:
+                    elem = args[static_idx]
+                    if e._check_if_exists:
+                        return dt.lub(elem, default_dt)
+                    return elem
+                if not e._check_if_exists:
+                    raise IndexError(
+                        f"Index {static_idx} out of range for a tuple of "
+                        f"type {inner.typehint}."
+                    )
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f"Index {static_idx} out of range for a tuple of type "
+                    f"{inner.typehint}. The default value will be used. "
+                    "Consider using just the default value without .get().",
+                    stacklevel=2,
+                )
+                return default_dt
+            # dynamic index
+            elem = args[0]
+            for a in args[1:]:
+                elem = dt.lub(elem, a)
+            if e._check_if_exists:
+                return dt.lub(dt.Optional_(elem), default_dt)
+            return dt.ANY
+        if isinstance(inner, dt.ListDType):
+            elem = inner.wrapped
+            if e._check_if_exists:
+                return dt.lub(dt.Optional_(elem), default_dt)
+            return elem
         return dt.ANY
     if isinstance(e, PointerExpression):
         return dt.Optional_(dt.POINTER) if e._optional else dt.POINTER
@@ -546,6 +611,8 @@ class Table(Joinable):
         tables = _collect_tables(exprs.values())
         if self in tables:
             tables.remove(self)
+        for t in tables:
+            _require_related_universes(self, t)
         input_tables = [self] + tables
         for t in tables:
             if t._universe is not self._universe and not (
@@ -586,6 +653,16 @@ class Table(Joinable):
             if isinstance(e, ThisPlaceholder):  # `**pw.this` expansion
                 for n in self.column_names():
                     exprs[n] = self[n]
+                continue
+            if isinstance(e, ThisSlice):  # `**pw.this.without(...)` etc.
+                for n, ref in e.resolve(self).items():
+                    exprs[n] = ref
+                continue
+            from pathway_tpu.internals.table_slice import TableSlice
+
+            if isinstance(e, TableSlice):
+                for n in e.keys():
+                    exprs[n] = e[n]
                 continue
             exprs[name] = wrap_expr(e)
         return self._build_rowwise(exprs)
@@ -746,6 +823,16 @@ class Table(Joinable):
             self._desugar(time_column),
         )
 
+    def _remove_retractions(self) -> "Table":
+        """Pass inserts through and DROP deletions (reference:
+        Table._remove_retractions — downstream sees an append-only view)."""
+        node = nodes.RemoveRetractionsNode(self._node)
+        return Table._from_node(
+            node,
+            {n: self._schema[n].dtype for n in self.column_names()},
+            Universe(),
+        )
+
     # --- ids ------------------------------------------------------------------
 
     def pointer_from(
@@ -756,10 +843,20 @@ class Table(Joinable):
         )
 
     def with_id(self, new_index: ColumnReference) -> "Table":
-        e = self._desugar(new_index)
-        internal = resolve_to_internal({"k": e}, [self])["k"]
-        node = nodes.ReindexNode(self._node, internal)
-        return Table(node, self._schema, Universe())
+        # the new-id expression may live on a related table (e.g. a
+        # restricted pointer table): route through a row-aligned select
+        prep = self._build_rowwise(
+            {
+                **{n: self[n] for n in self.column_names()},
+                "_pw_new_id": new_index,
+            }
+        )
+        internal = resolve_to_internal(
+            {"k": prep._pw_new_id}, [prep]
+        )["k"]
+        node = nodes.ReindexNode(prep._node, internal)
+        out = Table(node, prep._schema, Universe())
+        return out.without("_pw_new_id")
 
     def with_id_from(self, *args: Any, instance: Any = None) -> "Table":
         e = self._desugar(
@@ -907,6 +1004,17 @@ class Table(Joinable):
 
     def concat(self, *others: "Table") -> "Table":
         tables = [self] + list(others)
+        # key sets must be provably disjoint or ids could collide
+        # (reference: concat requires promise_are_pairwise_disjoint;
+        # concat_reindex rehashes and is always safe)
+        for i, a in enumerate(tables):
+            for b in tables[i + 1 :]:
+                if not a._universe.is_disjoint_from(b._universe):
+                    raise ValueError(
+                        "Table.concat: universes are not provably disjoint; "
+                        "call pw.universes.promise_are_pairwise_disjoint "
+                        "first, or use concat_reindex"
+                    )
         names = self.column_names()
         aligned = [t.select(*[t[n] for n in names]) for t in tables]
         node = nodes.ConcatNode([t._node for t in aligned])
@@ -923,20 +1031,58 @@ class Table(Joinable):
         reindexed = [
             t.with_id_from(t.id, i) for i, t in enumerate(tables)
         ]
+        # the side tag mixed into every rehashed id guarantees disjointness
+        for i, a in enumerate(reindexed):
+            for b in reindexed[i + 1 :]:
+                a._universe.promise_disjoint(b._universe)
         return reindexed[0].concat(*reindexed[1:])
 
     def update_rows(self, other: "Table") -> "Table":
         names = self.column_names()
+        if set(other.column_names()) != set(names):
+            raise ValueError(
+                "update_rows: column sets must match "
+                f"({sorted(names)} vs {sorted(other.column_names())})"
+            )
         other_aligned = other.select(*[other[n] for n in names])
         node = nodes.UpdateRowsNode(self._node, other_aligned._node)
         dtypes = {
             n: dt.lub(self._schema[n].dtype, other._schema[n].dtype)
             for n in names
         }
-        return Table._from_node(node, dtypes, Universe())
+        if self._universe.is_subset_of(other._universe):
+            # other covers every key of self: nothing of self survives the
+            # override (reference warns and short-circuits)
+            import warnings
+
+            warnings.warn(
+                "Universe of self is a subset of universe of other in "
+                "update_rows. Returning other.",
+                stacklevel=2,
+            )
+            return other_aligned
+        # an update from a promised subset cannot add keys: the result
+        # keeps self's universe (reference: update_rows universe solver)
+        universe = (
+            self._universe
+            if other._universe.is_subset_of(self._universe)
+            else Universe()
+        )
+        return Table._from_node(node, dtypes, universe)
 
     def update_cells(self, other: "Table") -> "Table":
         # columns of `other` override; other's universe ⊆ self's
+        if other._universe is self._universe:
+            import warnings
+
+            warnings.warn(
+                "Key sets of self and other in update_cells are the same. "
+                "Using with_columns instead of update_cells.",
+                stacklevel=2,
+            )
+            return self.with_columns(
+                **{n: other[n] for n in other.column_names()}
+            )
         names = self.column_names()
         override = [n for n in other.column_names() if n in names]
         exprs: dict[str, Any] = {n: self[n] for n in names}
@@ -978,6 +1124,8 @@ class Table(Joinable):
         return Table(node, self._schema, self._universe.subset())
 
     def restrict(self, other: TableLike) -> "Table":
+        if hasattr(other, "_flatten") and not hasattr(other, "_node"):
+            other = other._flatten()  # JoinResult used as a key-set source
         node = nodes.UniverseSetOpNode(
             self._node, [other._node], "restrict"  # type: ignore[attr-defined]
         )
@@ -1179,6 +1327,7 @@ class Table(Joinable):
     # --- promises (metadata-only, parity surface) -----------------------------
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        self._universe.promise_disjoint(other._universe)
         return self
 
     def promise_universes_are_equal(self, other: "Table") -> "Table":
@@ -1340,10 +1489,13 @@ class Table(Joinable):
 
     # --- interactive sugar ----------------------------------------------------
 
+    @property
     def slice(self):
         from pathway_tpu.internals.table_slice import TableSlice
 
-        return TableSlice(self)
+        return TableSlice(
+            {n: self[n] for n in self.column_names()}, self
+        )
 
 
 def _CellUpdate(left_ref, right_ref):

@@ -14,11 +14,29 @@ _counter = itertools.count()
 
 
 class Universe:
-    __slots__ = ("id", "parent")
+    __slots__ = ("id", "parent", "_disjoint")
 
     def __init__(self, parent: "Universe | None" = None):
         self.id = next(_counter)
         self.parent = parent
+        self._disjoint: set[int] = set()  # ids promised disjoint from this
+
+    def promise_disjoint(self, other: "Universe") -> None:
+        self._disjoint.add(other.id)
+        other._disjoint.add(self.id)
+
+    def is_disjoint_from(self, other: "Universe") -> bool:
+        # a subset of a promised-disjoint universe is still disjoint:
+        # check every ancestor pair
+        a: Universe | None = self
+        while a is not None:
+            b: Universe | None = other
+            while b is not None:
+                if b.id in a._disjoint:
+                    return True
+                b = b.parent
+            a = a.parent
+        return False
 
     def subset(self) -> "Universe":
         return Universe(parent=self)

@@ -123,8 +123,9 @@ class _Parser:
             self.eat()
             s = v[1:-1]
             if v[0] in ("'", '"'):
-                # unescape \' and \" produced by the quote normalization
-                s = re.sub(r"\\(.)", r"\1", s)
+                # unescape ONLY the quote escapes the normalization emits;
+                # other backslashes (windows paths) stay verbatim
+                s = s.replace("\\'", "'").replace('\\"', '"')
             if v[0] == "`":
                 # JMESPath backticks delimit JSON literals: `4` is the
                 # number 4, `"x"` the string "x"; bare words fall back to

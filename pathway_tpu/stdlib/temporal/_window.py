@@ -567,6 +567,9 @@ class _IntervalsOverGrouped(GroupedTable):
                 loc_out_name = n
                 break
         probes_keyed = probes.with_id_from(probes._pw_at)
+        # re-keying by the probe value lands on the SAME ids the distinct
+        # groupby assigned (both ref_scalar(_pw_at))
+        probes_keyed.promise_universe_is_equal_to(probes)
         if loc_out_name is not None:
             reduced_keyed = reduced.with_id_from(reduced[loc_out_name])
         else:
@@ -579,6 +582,8 @@ class _IntervalsOverGrouped(GroupedTable):
             )
         empty = probes_keyed.difference(reduced_keyed)
         empty_rows = empty.select(**empty_exprs)
+        # empty is probes-minus-reduced: provably disjoint from reduced
+        reduced.promise_universes_are_disjoint(empty_rows)
         return reduced.concat(empty_rows)
 
 

@@ -209,6 +209,8 @@ def _session_window_join(
     def flat_for(orig, side):
         sw = windows.filter(windows._pw_side == side)
         sw = sw.with_id(sw._pw_orig)
+        # sw's keys ARE orig row ids (one window row per source row)
+        sw.promise_universe_is_subset_of(orig)
         cols = {n: orig[n] for n in orig.column_names()}
         out = orig._build_rowwise(
             {

@@ -232,11 +232,20 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
             doc_list = docs.value if isinstance(docs, Json) else list(docs or [])
             context = "\n\n".join(_doc_text(d) for d in (doc_list or []))
             fn = _coerce_sync(_unwrap_udf(prompt_udf))
+            # dispatch on the template's own signature, so an internal
+            # TypeError is never masked by a retry
+            import inspect as _inspect
+
             try:
+                params = list(_inspect.signature(fn).parameters)
+            except (TypeError, ValueError):
+                params = []
+            if "context" in params:
                 return str(fn(query=prompt, context=context))
-            except TypeError:
-                # positional / legacy (query, docs) templates
-                return str(fn(prompt, context))
+            if len(params) >= 2 and params[1] in ("docs", "documents"):
+                # legacy (query, docs) templates receive the list
+                return str(fn(prompt, doc_list or []))
+            return str(fn(prompt, context))
 
         with_prompt = combined.with_columns(
             _full_prompt=apply_with_type(

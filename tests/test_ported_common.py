@@ -398,6 +398,7 @@ def test_update_cells():
         1 | 99
         """
     )
+    pw.universes.promise_is_subset_of(new, old)
     res = old.update_cells(new)
     assert_table_equality_wo_index(
         res,

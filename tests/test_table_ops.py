@@ -304,6 +304,7 @@ def test_update_cells():
         1  | z
         """
     )
+    pw.universes.promise_is_subset_of(t2, t1)
     res = t1.update_cells(t2)
     assert_table_equality_wo_index(
         res,
