@@ -36,6 +36,16 @@ def _fixture_universe(keys: "Iterable[int]") -> Universe:
     return u
 
 
+def _fixture_universe_from_events(events: dict) -> Universe:
+    """Universe keyed by the fixture's NET key set: retracted rows do not
+    count, so only fixtures ending with identical keys unify."""
+    net: dict[int, int] = {}
+    for _t, rows in sorted(events.items()):
+        for k, d, _v in rows:
+            net[k] = net.get(k, 0) + d
+    return _fixture_universe(k for k, c in net.items() if c > 0)
+
+
 class _RowsSource(StaticSource):
     # debug fixtures are not persistable connectors: re-read fresh on every
     # run instead of being offset-suppressed/logged (reference: persistence
@@ -238,9 +248,8 @@ def table_from_markdown(
         dtypes = {n: _dtype_for(col_values[n]) for n in col_names}
     source = _RowsSource(col_names, sorted(events.items()))
     node = InputNode(source, col_names)
-    all_keys = [k for _t, rows in events.items() for (k, _d, _v) in rows]
     return Table._from_node(
-        node, dtypes, _fixture_universe(all_keys)
+        node, dtypes, _fixture_universe_from_events(events)
     )
 
 
@@ -272,9 +281,8 @@ def table_from_rows(
         events.setdefault(int(t), []).append((key, int(d), tuple(vals)))
     source = _RowsSource(col_names, sorted(events.items()))
     node = InputNode(source, col_names)
-    all_keys = [k for _t, rows in events.items() for (k, _d, _v) in rows]
     return Table._from_node(
-        node, dict(schema.dtypes()), _fixture_universe(all_keys)
+        node, dict(schema.dtypes()), _fixture_universe_from_events(events)
     )
 
 

@@ -835,14 +835,35 @@ class JoinExec(NodeExec):
                 emit(okey, (None,) * self.n_l + rvals + (None, Pointer(rk)))
         return out
 
-    def _batch_jks(self, b, on_idx) -> np.ndarray:
+    def _batch_jks(self, b, on_idx, side_tag: str = "") -> np.ndarray:
         """Join keys for a whole batch via the C batch hasher (byte-
         identical to per-row ref_scalar, same contract as the groupby
-        path's _group_keys_batch)."""
-        from pathway_tpu.internals.api import ref_scalars_columns
+        path's _group_keys_batch). A row with None in ANY on-column gets a
+        PRIVATE key (side + row id): null keys never match the other side
+        but still pad as unmatched in outer modes (reference: chained
+        outer joins do not equate padded Nones)."""
+        from pathway_tpu.internals.api import ref_scalar, ref_scalars_columns
 
         cols = list(b.columns.values())
-        return ref_scalars_columns([cols[i] for i in on_idx], len(b))
+        jks = ref_scalars_columns([cols[i] for i in on_idx], len(b))
+        null_rows = None
+        for i in on_idx:
+            col = cols[i]
+            if col.dtype == object:
+                # vectorized identity-None test (object array == None
+                # compares elementwise by identity for None)
+                m = np.asarray(col == None, dtype=bool)  # noqa: E711
+                if not m.any():
+                    continue
+                null_rows = m if null_rows is None else (null_rows | m)
+        if null_rows is not None and null_rows.any():
+            jks = np.array(jks, copy=True)
+            keys = b.keys
+            for i in np.nonzero(null_rows)[0]:
+                jks[i] = int(
+                    ref_scalar("__pw_null", side_tag, Pointer(int(keys[i])))
+                ) & 0xFFFFFFFFFFFFFFFF
+        return jks
 
     def _try_bulk(self, lb, rb, jks_l, jks_r):
         """Columnar hash-join fast path (the batched analog of
@@ -1015,8 +1036,16 @@ class JoinExec(NodeExec):
         )
         if not len(lb) and not len(rb):
             return extra
-        jks_l = self._batch_jks(lb, self.l_on_idx) if len(lb) else np.empty(0, np.uint64)
-        jks_r = self._batch_jks(rb, self.r_on_idx) if len(rb) else np.empty(0, np.uint64)
+        jks_l = (
+            self._batch_jks(lb, self.l_on_idx, "l")
+            if len(lb)
+            else np.empty(0, np.uint64)
+        )
+        jks_r = (
+            self._batch_jks(rb, self.r_on_idx, "r")
+            if len(rb)
+            else np.empty(0, np.uint64)
+        )
         bulk = self._try_bulk(lb, rb, jks_l, jks_r)
         if bulk is not None:
             return extra + bulk

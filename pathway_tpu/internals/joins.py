@@ -54,6 +54,7 @@ class JoinResult:
         self._left = left
         self._right = right
         self._mode = mode if isinstance(mode, JoinMode) else JoinMode(mode)
+        self._join_mode = self._mode  # reference-public spelling
         self._id_expr = id_expr
         if id_expr is not None and not (
             isinstance(id_expr, ColumnReference)
@@ -66,12 +67,35 @@ class JoinResult:
                 "join id= must be the id column of one side "
                 "(left.id or right.id)"
             )
+        if id_expr is not None:
+            id_is_left = id_expr.table in (left, left_ph)
+            # the id side must be preserved by the join mode, or padded
+            # rows have no id (reference: KeyError at build)
+            if (
+                self._mode == JoinMode.OUTER
+                or (self._mode == JoinMode.LEFT and not id_is_left)
+                or (self._mode == JoinMode.RIGHT and id_is_left)
+            ):
+                raise KeyError(
+                    "join id= side is not preserved by this join mode: "
+                    "padded rows would have no id"
+                )
         self._left_on: list[ColumnExpression] = []
         self._right_on: list[ColumnExpression] = []
         for cond in on:
             l_e, r_e = self._split_condition(cond)
             self._left_on.append(l_e)
             self._right_on.append(r_e)
+        if not isinstance(left, JoinResult) and not isinstance(
+            right, JoinResult
+        ):
+            from pathway_tpu.stdlib.temporal.utils import (
+                validate_join_condition_types,
+            )
+
+            validate_join_condition_types(
+                left, right, self._left_on, self._right_on
+            )
 
     # --- condition handling ---------------------------------------------------
 
@@ -220,6 +244,11 @@ class JoinResult:
         resolved = {n: wrap_expr(e)._substitute(sub) for n, e in exprs.items()}
         return joined.select(**resolved)
 
+    @property
+    def _universe(self) -> Universe:
+        joined, _sub = self._joined_with_sub()
+        return joined._universe
+
     def _result_universe(self) -> Universe:
         """Universe of the joined table: fresh by default; with id= the
         keys come from one side, so the result lives in (a subset of) that
@@ -243,6 +272,9 @@ class JoinResult:
         return self
 
     def promise_universes_are_equal(self, other) -> "JoinResult":
+        return self
+
+    def promise_universe_is_equal_to(self, other) -> "JoinResult":
         return self
 
     def _maybe_opt(self, d: dt.DType, side: str) -> dt.DType:
@@ -447,24 +479,48 @@ class JoinResult:
             and isinstance(r_e, ColumnReference)
             and l_e.name == r_e.name
         }
-        exprs: dict[str, ColumnReference] = {}
+        exprs: dict[str, Any] = {}
         aliases: dict[tuple[int, str], str] = {}
-        for tbl, prefix in ((self._left, "l."), (self._right, "r.")):
+        for tbl, prefix, idcol in (
+            (self._left, "l.", "_left_id"),
+            (self._right, "r.", "_right_id"),
+        ):
             sub_aliases = getattr(tbl, "_join_aliases", {})
+            # each side's row id stays addressable after flattening
+            # (chained conditions like t1.id == t2.id)
+            id_hidden = f"_pw_id_{prefix[0]}"
+            exprs[id_hidden] = ColumnReference(joined, idcol)
+            aliases[(id(tbl), "id")] = id_hidden
+            for key, v in sub_aliases.items():
+                if key[1] == "id" or v.startswith("_pw_"):
+                    # nested hidden columns (pure copies, nested ids) are
+                    # carried through under fresh hidden names
+                    carried = f"_pw_{prefix[0]}{v}" if not v.startswith("_pw_") else f"_pw_{prefix[0]}_{v[4:]}"
+                    if v in tbl.column_names():
+                        exprs[carried] = ColumnReference(joined, prefix + v)
+                        aliases[key] = carried
             for n in tbl.column_names():
                 if n.startswith("_on") or n.startswith("_pw_"):
                     continue
-                if prefix == "r." and n in exprs and n in equi_names:
-                    if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
-                        # left copy is None on right-only rows: keep
-                        # whichever side has the value
-                        exprs[n] = CoalesceExpression(
-                            exprs[n], ColumnReference(joined, "r." + n)
-                        )
-                    aliases[(id(tbl), n)] = n
+                if n in equi_names:
+                    # an equi-joined column shows ONCE, coalesced when the
+                    # right side can carry unmatched rows; the PURE copies
+                    # live under hidden names so t1.col / t2.col refs (and
+                    # further chained conditions on them) read one side
+                    hidden = f"_pw_{prefix[0]}_{n}"
+                    exprs[hidden] = ColumnReference(joined, prefix + n)
+                    aliases[(id(tbl), n)] = hidden
                     for key, v in sub_aliases.items():
                         if v == n:
-                            aliases[key] = n
+                            aliases[key] = hidden
+                    if prefix == "l.":
+                        if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
+                            exprs[n] = CoalesceExpression(
+                                ColumnReference(joined, "l." + n),
+                                ColumnReference(joined, "r." + n),
+                            )
+                        else:
+                            exprs[n] = ColumnReference(joined, "l." + n)
                     continue
                 out_name = n
                 while out_name in exprs:

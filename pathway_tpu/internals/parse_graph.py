@@ -10,6 +10,17 @@ from typing import Any, Callable
 from pathway_tpu.engine.nodes import Node, OutputNode
 
 
+class _UniverseSolver:
+    """Minimal query surface of the reference's universe solver: answers
+    the relations our Universe objects track structurally."""
+
+    def query_are_equal(self, a, b) -> bool:
+        return a is b or (a.is_subset_of(b) and b.is_subset_of(a))
+
+    def query_is_subset(self, a, b) -> bool:
+        return a.is_subset_of(b)
+
+
 class ParseGraph:
     def __init__(self) -> None:
         self.outputs: list[Node] = []
@@ -20,6 +31,10 @@ class ParseGraph:
 
     def add_output(self, node: Node) -> None:
         self.outputs.append(node)
+
+    @property
+    def universe_solver(self) -> _UniverseSolver:
+        return _UniverseSolver()
 
     def clear(self) -> None:
         from pathway_tpu.engine.nodes import ALL_NODES

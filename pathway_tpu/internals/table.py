@@ -1072,6 +1072,12 @@ class Table(Joinable):
 
     def update_cells(self, other: "Table") -> "Table":
         # columns of `other` override; other's universe ⊆ self's
+        extra = set(other.column_names()) - set(self.column_names())
+        if extra:
+            raise ValueError(
+                f"update_cells: columns {sorted(extra)} are not present "
+                "in the updated table"
+            )
         if other._universe is self._universe:
             import warnings
 

@@ -104,6 +104,7 @@ class ThisSlice:
         prefix: str = "",
         suffix: str = "",
         pick: list[str] | None = None,
+        out_renames: dict | None = None,
     ):
         self._parent = parent
         self._names = names
@@ -112,6 +113,7 @@ class ThisSlice:
         self._prefix = prefix
         self._suffix = suffix
         self._pick = pick  # narrow to these OUTPUT names after renaming
+        self._out_renames = dict(out_renames or {})  # output -> new output
 
     def _derive(self, **overrides) -> "ThisSlice":
         kw = dict(
@@ -121,6 +123,7 @@ class ThisSlice:
             prefix=self._prefix,
             suffix=self._suffix,
             pick=self._pick,
+            out_renames=self._out_renames,
         )
         kw.update(overrides)
         return ThisSlice(self._parent, **kw)
@@ -136,7 +139,10 @@ class ThisSlice:
             )
             for k, v in rename_dict.items()
         }
-        return self._derive(renames={**self._renames, **norm})
+        # renames address OUTPUT names (post prefix/suffix/earlier
+        # renames), mirroring TableSlice.rename; unknown names error at
+        # resolve time, when the column set is known
+        return self._derive(out_renames={**self._out_renames, **norm})
 
     def with_prefix(self, prefix: str) -> "ThisSlice":
         return self._derive(prefix=prefix + self._prefix)
@@ -183,6 +189,12 @@ class ThisSlice:
         out = {
             self._out_name(n): table[n] for n in self._visible_names(table)
         }
+        for old, new in self._out_renames.items():
+            if old not in out:
+                raise KeyError(
+                    f"Column name {old!r} not found in this slice."
+                )
+            out[new] = out.pop(old)
         if self._pick is not None:
             out = {n: out[n] for n in self._pick}
         return out

@@ -237,10 +237,15 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
             import inspect as _inspect
 
             try:
-                params = list(_inspect.signature(fn).parameters)
+                sig_params = _inspect.signature(fn).parameters
+                params = list(sig_params)
+                has_var_kw = any(
+                    p.kind is _inspect.Parameter.VAR_KEYWORD
+                    for p in sig_params.values()
+                )
             except (TypeError, ValueError):
-                params = []
-            if "context" in params:
+                params, has_var_kw = [], False
+            if "context" in params or has_var_kw:
                 return str(fn(query=prompt, context=context))
             if len(params) >= 2 and params[1] in ("docs", "documents"):
                 # legacy (query, docs) templates receive the list
