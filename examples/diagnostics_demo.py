@@ -1,0 +1,68 @@
+"""A deliberately sick pipeline — the Graph Doctor's demo patient.
+
+Every block below trips a different rule, so
+
+    python -m pathway_tpu.analysis --fail-on never examples/diagnostics_demo.py
+
+shows the full diagnostic surface (dead-node, dead-column,
+unbounded-state, universe-safety, shard-exchange, shard-nondeterminism,
+shard-reducer, graph-stats) with declaration-site provenance. Do not use
+it as a template for real pipelines.
+"""
+
+import random
+
+import pathway_tpu as pw
+
+
+class EventSubject(pw.io.python.ConnectorSubject):
+    def run(self) -> None:
+        self.close()
+
+
+class EventSchema(pw.Schema):
+    user: str
+    amount: int
+
+
+events = pw.io.python.read(EventSubject(), schema=EventSchema)
+
+
+@pw.udf(deterministic=False)
+def jitter(x: int) -> float:
+    return x + random.random()
+
+
+# dead-column: `unused` is computed and never read again
+enriched = events.select(
+    pw.this.user,
+    amount=jitter(pw.this.amount),  # shard-nondeterminism: feeds a groupby
+    unused=pw.this.amount * 2,
+)
+
+# unbounded-state: streaming groupby with no window/behavior;
+# shard-exchange: grouping forces a row exchange under sharding;
+# shard-reducer: tuple() without sort_by is arrival-order dependent
+totals = enriched.groupby(pw.this.user).reduce(
+    pw.this.user,
+    total=pw.reducers.sum(pw.this.amount),
+    history=pw.reducers.tuple(pw.this.amount),
+)
+
+# universe-safety: restricting to a key set with no declared relation
+labels = pw.debug.table_from_markdown(
+    """
+    label
+    vip
+    """
+)
+labeled = labels.with_universe_of(totals)
+
+# dead-node: declared, never written or consumed
+orphan = events.select(doubled=pw.this.amount * 2)
+
+pw.io.null.write(totals)
+pw.io.null.write(labeled)
+
+if __name__ == "__main__":
+    pw.run(diagnostics="warn")

@@ -1,0 +1,39 @@
+"""Windowed streaming wordcount — a Graph-Doctor-clean pipeline.
+
+Tier-1 runs ``python -m pathway_tpu.analysis examples/streaming_wordcount.py``
+over this file (tests/test_graph_doctor.py): the aggregation is windowed
+with a temporal behavior, so closed windows free their state and the
+doctor reports no error-severity findings.
+"""
+
+import pathway_tpu as pw
+
+
+class WordSubject(pw.io.python.ConnectorSubject):
+    def run(self) -> None:
+        for t, word in enumerate(["tpu", "dataflow", "tpu", "pathway"]):
+            self.next(word=word, event_time=t)
+        self.close()
+
+
+class WordSchema(pw.Schema):
+    word: str
+    event_time: int
+
+
+words = pw.io.python.read(WordSubject(), schema=WordSchema)
+
+counts = words.windowby(
+    pw.this.event_time,
+    window=pw.temporal.tumbling(duration=10),
+    instance=pw.this.word,
+    behavior=pw.temporal.common_behavior(cutoff=30),
+).reduce(
+    word=pw.this._pw_instance,
+    count=pw.reducers.count(),
+)
+
+pw.io.null.write(counts)
+
+if __name__ == "__main__":
+    pw.run(diagnostics="warn")

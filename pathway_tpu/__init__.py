@@ -11,7 +11,7 @@ kernels) running on TPU via jax/XLA/Pallas and scaling over device meshes via
 from __future__ import annotations
 
 import pathway_tpu.reducers as reducers
-from pathway_tpu import debug, demo, io, udfs
+from pathway_tpu import analysis, debug, demo, io, udfs
 from pathway_tpu.internals import (
     UDF,
     ColumnExpression,
@@ -151,6 +151,7 @@ def table_transformer(*args, **kwargs):
 
 __all__ = [
     "__version__",
+    "analysis",
     "udfs",
     "graphs",
     "utils",

@@ -1,0 +1,45 @@
+"""pathway_tpu.analysis — the Graph Doctor.
+
+A pre-execution static-analysis pass over the declared dataflow
+(`ParseGraph`): walks the registered node graph BEFORE the engine starts
+and emits structured diagnostics (rule id, severity, node provenance
+with declaration-site trace, fix hint) — the correctness-tooling
+counterpart of XLA's ahead-of-time compilation model.
+
+Entry points:
+
+- ``pw.run(diagnostics="warn"|"error"|"off")``
+- ``python -m pathway_tpu.analysis <script.py>`` (build, don't execute)
+- ``pw.debug.diagnose(table)``
+- library use: ``run_doctor()`` / ``GraphFacts`` / ``@rule`` to extend.
+"""
+
+from pathway_tpu.analysis.diagnostics import (
+    Diagnostic,
+    Severity,
+    node_provenance,
+)
+from pathway_tpu.analysis.doctor import (
+    DoctorReport,
+    GraphDoctorError,
+    check_before_run,
+    run_doctor,
+    suppress,
+)
+from pathway_tpu.analysis.graph_facts import GraphFacts
+from pathway_tpu.analysis.rules import RULES, default_rules, rule
+
+__all__ = [
+    "Diagnostic",
+    "DoctorReport",
+    "GraphDoctorError",
+    "GraphFacts",
+    "RULES",
+    "Severity",
+    "check_before_run",
+    "default_rules",
+    "node_provenance",
+    "rule",
+    "run_doctor",
+    "suppress",
+]

@@ -1,0 +1,105 @@
+"""``python -m pathway_tpu.analysis <script.py>`` — build, don't execute.
+
+Runs the user script with ``pw.run``/``pw.run_all`` turned into no-ops,
+so the script *declares* its dataflow exactly as it would in production
+but the engine never starts; then the Graph Doctor reports over the
+declared graph. Exit status is governed by ``--fail-on`` (default:
+nonzero when any ERROR-severity finding exists), so the command slots
+into CI next to a type-checker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+
+from pathway_tpu.analysis.diagnostics import Severity
+from pathway_tpu.analysis.doctor import run_doctor
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pathway_tpu.analysis",
+        description="Graph Doctor: static analysis over the dataflow a "
+        "pathway_tpu script declares, without executing it. Doctor "
+        "options go BEFORE the script path; everything after it is "
+        "passed through to the script (like `python` itself).",
+    )
+    parser.add_argument("script", help="pipeline script to analyze")
+    parser.add_argument(
+        "script_args",
+        nargs=argparse.REMAINDER,
+        help="arguments passed through to the script's sys.argv",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit diagnostics as a JSON list instead of text",
+    )
+    parser.add_argument(
+        "--min-severity",
+        default="info",
+        choices=["info", "warning", "error"],
+        help="hide findings below this severity (default: info)",
+    )
+    parser.add_argument(
+        "--fail-on",
+        default="error",
+        choices=["error", "warning", "never"],
+        help="exit nonzero when a finding at/above this severity exists "
+        "(default: error)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE_ID",
+        help="run only this rule (repeatable)",
+    )
+    args = parser.parse_args(argv)
+
+    import importlib
+
+    from pathway_tpu.internals import parse_graph
+
+    # the module, not the re-exported `run` function: the build-only flag
+    # lives in the module namespace
+    run_mod = importlib.import_module("pathway_tpu.internals.run")
+
+    # declare-only mode: pw.run()/run_all() inside the script return
+    # without building a Runtime
+    run_mod._build_only = True
+    saved_argv = sys.argv
+    sys.argv = [args.script] + args.script_args
+    try:
+        runpy.run_path(args.script, run_name="__main__")
+    finally:
+        sys.argv = saved_argv
+        run_mod._build_only = False
+
+    seeds = list(parse_graph.G.outputs) or None
+    try:
+        report = run_doctor(outputs=seeds, rules=args.rules)
+    except ValueError as e:  # e.g. a typoed --rule id
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    min_sev = Severity.parse(args.min_severity)
+    if args.json:
+        out = [
+            d.to_dict() for d in report if d.severity >= min_sev
+        ]
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(report.format(min_severity=min_sev))
+
+    if args.fail_on == "never":
+        return 0
+    threshold = Severity.parse(args.fail_on)
+    return 1 if report.count_at_least(threshold) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

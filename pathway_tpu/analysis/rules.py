@@ -1,0 +1,468 @@
+"""Built-in Graph Doctor rules + the extensible registry.
+
+A rule is a function `(facts: GraphFacts) -> Iterable[Diagnostic]`
+registered under a stable id. Third-party packages (or user conftest
+code) add rules with::
+
+    from pathway_tpu.analysis import rule
+
+    @rule("my-rule")
+    def check_my_invariant(facts):
+        for node in facts.order:
+            ...
+            yield Diagnostic("my-rule", Severity.WARNING, "...", node)
+
+Rule ids double as the suppression handles:
+``pw.analysis.suppress(table, "unbounded-state")``.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Iterable
+
+from pathway_tpu.analysis.diagnostics import Diagnostic, Severity
+from pathway_tpu.analysis.graph_facts import GraphFacts
+from pathway_tpu.engine.nodes import (
+    ConcatNode,
+    DeduplicateNode,
+    GroupByNode,
+    InputNode,
+    JoinNode,
+    Node,
+    OutputNode,
+    RowwiseNode,
+    UniverseSetOpNode,
+)
+from pathway_tpu.engine.temporal_nodes import (
+    AsofJoinNode,
+    AsofNowJoinNode,
+    IntervalJoinNode,
+)
+from pathway_tpu.internals.expression import iter_apply_expressions
+
+RuleFn = Callable[[GraphFacts], Iterable[Diagnostic]]
+
+RULES: dict[str, RuleFn] = {}
+
+
+def rule(rule_id: str) -> Callable[[RuleFn], RuleFn]:
+    """Register a Graph Doctor rule under `rule_id` (replacing any
+    previous registration of the same id)."""
+
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES[rule_id] = fn
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# 1. dead nodes / dead columns
+
+
+@rule("dead-node")
+def dead_nodes(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Declared nodes whose results can never reach any registered
+    output — built but silently discarded work."""
+    if not facts.outputs:
+        return  # nothing registered yet: reachability is undefined
+    for node in facts.order:
+        if node.id in facts.reachable or isinstance(node, OutputNode):
+            continue
+        # flag only the FRONTIER: dead nodes none of whose consumers are
+        # also dead (the deepest dead table) — one diagnostic per dead
+        # chain instead of one per node
+        if any(
+            c.id not in facts.reachable for c in facts.consumers[node.id]
+        ):
+            continue
+        if node.column_names and all(
+            c.startswith("_") for c in node.column_names
+        ):
+            continue  # library scaffolding (probe/prep tables), not user work
+        kind = "source" if isinstance(node, InputNode) else "table"
+        yield Diagnostic(
+            "dead-node",
+            Severity.WARNING,
+            f"this {kind} never reaches any output; it is built but its "
+            "rows are discarded",
+            node,
+            fix_hint="write/subscribe it, feed it into a consumed table, "
+            "or delete the declaration",
+        )
+
+
+@rule("dead-column")
+def dead_columns(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Computed columns no downstream consumer ever reads."""
+    from pathway_tpu.engine.expression_eval import InternalColRef
+
+    for node in facts.order:
+        if not isinstance(node, RowwiseNode):
+            continue
+        if node.id not in facts.reachable or not facts.consumers[node.id]:
+            continue  # dead-node territory / externally captured
+        live = facts.live_columns.get(node.id)
+        if live is None:
+            continue
+        for name in node.column_names:
+            if name in live or name.startswith("_"):
+                continue  # "_"-prefixed: engine-internal prep columns
+            if isinstance(node.exprs.get(name), InternalColRef):
+                continue  # zero-cost passthrough, not computed work
+            yield Diagnostic(
+                "dead-column",
+                Severity.INFO,
+                f"column {name!r} is computed but never read by any "
+                "consumer on the way to an output",
+                node,
+                fix_hint=f"drop {name!r} from the select/with_columns, or "
+                "consume it downstream",
+                data={"column": name},
+            )
+
+
+# ---------------------------------------------------------------------------
+# 2. unbounded state
+
+_STATEFUL_JOINS = (JoinNode, IntervalJoinNode, AsofJoinNode, AsofNowJoinNode)
+
+
+@rule("unbounded-state")
+def unbounded_state(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Stateful operators fed by a streaming source with no temporal
+    guard (Forget/Buffer/Freeze from `behavior=`) on the path and no
+    instance cap: their keyed state grows without bound for the lifetime
+    of the run."""
+    for node in facts.order:
+        if not getattr(node, "is_stateful", False):
+            continue
+        if not facts.has_unguarded_streaming_input(node):
+            continue
+        if isinstance(node, GroupByNode):
+            if getattr(node, "_windowed", False):
+                yield Diagnostic(
+                    "unbounded-state",
+                    Severity.INFO,
+                    "windowed aggregation over a streaming source without "
+                    "a temporal behavior: state grows with the number of "
+                    "open windows",
+                    node,
+                    fix_hint="pass behavior=pw.temporal.common_behavior("
+                    "cutoff=...) (or exactly_once_behavior) to windowby "
+                    "so closed windows free their state",
+                )
+            else:
+                yield Diagnostic(
+                    "unbounded-state",
+                    Severity.WARNING,
+                    "groupby over a streaming source holds one aggregate "
+                    "per distinct key forever: state grows without bound",
+                    node,
+                    fix_hint="aggregate inside windowby(...) with a "
+                    "temporal behavior, or bound the input with "
+                    "a Forget (pw.temporal) before grouping",
+                )
+        elif isinstance(node, _STATEFUL_JOINS):
+            yield Diagnostic(
+                "unbounded-state",
+                Severity.WARNING,
+                f"{type(node).__name__.removesuffix('Node')} over a "
+                "streaming source retains every row of both sides "
+                "forever: state grows without bound",
+                node,
+                fix_hint="use asof_now semantics for query streams, add a "
+                "temporal behavior, or bound the inputs with a window",
+            )
+        elif isinstance(node, DeduplicateNode):
+            if node.instance_cols:
+                yield Diagnostic(
+                    "unbounded-state",
+                    Severity.WARNING,
+                    "deduplicate over a streaming source keeps one entry "
+                    "per distinct instance: state grows with instance "
+                    "cardinality",
+                    node,
+                    fix_hint="drop instance= for a single bounded slot, "
+                    "pick a low-cardinality instance, or bound the input "
+                    "temporally",
+                )
+        # other stateful nodes (sort, ix, aligned select, ...) also grow,
+        # but proportionally to the LIVE key set, which retractions bound;
+        # flagging them would be noise
+
+
+# ---------------------------------------------------------------------------
+# 3. universe safety
+
+
+def _rel(a, b) -> str:
+    if a is None or b is None:
+        return "unknown"
+    if a is b:
+        return "equal"
+    if a.is_subset_of(b) or b.is_subset_of(a):
+        return "subset"
+    if a.is_disjoint_from(b):
+        return "disjoint"
+    return "unrelated"
+
+
+@rule("universe-safety")
+def universe_safety(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Re-checks the key-set relations row-aligned operators depend on,
+    surfaced as diagnostics with declaration-site tracebacks instead of
+    the bare build/runtime exceptions."""
+    for node in facts.order:
+        if isinstance(node, RowwiseNode) and len(node.inputs) > 1:
+            primary = getattr(node.inputs[0], "_universe", None)
+            for other in node.inputs[1:]:
+                r = _rel(primary, getattr(other, "_universe", None))
+                if r == "equal":
+                    continue
+                if r == "subset":
+                    yield Diagnostic(
+                        "universe-safety",
+                        Severity.INFO,
+                        "row-aligned expression mixes tables related only "
+                        "by a subset promise; missing keys surface at run "
+                        "time",
+                        node,
+                        fix_hint="verify the promise "
+                        "(promise_is_subset_of / with_universe_of) holds "
+                        "for every input, or join explicitly",
+                    )
+                else:
+                    yield Diagnostic(
+                        "universe-safety",
+                        Severity.ERROR,
+                        "row-aligned expression mixes tables over "
+                        f"{r} universes: rows cannot be matched by key",
+                        node,
+                        fix_hint="use with_universe_of / "
+                        "pw.universes.promise_is_subset_of to assert how "
+                        "the key sets relate, or join the tables instead",
+                    )
+        elif isinstance(node, UniverseSetOpNode) and node.mode == "restrict":
+            if getattr(node, "_intentional_restrict", False):
+                continue  # having(): dropping missing keys IS the point
+            primary = getattr(node.inputs[0], "_universe", None)
+            for other in node.inputs[1:]:
+                r = _rel(primary, getattr(other, "_universe", None))
+                if r in ("equal", "subset"):
+                    continue
+                yield Diagnostic(
+                    "universe-safety",
+                    Severity.WARNING,
+                    "with_universe_of/restrict over universes with no "
+                    "declared relation: rows missing from the target key "
+                    "set silently drop",
+                    node,
+                    fix_hint="promise the subset relation explicitly "
+                    "(pw.universes.promise_is_subset_of) so the intent "
+                    "is checked",
+                )
+        elif isinstance(node, ConcatNode):
+            for i, a in enumerate(node.inputs):
+                ua = getattr(a, "_universe", None)
+                for b in node.inputs[i + 1:]:
+                    r = _rel(ua, getattr(b, "_universe", None))
+                    if r == "disjoint":
+                        yield Diagnostic(
+                            "universe-safety",
+                            Severity.INFO,
+                            "concat relies on a pairwise-disjointness "
+                            "PROMISE; a key collision would only surface "
+                            "at run time",
+                            node,
+                            fix_hint="use concat_reindex to rehash ids "
+                            "if disjointness is not structurally "
+                            "guaranteed",
+                        )
+                    elif r == "unrelated":
+                        yield Diagnostic(
+                            "universe-safety",
+                            Severity.ERROR,
+                            "concat over universes that are not promised "
+                            "disjoint: duplicate keys would collide",
+                            node,
+                            fix_hint="call pw.universes."
+                            "promise_are_pairwise_disjoint first, or use "
+                            "concat_reindex",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# 4. shard safety (the parallel/ layer)
+
+_ORDER_DEPENDENT_REDUCERS = {
+    "stateful": Severity.WARNING,  # arbitrary combine fn: not provably
+    # commutative/associative — cross-shard merge order is unspecified
+    "earliest": Severity.INFO,  # tie order at equal times is
+    "latest": Severity.INFO,  # arrival-dependent across shards
+}
+
+
+@rule("shard-exchange")
+def shard_exchange(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Operators whose key columns force a row exchange when the engine
+    runs sharded (PATHWAY_ENGINE_SHARDS / multi-process DCN)."""
+    for node in facts.order:
+        fx = facts.exchange_edges.get(node.id)
+        if not fx:
+            continue
+        edges = [
+            (
+                label,
+                [
+                    facts.input_column_label(node, k, side)
+                    for k in keys
+                ],
+            )
+            for side, (label, keys) in enumerate(fx)
+        ]
+        desc = "; ".join(
+            f"{label} routed by ({', '.join(keys) or 'id'})"
+            for label, keys in edges
+        )
+        yield Diagnostic(
+            "shard-exchange",
+            Severity.INFO,
+            f"forces a row exchange under sharding: {desc}",
+            node,
+            data={"edges": [keys for _l, keys in edges]},
+        )
+
+
+@rule("shard-nondeterminism")
+def shard_nondeterminism(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Non-deterministic UDFs whose values cross an exchange boundary:
+    re-evaluation on retraction/replay may produce different values on
+    different shards, breaking cross-replica consistency (the EQuARX
+    concern applied to the dataflow layer)."""
+    # nodes downstream of which an exchange occurs
+    exchanging = set(facts.exchange_edges)
+    feeds_exchange: dict[int, bool] = {}
+    for node in reversed(facts.order):
+        feeds_exchange[node.id] = node.id in exchanging or any(
+            feeds_exchange.get(c.id, False)
+            for c in facts.consumers[node.id]
+        )
+    for node in facts.order:
+        if not isinstance(node, RowwiseNode):
+            continue
+        if not feeds_exchange.get(node.id, False):
+            continue
+        bad = []
+        for name, e in node.exprs.items():
+            for a in iter_apply_expressions(e):
+                if a._deterministic is False:
+                    bad.append(
+                        getattr(a, "_udf_name", None) or f"column {name!r}"
+                    )
+        for label in dict.fromkeys(bad):
+            yield Diagnostic(
+                "shard-nondeterminism",
+                Severity.WARNING,
+                f"non-deterministic UDF {label} feeds an exchange "
+                "boundary: retraction replay may route or value rows "
+                "differently across shards",
+                node,
+                fix_hint="declare the UDF deterministic=True if it is, "
+                "or materialize its result before the exchange (e.g. via "
+                "a connector) so every shard sees one value",
+            )
+
+
+@rule("shard-reducer")
+def shard_reducer(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Reducers that are not commutative/associative merge-safe when a
+    groupby runs sharded."""
+    for node in facts.order:
+        if not isinstance(node, GroupByNode):
+            continue
+        for out_col, spec in node.reducer_specs.items():
+            sev = _ORDER_DEPENDENT_REDUCERS.get(spec.kind)
+            if sev is None:
+                # tuple/ndarray without an explicit sort key depend on
+                # arrival order per group
+                if spec.kind in ("tuple", "ndarray") and not node.sort_by:
+                    sev = Severity.INFO
+                else:
+                    continue
+            label = facts.output_column_label(node, out_col)
+            yield Diagnostic(
+                "shard-reducer",
+                sev,
+                f"reducer {spec.kind!r} (column {label!r}) is "
+                "order-dependent: under sharding its result depends on "
+                "per-shard arrival order",
+                node,
+                fix_hint="use a commutative reducer (sum/count/min/max), "
+                "add sort_by= to fix the order, or accept "
+                "run-to-run variation",
+                data={"reducer": spec.kind, "column": label},
+            )
+
+
+# ---------------------------------------------------------------------------
+# 5. graph stats
+
+_STATE_ESTIMATES = {
+    "GroupByNode": "O(distinct groups x reducer state)",
+    "JoinNode": "O(left rows + right rows)",
+    "UpdateRowsNode": "O(rows of both sides)",
+    "SortNode": "O(live rows)",
+    "DeduplicateNode": "O(distinct instances)",
+    "IxNode": "O(rows of both sides)",
+    "UniverseSetOpNode": "O(live rows)",
+    "GradualBroadcastNode": "O(live rows)",
+    "BufferNode": "O(rows held before the watermark)",
+    "ForgetNode": "O(rows inside the retention window)",
+    "RowwiseNode": "O(live rows x inputs)",
+    "IntervalJoinNode": "O(rows inside the interval bounds)",
+    "AsofJoinNode": "O(live rows of both sides)",
+    "AsofNowJoinNode": "O(right rows + emitted matches)",
+    "SessionAssignNode": "O(live rows per instance)",
+}
+
+
+@rule("graph-stats")
+def graph_stats(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """One INFO report: node counts per type, exchange edges, estimated
+    state per stateful operator, streaming/static source split."""
+    from pathway_tpu.parallel import exchange_topology
+
+    counts = Counter(type(n).__name__ for n in facts.order)
+    n_stream = sum(
+        1
+        for n in facts.order
+        if isinstance(n, InputNode) and facts.is_streaming(n)
+    )
+    n_static = sum(1 for n in facts.order if isinstance(n, InputNode)) - (
+        n_stream
+    )
+    stateful = [n for n in facts.order if getattr(n, "is_stateful", False)]
+    topo = exchange_topology()
+    lines = [
+        f"{len(facts.order)} nodes "
+        f"({len(facts.reachable)} reach an output), "
+        f"{n_stream} streaming + {n_static} static sources, "
+        f"{len(stateful)} stateful operators, "
+        f"{sum(len(v) for v in facts.exchange_edges.values())} exchange "
+        f"edges (topology: {topo['engine_shards']} engine shard(s) x "
+        f"{topo['dcn_processes']} process(es))",
+        "node counts: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items())),
+    ]
+    if stateful:
+        lines.append("estimated state:")
+        for n in stateful:
+            est = _STATE_ESTIMATES.get(type(n).__name__, "O(live rows)")
+            lines.append(f"  {n!r}: {est}")
+    yield Diagnostic("graph-stats", Severity.INFO, "\n".join(lines), None)
+
+
+def default_rules() -> dict[str, RuleFn]:
+    return dict(RULES)

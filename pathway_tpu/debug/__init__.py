@@ -861,3 +861,23 @@ def _compute_tables(*tables: Table):
             f"error during computation: {first.get('message', first)!r}"
         )
     return captures
+
+
+def diagnose(*tables: Table, min_severity: str = "info"):
+    """Notebook entry point for the Graph Doctor (pathway_tpu.analysis):
+    print and return the static-analysis report for the pipeline feeding
+    the given table(s) — or the whole declared graph when called with no
+    arguments. Nothing executes; the pass walks the declared nodes only."""
+    from pathway_tpu.analysis import run_doctor
+    from pathway_tpu.analysis.diagnostics import Severity
+    from pathway_tpu.engine.runtime import collect_nodes
+
+    if tables:
+        seeds = [t._node for t in tables]
+        # scope to the upstream cone: a table under diagnosis counts as
+        # consumed, and unrelated parts of the graph stay out of view
+        report = run_doctor(outputs=seeds, all_nodes=collect_nodes(seeds))
+    else:
+        report = run_doctor()
+    print(report.format(min_severity=Severity.parse(min_severity)))
+    return report

@@ -15,6 +15,8 @@ per tick — the microbatch analog of differential dataflow's arrangements
 from __future__ import annotations
 
 import itertools
+import os
+import sys
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
@@ -45,19 +47,53 @@ ALL_NODES: list["Node"] = []  # every node built since the last G.clear()
 # GraphRunner.run_all vs run_outputs, internals/graph_runner/__init__.py)
 
 
+# package root used to find the user frame that declared a node (the
+# first stack frame outside pathway_tpu itself)
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _declaration_frame() -> tuple[str, int, str] | None:
+    """(filename, lineno, function) of the user code declaring a node —
+    the provenance the Graph Doctor attaches to diagnostics (a cheap
+    frame walk, no traceback materialization)."""
+    try:
+        f = sys._getframe(1)
+    except ValueError:  # pragma: no cover
+        return None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_ROOT):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
 class Node:
     """Build-time descriptor."""
+
+    # --- static-analysis metadata (pathway_tpu/analysis) ---------------
+    # Whether the exec keeps keyed state across ticks — drives the Graph
+    # Doctor's unbounded-state and graph-stats rules.
+    is_stateful = False
 
     def __init__(self, inputs: Sequence["Node"], column_names: Sequence[str]):
         self.id = next(_node_counter)
         self.inputs = list(inputs)
         self.column_names = list(column_names)
         self.name = type(self).__name__
+        # declaration-site provenance for diagnostics
+        self.trace = _declaration_frame()
         # error-log scope captured at build time (pw.local_error_log)
         from pathway_tpu.internals.errors import current_build_scope
 
         self._error_scope = current_build_scope()
         ALL_NODES.append(self)
+
+    def key_columns(self) -> tuple[str, ...]:
+        """Input columns that determine keyed-state routing (grouping
+        keys, join keys, dedup instances, ...) — () for stateless or
+        row-key-routed nodes."""
+        return ()
 
     def make_exec(self) -> "NodeExec":
         raise NotImplementedError
@@ -147,6 +183,12 @@ class RowwiseNode(Node):
         super().__init__(inputs, list(exprs.keys()))
         self.exprs = exprs
         self.deterministic = deterministic
+
+    @property
+    def is_stateful(self) -> bool:  # type: ignore[override]
+        # AlignedRowwiseExec keeps per-input multiset state; the
+        # single-input deterministic fast path is a pure streaming map
+        return len(self.inputs) > 1 or not self.deterministic
 
     def make_exec(self):
         if len(self.inputs) == 1 and self.deterministic:
@@ -302,6 +344,8 @@ class ReindexExec(NodeExec):
 class GroupByNode(Node):
     """(reference: group_by_table, src/engine/dataflow.rs:3404)"""
 
+    is_stateful = True
+
     def __init__(
         self,
         input: Node,
@@ -318,6 +362,12 @@ class GroupByNode(Node):
         self.instance_col = instance_col
         self.set_id = set_id
         self.sort_by = sort_by
+
+    def key_columns(self) -> tuple[str, ...]:
+        out = tuple(self.grouping_cols)
+        if self.instance_col:
+            out += (self.instance_col,)
+        return out
 
     def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
@@ -648,6 +698,8 @@ class JoinNode(Node):
     Output columns: left columns as 'l.<name>', right as 'r.<name>', plus
     '_left_id'/'_right_id' pointers (None on the unmatched side)."""
 
+    is_stateful = True
+
     def __init__(
         self,
         left: Node,
@@ -668,6 +720,9 @@ class JoinNode(Node):
         self.right_on = list(right_on)
         self.mode = mode
         self.id_from = id_from
+
+    def key_columns(self) -> tuple[str, ...]:
+        return tuple(self.left_on) + tuple(self.right_on)
 
     def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
@@ -850,9 +905,12 @@ class JoinExec(NodeExec):
         for i in on_idx:
             col = cols[i]
             if col.dtype == object:
-                # vectorized identity-None test (object array == None
-                # compares elementwise by identity for None)
-                m = np.asarray(col == None, dtype=bool)  # noqa: E711
+                # per-element identity test: `col == None` would dispatch
+                # elementwise __eq__, which ndarray values hijack into
+                # arrays ("truth value ... is ambiguous")
+                m = np.fromiter(
+                    (v is None for v in col), dtype=bool, count=len(col)
+                )
                 if not m.any():
                     continue
                 null_rows = m if null_rows is None else (null_rows | m)
@@ -1113,6 +1171,8 @@ class ConcatExec(NodeExec):
 
 
 class UpdateRowsNode(Node):
+    is_stateful = True
+
     def __init__(self, left: Node, right: Node):
         super().__init__([left, right], left.column_names)
 
@@ -1296,10 +1356,18 @@ class SortNode(Node):
     """Incremental prev/next pointers over a sorted order
     (reference: src/engine/dataflow/operators/prev_next.rs)."""
 
+    is_stateful = True
+
     def __init__(self, input: Node, key_col: str, instance_col: str | None):
         super().__init__([input], ["prev", "next"])
         self.key_col = key_col
         self.instance_col = instance_col
+
+    def key_columns(self) -> tuple[str, ...]:
+        out = (self.key_col,)
+        if self.instance_col:
+            out += (self.instance_col,)
+        return out
 
     def _make_local_exec(self):
         from pathway_tpu.parallel.mesh import get_engine_mesh
@@ -1470,6 +1538,8 @@ class GradualBroadcastNode(Node):
     the key space, else lower — so as `value` sweeps lower->upper, rows
     flip individually instead of all at once."""
 
+    is_stateful = True
+
     def __init__(self, data: Node, thr: Node):
         super().__init__([data, thr], ["apx_value"])
 
@@ -1605,6 +1675,8 @@ class GradualBroadcastExec(NodeExec):
 class DeduplicateNode(Node):
     """(reference: deduplicate, src/engine/dataflow.rs:3514)"""
 
+    is_stateful = True
+
     def __init__(
         self,
         input: Node,
@@ -1616,6 +1688,9 @@ class DeduplicateNode(Node):
         self.instance_cols = list(instance_cols)
         self.acceptor = acceptor
         self.value_col = value_col
+
+    def key_columns(self) -> tuple[str, ...]:
+        return tuple(self.instance_cols)
 
     def _make_local_exec(self):
         return DeduplicateExec(self)
@@ -1708,6 +1783,8 @@ class IxNode(Node):
     column of `indexer`; result lives on the indexer's universe
     (reference: Graph::ix / Table.ix, internals/table.py:1164)."""
 
+    is_stateful = True
+
     def __init__(
         self, indexer: Node, ptr_col: str, indexed: Node, optional: bool
     ):
@@ -1797,6 +1874,8 @@ class IxExec(NodeExec):
 class UniverseSetOpNode(Node):
     """restrict / intersect / difference on key sets
     (reference: Graph::restrict_column / intersect_tables / subtract_table)."""
+
+    is_stateful = True
 
     def __init__(self, left: Node, others: Sequence[Node], mode: str):
         super().__init__([left] + list(others), left.column_names)
@@ -1904,6 +1983,8 @@ class BufferNode(Node):
     """Postpone rows until the time column passes a threshold
     (reference: postpone_core, src/engine/dataflow/operators/time_column.rs:248)."""
 
+    is_stateful = True
+
     def __init__(
         self,
         input: Node,
@@ -2005,6 +2086,8 @@ class BufferExec(NodeExec):
 class ForgetNode(Node):
     """Retract rows older than threshold — bounds state
     (reference: TimeColumnForget, time_column.rs:426)."""
+
+    is_stateful = True
 
     def __init__(
         self,

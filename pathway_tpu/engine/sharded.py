@@ -42,6 +42,34 @@ def shard_of(gks: np.ndarray, n_shards: int) -> np.ndarray:
     )).astype(np.int32)
 
 
+def exchange_facts(node: Any) -> list[tuple[str, tuple[str, ...]]]:
+    """Static description of the exchange edges the sharded engine inserts
+    in front of `node`: [(input label, routing key columns)]. Empty for
+    operators that never re-route rows. Consumed by the Graph Doctor's
+    shard-safety and graph-stats rules — kept HERE so the facts stay next
+    to the exec classes that implement the exchanges (a new Sharded*Exec
+    must register its routing contract in the same file)."""
+    from pathway_tpu.engine import nodes as _n
+
+    if isinstance(node, _n.GroupByNode):
+        return [("input", node.key_columns())]
+    if isinstance(node, _n.JoinNode):
+        return [
+            ("left", tuple(node.left_on)),
+            ("right", tuple(node.right_on)),
+        ]
+    if isinstance(node, _n.SortNode):
+        # instance-less sorts are one global order and never shard
+        # (SortNode.make_exec builds a plain SortExec for them)
+        if node.instance_col is not None:
+            return [("input", (node.instance_col,))]
+        return []
+    if isinstance(node, _n.BufferNode):
+        # ShardedBufferExec routes by row key; the watermark is global
+        return [("input", ("id",))]
+    return []
+
+
 def _pack_scalar_column(col: np.ndarray):
     """One numeric device array + rebuild spec for a scalar column, or
     None when ineligible."""

@@ -18,6 +18,7 @@ from typing import Any, Callable, Sequence
 from pathway_tpu.engine.batch import DiffBatch
 from pathway_tpu.engine.nodes import Node, NodeExec, _concat_inputs
 from pathway_tpu.internals.api import Pointer, ref_scalar
+from pathway_tpu.internals.errors import record_error
 
 
 # ---------------------------------------------------------------------------
@@ -34,6 +35,8 @@ class SessionAssignNode(Node):
     "_pw_window_end"]. Incremental: per-instance full restate on touch, diffed
     against previously emitted assignments.
     """
+
+    is_stateful = True
 
     def __init__(
         self,
@@ -253,6 +256,8 @@ class IntervalJoinNode(Node):
     desugared into bucketed equijoins; here a dedicated incremental node).
     """
 
+    is_stateful = True
+
     def __init__(
         self,
         left: Node,
@@ -313,6 +318,8 @@ class AsofJoinNode(Node):
     r.t >= l.t), 'nearest'. mode: left | right | outer — 'outer' emits every
     left row (matched or padded) plus every right row that is nobody's match.
     """
+
+    is_stateful = True
 
     def __init__(
         self,
@@ -456,6 +463,8 @@ class AsofNowJoinNode(Node):
     of use_external_index, src/engine/dataflow.rs:2694). Left retractions do
     retract their previously-emitted results. mode: inner | left."""
 
+    is_stateful = True
+
     def __init__(
         self,
         left: Node,
@@ -519,13 +528,23 @@ class AsofNowJoinExec(NodeExec):
                 use_lk = self.node.id_from == "left"
                 if use_lk and len(rrows) > 1:
                     # id=left.id promises ONE output row per query row; two
-                    # matches would silently collapse under the same key
-                    # (reference: the engine errors on duplicate ids)
-                    raise ValueError(
-                        "asof_now_join with id=pw.left.id: query row "
-                        f"matched {len(rrows)} rows; the id contract "
-                        "requires at most one match per query"
+                    # matches would silently collapse under the same key.
+                    # Recorded (not raised) so non-terminate_on_error runs
+                    # keep going with the row poisoned/skipped, matching
+                    # GroupByExec's reducer-error contract; terminate_on_
+                    # error runs re-raise it as a ValueError when the run
+                    # terminates (like every recorded error, it does not
+                    # abort an unbounded stream mid-run).
+                    record_error(
+                        ValueError(
+                            "asof_now_join with id=pw.left.id: query row "
+                            f"matched {len(rrows)} rows; the id contract "
+                            "requires at most one match per query"
+                        ),
+                        str(self.node),
                     )
+                    self.emitted_by_left[lk] = []
+                    continue
                 if rrows:
                     for rk, (rvals, _c) in rrows.items():
                         okey = lk if use_lk else int(

@@ -376,7 +376,7 @@ class ApplyExpression(ColumnExpression):
         fn: Callable,
         return_type: Any,
         propagate_none: bool,
-        deterministic: bool,
+        deterministic: bool | None,
         args: tuple,
         kwargs: Mapping[str, Any],
         *,
@@ -389,6 +389,9 @@ class ApplyExpression(ColumnExpression):
         self._args = tuple(wrap_expr(a) for a in args)
         self._kwargs = {k: wrap_expr(v) for k, v in kwargs.items()}
         self._max_batch_size = max_batch_size
+        # UDF provenance for diagnostics; pw.udf overrides with the raw
+        # (unwrapped) function's name
+        self._udf_name = getattr(fn, "__name__", None)
 
     @property
     def _children(self):
@@ -396,7 +399,7 @@ class ApplyExpression(ColumnExpression):
 
     def _rebuild(self, children):
         n = len(self._args)
-        return type(self)(
+        out = type(self)(
             self._fn,
             self._return_type,
             self._propagate_none,
@@ -405,6 +408,8 @@ class ApplyExpression(ColumnExpression):
             dict(zip(self._kwargs.keys(), children[n:])),
             max_batch_size=self._max_batch_size,
         )
+        out._udf_name = self._udf_name
+        return out
 
 
 
@@ -636,6 +641,42 @@ class ToStringExpression(ColumnExpression):
 
     def _rebuild(self, children):
         return ToStringExpression(children[0])
+
+
+# ---------------------------------------------------------------------------
+# purity / determinism facts (consumed by pathway_tpu/analysis)
+
+
+def iter_subexpressions(e: ColumnExpression) -> "Iterable[ColumnExpression]":
+    """Depth-first walk over an expression tree (self included)."""
+    yield e
+    for c in e._children:
+        yield from iter_subexpressions(c)
+
+
+def iter_apply_expressions(
+    e: ColumnExpression,
+) -> "Iterable[ApplyExpression]":
+    """Every UDF application (pw.apply / @pw.udf / async variants) inside
+    an expression tree."""
+    for sub in iter_subexpressions(e):
+        if isinstance(sub, ApplyExpression):
+            yield sub
+
+
+def expression_is_deterministic(e: ColumnExpression) -> bool:
+    """True when re-evaluating the expression over the same rows provably
+    yields the same values: every UDF inside is tagged deterministic.
+    Built-in operators and method namespaces are always deterministic."""
+    return all(a._deterministic for a in iter_apply_expressions(e))
+
+
+def expression_is_pure(e: ColumnExpression) -> bool:
+    """True when the expression contains no escape-hatch UDF at all —
+    the engine fully understands its semantics."""
+    for _ in iter_apply_expressions(e):
+        return False
+    return True
 
 
 class MethodCallExpression(ColumnExpression):

@@ -327,6 +327,9 @@ class GroupedTable:
             set_id=self._set_id,
             sort_by="_sortby" if self._sort_by is not None else None,
         )
+        # windowby-built groupbys aggregate windows, not raw groups — the
+        # Graph Doctor's unbounded-state rule treats them differently
+        gb_node._windowed = getattr(self, "_pw_windowed", False)
         env = table._dtype_env()
         gb_dtypes: dict[str, dt.DType] = {}
         for i, g in enumerate(self._grouping):

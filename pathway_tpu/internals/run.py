@@ -18,6 +18,11 @@ class MonitoringLevel:
     ALL = "all"
 
 
+# set by `python -m pathway_tpu.analysis`: the script's pw.run() calls
+# declare the graph but never build a Runtime
+_build_only = False
+
+
 def run(
     *,
     debug: bool = False,
@@ -29,9 +34,18 @@ def run(
     license_key: str | None = None,
     terminate_on_error: bool = True,
     autocommit_duration_ms: int = 50,
+    diagnostics: str | None = None,
     **kwargs: Any,
 ) -> None:
-    """Execute the dataflow declared so far (all registered outputs)."""
+    """Execute the dataflow declared so far (all registered outputs).
+
+    ``diagnostics`` runs the Graph Doctor (pathway_tpu.analysis) over the
+    declared graph before the engine starts: ``"warn"`` logs findings,
+    ``"error"`` raises GraphDoctorError on warning-or-worse findings so
+    not a single batch executes, ``"off"``/None skips the pass.
+    """
+    if _build_only:
+        return
     G = parse_graph.G
     seeds = list(G.outputs)
     if kwargs.pop("_all_nodes", False):
@@ -40,6 +54,10 @@ def run(
         seeds += _nodes.ALL_NODES
     if not seeds:
         return
+    if diagnostics not in (None, "off"):
+        from pathway_tpu.analysis import check_before_run
+
+        check_before_run(seeds, diagnostics)
     # join the process group when `pathway spawn -n N` launched us
     # (reference env contract PATHWAY_PROCESSES/PROCESS_ID, config.rs:88).
     # The engine's multi-process transport is the host mesh (TCP, DCN

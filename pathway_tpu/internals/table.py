@@ -509,6 +509,10 @@ class Table(Joinable):
         self._node = node
         self._schema = schema
         self._universe = universe
+        # static-analysis metadata: the universe this node's rows live on
+        # (pathway_tpu/analysis re-checks universe relations over the
+        # declared graph and surfaces them as diagnostics)
+        node._universe = universe
 
     # --- metadata -------------------------------------------------------------
 
@@ -1143,6 +1147,11 @@ class Table(Joinable):
             tbl = ix.table
             keyed = tbl.with_id(ix)
             node = nodes.UniverseSetOpNode(out._node, [keyed._node], "restrict")
+            # having() IS the sanctioned drop-missing-keys filter (the
+            # result universe stays a subset of self) — the Graph Doctor's
+            # universe-safety rule must not treat it as an unchecked
+            # restrict over unrelated key sets
+            node._intentional_restrict = True
             out = Table(node, out._schema, out._universe.subset())
         return out
 
@@ -1341,14 +1350,17 @@ class Table(Joinable):
 
     def promise_universe_is_subset_of(self, other: "Table") -> "Table":
         self._universe = other._universe.subset()
+        self._node._universe = self._universe
         return self
 
     def promise_universe_is_equal_to(self, other: "Table") -> "Table":
         self._universe = other._universe
+        self._node._universe = self._universe
         return self
 
     def _set_universe(self, universe: Universe) -> "Table":
         self._universe = universe
+        self._node._universe = universe
         return self
 
     # --- temporal ops (stdlib.temporal, reference: Table methods added by

@@ -250,7 +250,7 @@ class UDF:
         self,
         *,
         return_type: Any = None,
-        deterministic: bool = False,
+        deterministic: bool | None = None,
         propagate_none: bool = False,
         executor: Any = None,
         cache_strategy: CacheStrategy | None = None,
@@ -325,7 +325,7 @@ class UDF:
             cls = expr_mod.AsyncApplyExpression
         else:
             cls = expr_mod.ApplyExpression
-        return cls(
+        expr = cls(
             self._fn,
             self._return_type,
             self._propagate_none,
@@ -334,6 +334,17 @@ class UDF:
             kwargs,
             max_batch_size=self._max_batch_size,
         )
+        # provenance for static analysis: which UDF produced this node
+        expr._udf_name = getattr(self._fn_raw, "__name__", None)
+        return expr
+
+    @property
+    def deterministic(self) -> bool | None:
+        """Tri-state determinism declaration: True (re-evaluation under
+        retraction/replay yields identical values), False (explicitly
+        non-deterministic — the Graph Doctor's shard-safety rule flags it
+        when it feeds an exchange boundary), or None (unspecified)."""
+        return self._deterministic
 
 
 def udf(
@@ -341,7 +352,7 @@ def udf(
     /,
     *,
     return_type: Any = None,
-    deterministic: bool = False,
+    deterministic: bool | None = None,
     propagate_none: bool = False,
     executor: Any = None,
     cache_strategy: CacheStrategy | None = None,

@@ -9,6 +9,25 @@ from pathway_tpu.parallel.collectives import (
     sharded_rows,
 )
 
+def exchange_topology() -> dict:
+    """Static facts about the exchange fabric this process would execute
+    with: engine key-shards over the device mesh (ICI rung) and peer
+    processes on the host mesh (DCN rung). Consumed by the Graph Doctor's
+    graph-stats rule; cheap enough to call at graph-build time."""
+    from pathway_tpu.parallel.host_exchange import dcn_active, process_env
+    from pathway_tpu.parallel.mesh import get_engine_mesh
+
+    n_procs, _pid, _port, _host = process_env()
+    em = get_engine_mesh()
+    shards = em[0].shape[em[1]] if em is not None else 1
+    dcn = n_procs if dcn_active() else 1
+    return {
+        "engine_shards": shards,
+        "dcn_processes": dcn,
+        "sharding_active": shards > 1 or dcn > 1,
+    }
+
+
 __all__ = [
     "make_mesh",
     "get_mesh",
@@ -16,4 +35,5 @@ __all__ = [
     "exchange_by_shard",
     "sharded_rows",
     "replicated",
+    "exchange_topology",
 ]

@@ -23,7 +23,9 @@ load, so the mesh authenticates under a per-job shared secret
 launches must export it on every process). The hello is a
 challenge-response (acceptor sends a random nonce, dialer answers with
 an HMAC over it — a captured hello cannot be replayed to frame a peer
-as dead), and every frame MAC covers (src, dst, sequence number, body),
+as dead; the acceptor then returns an authenticated OK so a secret
+mismatch fails fast at dial time), and every frame MAC covers
+(src, dst, sequence number, body),
 so frames cannot be forged, reflected to a different peer, or replayed
 out of order. Unauthenticated bytes are dropped before they ever reach
 pickle.loads. The reference's timely mesh is unauthenticated but
@@ -42,9 +44,16 @@ import threading
 import time
 from typing import Any
 
-_HELLO_MAGIC = b"PWHX3"  # protocol version tag (networking.rs handshake analog)
+_HELLO_MAGIC = b"PWHX4"  # protocol version tag (networking.rs handshake analog)
 _MAC_LEN = 32  # HMAC-SHA256
 _NONCE_LEN = 32
+_OK_TAG = b"PWOK"  # acceptor's authenticated handshake acknowledgment
+# explicit (necessarily unauthenticated — we don't share a key with a
+# mismatched dialer) rejection sentinel: lets the dialer fail fast with
+# an auth diagnosis instead of retrying a close it can't interpret. A
+# forged reject is at worst a startup DoS an on-path attacker could
+# already cause with a TCP reset.
+_REJECT = b"PWNO" + b"\x00" * (_MAC_LEN - 4)
 
 
 def _frame_mac(key: bytes, src: int, dst: int, seq: int, body: bytes) -> bytes:
@@ -154,6 +163,30 @@ class HostMesh:
                 s.sendall(
                     hello + hmac.new(self._key, hello + nonce, "sha256").digest()
                 )
+                # wait for the acceptor's authenticated OK (MAC over its
+                # own nonce + our hello): a PATHWAY_DCN_SECRET mismatch
+                # fails HERE with a clear auth error instead of surfacing
+                # later as a confusing EPIPE on the first large send
+                ok = self._read_exact(s, _MAC_LEN)
+                if ok is None:
+                    # clean close mid-handshake (peer tearing down, or a
+                    # pre-PWHX4 acceptor dropping the unknown magic): a
+                    # retryable startup race, NOT an auth verdict
+                    raise OSError("peer closed during handshake")
+                if ok == _REJECT:
+                    s.close()
+                    raise HostMeshError(
+                        f"process {self.pid}: peer {peer} rejected the "
+                        "handshake — authentication failed (is "
+                        "PATHWAY_DCN_SECRET identical on every process?)"
+                    )
+                expected = hmac.new(
+                    self._key, _OK_TAG + nonce + hello, "sha256"
+                ).digest()
+                if not hmac.compare_digest(ok, expected):
+                    # a garbled (not explicitly rejected) response: treat
+                    # like a transport fault and retry within the deadline
+                    raise OSError("unexpected handshake response")
                 s.settimeout(None)
                 return s
             except OSError as e:
@@ -203,6 +236,10 @@ class HostMesh:
             if not hmac.compare_digest(
                 mac, hmac.new(self._key, claimed + nonce, "sha256").digest()
             ):
+                try:
+                    conn.sendall(_REJECT)
+                except OSError:
+                    pass
                 conn.close()
                 return
             hello_src, dst = struct.unpack(
@@ -213,6 +250,13 @@ class HostMesh:
                 # assigning src — the genuine peer must not be framed dead
                 conn.close()
                 return
+            # authenticated OK: proves to the dialer that WE hold the job
+            # key too (mutual auth) and that its hello was accepted
+            conn.sendall(
+                hmac.new(
+                    self._key, _OK_TAG + nonce + claimed, "sha256"
+                ).digest()
+            )
             src = hello_src
             conn.settimeout(None)
             recv_seq = 0

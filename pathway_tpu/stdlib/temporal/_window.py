@@ -64,6 +64,11 @@ class _WindowedGroupedTable(GroupedTable):
     (reference: windowby reduce latest-reducer warning,
     stdlib/temporal/_window.py)."""
 
+    # the groupby this table builds aggregates WINDOWS, not raw groups —
+    # the Graph Doctor's unbounded-state rule downgrades it (state grows
+    # with open windows; a behavior bounds it fully)
+    _pw_windowed = True
+
     def reduce(self, *args: Any, **kwargs: Any):
         import warnings
 
@@ -472,6 +477,8 @@ class _IntervalsOverGrouped(GroupedTable):
     """GroupedTable for intervals_over: with is_outer=True, probe locations
     with no rows in range still produce an output row with None in every
     non-grouping column (reference: _IntervalsOverWindow, is_outer)."""
+
+    _pw_windowed = True
 
     def __init__(
         self, table, grouping, *, sort_by, window, probes_distinct, has_instance
