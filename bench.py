@@ -24,6 +24,29 @@ import sys
 import time
 
 
+def _free_tcp_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fetch_thread_dump(port: int, timeout: float = 5.0) -> str | None:
+    """GET /debug/threads from a (possibly hung) probe child."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/threads", timeout=timeout
+        ) as resp:
+            return resp.read().decode(errors="replace")
+    except Exception:
+        return None
+
+
 def _probe_platform(
     delays: tuple = (0, 30, 60, 120, 180, 240),
     timeout_s: float = 90.0,
@@ -35,12 +58,54 @@ def _probe_platform(
     rounds 1-3 each lost the hardware headline to a transient tunnel
     outage at probe time). Each attempt's outcome (and stderr tail) is
     appended to `diagnostics` so an outage is diagnosable from the BENCH
-    JSON (VERDICT r4 item 1)."""
+    JSON (VERDICT r4 item 1). The child serves the Flight Recorder debug
+    endpoints on a side port, so a TIMEOUT captures /debug/threads —
+    *where* backend init hung, not just that it did (BENCH_r05 gap)."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         if diagnostics is not None:
             diagnostics.append("JAX_PLATFORMS=cpu pinned; not probing")
         return "cpu"
-    code = "import jax; print(jax.devices()[0].platform)"
+    # Two dump surfaces, armed BEFORE any heavy import (importing
+    # pathway_tpu — or jax — can itself hang in backend init):
+    #  * a stdlib-only /debug/threads twin (observability.debug has the
+    #    full one) for hangs that release the GIL, and
+    #  * faulthandler.dump_traceback_later to argv[2] — its watchdog is a
+    #    C thread, so it fires even when the hang HOLDS the GIL (the axon
+    #    tunnel's C++ rpc does, which freezes every Python thread
+    #    including an HTTP server)
+    code = (
+        "import faulthandler, sys, threading, traceback\n"
+        "from http.server import BaseHTTPRequestHandler, HTTPServer\n"
+        "faulthandler.dump_traceback_later(\n"
+        "    float(sys.argv[3]), file=open(sys.argv[2], 'w'), exit=False)\n"
+        "def _dump():\n"
+        "    frames = sys._current_frames()\n"
+        "    names = {t.ident: t.name for t in threading.enumerate()}\n"
+        "    out = []\n"
+        "    for ident, frame in sorted(frames.items()):\n"
+        "        out.append('--- Thread %r (ident=%s) ---'\n"
+        "                   % (names.get(ident, '?'), ident))\n"
+        "        out.extend(l.rstrip()\n"
+        "                   for l in traceback.format_stack(frame))\n"
+        "    return '\\n'.join(out) + '\\n'\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        body = _dump().encode()\n"
+        "        self.send_response(200)\n"
+        "        self.send_header('Content-Length', str(len(body)))\n"
+        "        self.end_headers()\n"
+        "        self.wfile.write(body)\n"
+        "    def log_message(self, *a):\n"
+        "        pass\n"
+        "try:\n"
+        "    srv = HTTPServer(('127.0.0.1', int(sys.argv[1])), H)\n"
+        "    threading.Thread(target=srv.serve_forever,\n"
+        "                     daemon=True).start()\n"
+        "except Exception:\n"
+        "    pass  # dump surface is best-effort; the probe still runs\n"
+        "import jax\n"
+        "print(jax.devices()[0].platform)\n"
+    )
     # stderr markers of a *failed accelerator init* (worth retrying) vs a
     # box that simply has no accelerator (give up immediately)
     accel_markers = ("tpu", "axon", "rpc", "plugin", "pjrt", "tunnel")
@@ -56,15 +121,62 @@ def _probe_platform(
         stderr = ""
         tag = f"probe {attempt + 1}/{len(delays)}"
         try:
-            out = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
+            import tempfile
+
+            debug_port = _free_tcp_port()
+            dump_fd, dump_path = tempfile.mkstemp(
+                prefix="pathway_probe_threads_", suffix=".txt"
             )
-            stderr = (out.stderr or "").lower()
-            if out.returncode == 0:
-                platform = out.stdout.strip().splitlines()[-1].strip()
+            os.close(dump_fd)
+            # the faulthandler watchdog must fire BEFORE the parent's
+            # kill so the file is complete when we read it
+            dump_after = max(1.0, timeout_s - 10.0)
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-c", code,
+                    str(debug_port), dump_path, str(dump_after),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            try:
+                stdout_text, stderr_text = proc.communicate(
+                    timeout=timeout_s
+                )
+            except subprocess.TimeoutExpired:
+                # the child is STILL ALIVE and hung — ask its debug
+                # server where; if the hang holds the GIL the server
+                # cannot answer, but the faulthandler dump (C watchdog,
+                # no GIL needed) already landed in dump_path
+                dump = _fetch_thread_dump(debug_port)
+                source = "/debug/threads"
+                if not dump:
+                    try:
+                        with open(dump_path) as f:
+                            dump = f.read().strip() or None
+                        source = "faulthandler (GIL-held hang)"
+                    except OSError:
+                        dump = None
+                proc.kill()
+                proc.communicate()
+                note(f"{tag}: TIMEOUT after {timeout_s:.0f}s (hung "
+                     "backend init — the axon tunnel blocks in C++ rpc)")
+                if dump:
+                    note(f"{tag}: hung-probe stack dump via {source} "
+                         f"(tail):\n{dump[-4000:]}")
+                else:
+                    note(f"{tag}: no stack dump captured (child died "
+                         "or hung pre-arm)")
+                continue
+            finally:
+                try:
+                    os.unlink(dump_path)
+                except OSError:
+                    pass
+            stderr = (stderr_text or "").lower()
+            if proc.returncode == 0:
+                platform = stdout_text.strip().splitlines()[-1].strip()
                 if platform and platform != "cpu":
                     note(f"{tag}: OK platform={platform}")
                     return platform
@@ -85,11 +197,8 @@ def _probe_platform(
                 return "cpu"
             else:
                 note(
-                    f"{tag}: exit={out.returncode} stderr={stderr[-200:]}"
+                    f"{tag}: exit={proc.returncode} stderr={stderr[-200:]}"
                 )
-        except subprocess.TimeoutExpired:
-            note(f"{tag}: TIMEOUT after {timeout_s:.0f}s (hung backend "
-                 "init — the axon tunnel blocks in C++ rpc)")
         except Exception as e:
             note(f"{tag}: {type(e).__name__}: {e}")
     note("probe exhausted; falling back to CPU")

@@ -67,6 +67,27 @@ class ExternalIndexExec(NodeExec):
     def __init__(self, node: ExternalIndexNode):
         super().__init__(node)
         self.index: IndexImpl = node.index_factory()
+        # Flight Recorder: end-to-end KNN serving latency (host rows in →
+        # device top-k → host replies), the BASELINE.md "KNN p50" metric,
+        # labeled by index implementation. Prebound once per exec.
+        from pathway_tpu.observability import REGISTRY
+
+        index_label = type(self.index).__name__
+        self._m_query_seconds = REGISTRY.histogram(
+            "pathway_knn_query_seconds",
+            "index search batch latency (all queries of one tick batch)",
+            labelnames=("index",),
+        ).labels(index_label)
+        self._m_queries = REGISTRY.counter(
+            "pathway_knn_queries_total",
+            "queries answered, by index implementation",
+            labelnames=("index",),
+        ).labels(index_label)
+        self._m_updates = REGISTRY.counter(
+            "pathway_knn_index_updates_total",
+            "upserts/removals applied to the index corpus",
+            labelnames=("index",),
+        ).labels(index_label)
         dcols = node.inputs[0].column_names
         qcols = node.inputs[1].column_names
         self.d_data = dcols.index("_data")
@@ -108,11 +129,16 @@ class ExternalIndexExec(NodeExec):
             k = int(vals[self.q_k]) if self.q_k is not None else 3
             flt = vals[self.q_filter] if self.q_filter is not None else None
             triples.append((q, k, flt))
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             results = self.index.search(triples)
         except Exception as exc:
             record_error(exc, str(self.node))
             results = [() for _ in triples]
+        self._m_query_seconds.observe(_time.perf_counter() - t0)
+        self._m_queries.inc(len(triples))
         out = {}
         for (qk, _vals), matches in zip(items, results):
             out[qk] = tuple(
@@ -126,6 +152,7 @@ class ExternalIndexExec(NodeExec):
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 data_changed = True
+                self._m_updates.inc()
                 if d > 0:
                     meta = (
                         vals[self.d_meta] if self.d_meta is not None else None

@@ -333,6 +333,24 @@ class Runtime:
         self._otel_metrics = get_metrics()
         self._otel_on = self._otel_metrics.enabled
         self._node_names = {n.id: type(n).__name__ for n in self.order}
+        # Flight Recorder: per-operator tick-time histogram on the
+        # process-wide registry (labels prebound per node — the per-tick
+        # cost is one lock + bisect; idle autocommit ticks are skipped so
+        # ~0-sample ticks don't swamp the distribution). The `/metrics`
+        # endpoint serves these as pathway_operator_tick_seconds_bucket.
+        from pathway_tpu.observability import REGISTRY
+
+        _tick_hist = REGISTRY.histogram(
+            "pathway_operator_tick_seconds",
+            "per-operator processing time per tick that moved rows, "
+            "by operator type",
+            labelnames=("operator",),
+        )
+        self._tick_hist_children = {
+            n.id: _tick_hist.labels(self._node_names[n.id])
+            for n in self.order
+        }
+        self.http_server = None  # set by start_http_server when attached
         # intra-tick worker parallelism (reference: PATHWAY_THREADS timely
         # workers, src/engine/dataflow/config.rs:63-86): independent nodes
         # of one topo level process concurrently on a thread pool. Each
@@ -399,12 +417,14 @@ class Runtime:
             stats.node_rows[node.id] = stats.node_rows.get(node.id, 0) + nrows
         node_ns = _time.perf_counter_ns() - t0
         stats.node_ns[node.id] = stats.node_ns.get(node.id, 0) + node_ns
-        if self._otel_on and (nrows or any(inputs)):
+        if nrows or any(inputs):
             # only ticks that did work: idle 50 ms autocommit ticks
             # would swamp the latency distribution with ~0 samples
-            self._otel_metrics.record_operator_latency(
-                self._node_names[node.id], node_ns
-            )
+            self._tick_hist_children[node.id].observe(node_ns / 1e9)
+            if self._otel_on:
+                self._otel_metrics.record_operator_latency(
+                    self._node_names[node.id], node_ns
+                )
         if isinstance(ex, InputExec) and nrows:
             stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
 

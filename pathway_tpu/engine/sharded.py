@@ -177,6 +177,26 @@ class _ShardRouter:
         self.axis = axis
         self.n_shards = mesh.shape[axis]
         self.device_exchanges = 0  # observability: collectives actually run
+        # Flight Recorder: rows routed per destination shard (the `shard`
+        # label lets a multi-host Prometheus aggregate skew across the
+        # whole mesh) + which transport carried them. Children prebound —
+        # routing is on the per-batch hot path.
+        from pathway_tpu.observability import REGISTRY
+
+        rows = REGISTRY.counter(
+            "pathway_shard_rows_total",
+            "rows routed to each shard by the sharded-exec exchange",
+            labelnames=("shard",),
+        )
+        self._m_shard_rows = [
+            rows.labels(str(s)) for s in range(self.n_shards)
+        ]
+        self._m_exchanges = REGISTRY.counter(
+            "pathway_shard_exchanges_total",
+            "exchange batches, by transport (device=lax.all_to_all over "
+            "ICI, host=numpy partition)",
+            labelnames=("transport",),
+        )
 
     def route(
         self, b: DiffBatch, dest: np.ndarray
@@ -188,8 +208,15 @@ class _ShardRouter:
             else None
         )
         if numeric is not None:
-            return self._route_device(b, dest, numeric)
-        return self._route_host(b, dest)
+            out = self._route_device(b, dest, numeric)
+            self._m_exchanges.labels("device").inc()
+        else:
+            out = self._route_host(b, dest)
+            self._m_exchanges.labels("host").inc()
+        for s, sub in enumerate(out):
+            if sub is not None:
+                self._m_shard_rows[s].inc(len(sub))
+        return out
 
     def _route_host(self, b, dest):
         out: list[DiffBatch | None] = [None] * self.n_shards

@@ -1,101 +1,294 @@
-"""Prometheus/OpenMetrics monitoring endpoint.
+"""Prometheus/OpenMetrics monitoring endpoint + debug surfaces.
 
 TPU-native equivalent of the reference's per-process metrics server
 (reference: src/engine/http_server.rs:21-90 — OpenMetrics endpoint at port
-20000 + process_id with input/output latency gauges). Serves the Runtime's
-prober counters (RuntimeStats) in Prometheus text exposition format at
-`/metrics` (and `/status` as JSON).
+20000 + process_id with input/output latency gauges), rebuilt on the
+Flight Recorder registry (pathway_tpu/observability): ``/metrics`` renders
+the process-wide MetricsRegistry (runtime counters are promoted onto it
+at scrape time), and three debug endpoints answer the questions the
+BENCH_r05 hung-probe investigation couldn't: ``/debug/threads``
+(all-thread stack dump), ``/debug/graph`` (per-node rows/ns/backlog as
+JSON), ``/debug/profile?seconds=N`` (on-demand jax profiler trace).
+
+Bind host comes from PATHWAY_MONITORING_HOST (default 127.0.0.1 — set
+0.0.0.0 for multi-host scrape); a taken port falls back to an ephemeral
+one with a logged warning instead of crashing the run.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pathway_tpu.observability import (
+    REGISTRY,
+    ProfilerUnavailable,
+    graph_table,
+    install_jax_metrics,
+    take_profile,
+    thread_stack_dump,
+)
+from pathway_tpu.observability.registry import MetricsRegistry
 
 BASE_PORT = 20000
 
+logger = logging.getLogger("pathway_tpu")
+
+
+def _monitoring_host() -> str:
+    return os.environ.get("PATHWAY_MONITORING_HOST", "127.0.0.1")
+
+
+class _RuntimeBridge:
+    """Promotes RuntimeStats raw dicts onto the registry at scrape time
+    (pull-based: the tick loop never pays for metric formatting). Node ids
+    are process-unique, so per-node series from earlier runtimes stay
+    monotone; whole-runtime counters (ticks) roll retired runtimes into a
+    base so the process counter never goes backward."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._runtime: weakref.ref | None = None
+        self._names: dict[int, str] = {}
+        self._ticks_base = 0
+        self._last_ticks = 0
+        g, c = registry.gauge, registry.counter
+        self.m_ticks = c("pathway_ticks_total", "engine ticks processed")
+        self.m_logical_time = g(
+            "pathway_logical_time", "current logical time (ms clock)"
+        )
+        self.m_last_tick = g(
+            "pathway_last_tick_seconds", "duration of the last tick"
+        )
+        self.m_frontier_lag = g(
+            "pathway_frontier_lag_ms",
+            "wall clock minus logical frontier (streaming mode only)",
+        )
+        self.m_cpu = c(
+            "pathway_process_cpu_seconds_total", "process CPU time"
+        )
+        self.m_rss = g(
+            "pathway_process_memory_rss_bytes", "resident set size"
+        )
+        self.m_rows_in = c(
+            "pathway_input_rows_total", "rows ingested per input node",
+            ("node",),
+        )
+        self.m_rows_out = c(
+            "pathway_output_rows_total", "rows emitted per output node",
+            ("node",),
+        )
+        self.m_node_rows = c(
+            "pathway_operator_rows_total", "rows produced per node",
+            ("node",),
+        )
+        self.m_node_seconds = c(
+            "pathway_operator_seconds_total",
+            "cumulative processing time per node",
+            ("node",),
+        )
+        registry.register_collector(self.collect)
+
+    def attach(self, runtime) -> None:
+        with self._lock:
+            old = self._runtime() if self._runtime is not None else None
+            if old is runtime:
+                return
+            if old is not None:
+                self._ticks_base += old.stats.ticks
+            elif self._runtime is not None:
+                # previous runtime was GC'd: fold in its last-seen count
+                self._ticks_base += self._last_ticks
+            self._last_ticks = 0
+            self._runtime = weakref.ref(runtime)
+            self._names = {
+                n.id: f"{n.name}_{n.id}" for n in runtime.order
+            }
+
+    def collect(self) -> None:
+        import time as _time
+
+        from pathway_tpu.internals.telemetry import process_gauges
+
+        gauges = process_gauges()
+        self.m_cpu._unlabeled().set_total(
+            gauges["process_cpu_seconds_total"]
+        )
+        self.m_rss.set(gauges["process_memory_rss_bytes"])
+        with self._lock:
+            runtime = self._runtime() if self._runtime is not None else None
+            names = self._names
+            base = self._ticks_base
+        if runtime is None:
+            self.m_ticks._unlabeled().set_total(base + self._last_ticks)
+            return
+        s = runtime.stats
+        with self._lock:
+            self._last_ticks = s.ticks
+        self.m_ticks._unlabeled().set_total(base + s.ticks)
+        self.m_logical_time.set(s.current_time)
+        self.m_last_tick.set(s.last_tick_ns / 1e9)
+        # frontier lag vs wall clock — the reference's input/output latency
+        # gauges (http_server.rs:25-90). Only meaningful when logical times
+        # ARE wall-clock ms (streaming mode); static runs with explicit
+        # small event times would otherwise report a multi-decade "lag"
+        now_ms = _time.time() * 1000.0
+        week_ms = 7 * 86400 * 1000.0
+        if 0 < s.current_time <= now_ms and now_ms - s.current_time < week_ms:
+            self.m_frontier_lag.set(now_ms - s.current_time)
+        else:
+            self.m_frontier_lag.set(0.0)
+        for metric, data in (
+            (self.m_rows_in, s.rows_in),
+            (self.m_rows_out, s.rows_out),
+            (self.m_node_rows, s.node_rows),
+        ):
+            for nid, v in data.items():
+                metric.labels(names.get(nid, str(nid))).set_total(v)
+        for nid, v in s.node_ns.items():
+            self.m_node_seconds.labels(
+                names.get(nid, str(nid))
+            ).set_total(v / 1e9)
+
+
+_bridge: _RuntimeBridge | None = None
+_bridge_lock = threading.Lock()
+
+
+def _ensure_bridge() -> _RuntimeBridge:
+    global _bridge
+    with _bridge_lock:
+        if _bridge is None:
+            _bridge = _RuntimeBridge(REGISTRY)
+        return _bridge
+
 
 def _render_metrics(runtime) -> str:
-    import time as _time
-
-    from pathway_tpu.internals.telemetry import process_gauges
-
-    s = runtime.stats
-    gauges = process_gauges()
-    # frontier lag vs wall clock — the reference's input/output latency
-    # gauges (http_server.rs:25-90). Only meaningful when logical times
-    # ARE wall-clock ms (streaming mode); static runs with explicit small
-    # event times would otherwise report a multi-decade "lag"
-    now_ms = _time.time() * 1000.0
-    week_ms = 7 * 86400 * 1000.0
-    if 0 < s.current_time <= now_ms and now_ms - s.current_time < week_ms:
-        lag_ms = now_ms - s.current_time
-    else:
-        lag_ms = 0.0
-    lines = [
-        "# TYPE pathway_ticks_total counter",
-        f"pathway_ticks_total {s.ticks}",
-        "# TYPE pathway_logical_time gauge",
-        f"pathway_logical_time {s.current_time}",
-        "# TYPE pathway_last_tick_seconds gauge",
-        f"pathway_last_tick_seconds {s.last_tick_ns / 1e9}",
-        "# TYPE pathway_frontier_lag_ms gauge",
-        f"pathway_frontier_lag_ms {lag_ms}",
-        "# TYPE pathway_process_cpu_seconds_total counter",
-        f"pathway_process_cpu_seconds_total {gauges['process_cpu_seconds_total']}",
-        "# TYPE pathway_process_memory_rss_bytes gauge",
-        f"pathway_process_memory_rss_bytes {gauges['process_memory_rss_bytes']}",
-        "# TYPE pathway_input_rows_total counter",
-        "# TYPE pathway_output_rows_total counter",
-        "# TYPE pathway_operator_rows_total counter",
-        "# TYPE pathway_operator_seconds_total counter",
-    ]
-    names = {n.id: f"{n.name}_{n.id}" for n in runtime.order}
-    for nid, v in sorted(s.rows_in.items()):
-        lines.append(f'pathway_input_rows_total{{node="{names.get(nid, nid)}"}} {v}')
-    for nid, v in sorted(s.rows_out.items()):
-        lines.append(f'pathway_output_rows_total{{node="{names.get(nid, nid)}"}} {v}')
-    for nid, v in sorted(s.node_rows.items()):
-        lines.append(
-            f'pathway_operator_rows_total{{node="{names.get(nid, nid)}"}} {v}'
-        )
-    for nid, v in sorted(s.node_ns.items()):
-        lines.append(
-            f'pathway_operator_seconds_total{{node="{names.get(nid, nid)}"}} {v / 1e9}'
-        )
-    return "\n".join(lines) + "\n"
+    """Render the registry with `runtime`'s stats promoted onto it
+    (kept as the model for tests and the TUI; the HTTP handler calls the
+    same path)."""
+    bridge = _ensure_bridge()
+    if runtime is not None:
+        bridge.attach(runtime)
+    install_jax_metrics(REGISTRY)
+    return REGISTRY.render()
 
 
-def start_http_server(runtime, port: int | None = None) -> ThreadingHTTPServer:
-    """Start the metrics endpoint in a daemon thread; returns the server."""
+def start_http_server(
+    runtime=None, port: int | None = None, host: str | None = None
+) -> ThreadingHTTPServer:
+    """Start the metrics/debug endpoint in a daemon thread; returns the
+    server (``server.server_address`` carries the actual bound port).
+    ``runtime=None`` serves registry metrics and debug surfaces only —
+    bench probes use that standalone mode."""
     if port is None:
         process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
         port = BASE_PORT + process_id
+    if host is None:
+        host = _monitoring_host()
+    bridge = _ensure_bridge()
+    if runtime is not None:
+        bridge.attach(runtime)
+    install_jax_metrics(REGISTRY)
 
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802
-            if self.path.rstrip("/") in ("", "/metrics"):
-                body = _render_metrics(runtime).encode()
-                ctype = "text/plain; version=0.0.4"
-            elif self.path.rstrip("/") == "/status":
-                body = json.dumps(runtime.stats.snapshot()).encode()
-                ctype = "application/json"
-            else:
-                self.send_response(404)
-                self.end_headers()
-                return
-            self.send_response(200)
+        def _reply(
+            self, code: int, body: bytes, ctype: str = "text/plain"
+        ) -> None:
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
+        def do_GET(self):  # noqa: N802
+            parsed = urlparse(self.path)
+            route = parsed.path.rstrip("/")
+            try:
+                if route in ("", "/metrics"):
+                    self._reply(
+                        200,
+                        _render_metrics(runtime).encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif route == "/status":
+                    snap = (
+                        runtime.stats.snapshot()
+                        if runtime is not None
+                        else {}
+                    )
+                    self._reply(
+                        200, json.dumps(snap).encode(), "application/json"
+                    )
+                elif route == "/debug/threads":
+                    self._reply(200, thread_stack_dump().encode())
+                elif route == "/debug/graph":
+                    self._reply(
+                        200,
+                        json.dumps(graph_table(runtime)).encode(),
+                        "application/json",
+                    )
+                elif route == "/debug/profile":
+                    self._profile(parse_qs(parsed.query))
+                else:
+                    self._reply(404, b"not found")
+            except BrokenPipeError:
+                pass
+            except Exception as exc:  # a broken page must not kill serving
+                try:
+                    self._reply(
+                        500, f"internal error: {exc}".encode()
+                    )
+                except Exception:
+                    pass
+
+        def _profile(self, query: dict) -> None:
+            try:
+                seconds = float(query.get("seconds", ["1.0"])[0])
+            except ValueError:
+                self._reply(400, b"seconds must be a number")
+                return
+            try:
+                trace_dir = take_profile(seconds)
+            except ProfilerUnavailable as exc:
+                self._reply(501, str(exc).encode())
+                return
+            except ValueError as exc:
+                self._reply(400, str(exc).encode())
+                return
+            except RuntimeError as exc:
+                self._reply(409, str(exc).encode())
+                return
+            self._reply(
+                200,
+                json.dumps(
+                    {"trace_dir": trace_dir, "seconds": seconds}
+                ).encode(),
+                "application/json",
+            )
+
         def log_message(self, *args):
             pass
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    try:
+        server = ThreadingHTTPServer((host, port), Handler)
+    except OSError as exc:
+        # the requested port is taken (common when several runs share a
+        # box): fall back to an ephemeral port instead of crashing the run
+        server = ThreadingHTTPServer((host, 0), Handler)
+        logger.warning(
+            "monitoring port %s:%d unavailable (%s); serving metrics on "
+            "ephemeral port %d instead",
+            host, port, exc, server.server_address[1],
+        )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
+    if runtime is not None:
+        runtime.http_server = server
     return server

@@ -169,6 +169,26 @@ class RestServerSubject(ConnectorSubject):
         self._delete_completed = delete_completed_queries
         self._futures: dict[int, asyncio.Future] = {}
         self._futures_lock = threading.Lock()
+        # Flight Recorder: serving-path latency, request-in to
+        # response-out (covers the whole dataflow round trip, which is
+        # what a client experiences), labeled by route
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_seconds = REGISTRY.histogram(
+            "pathway_rest_request_seconds",
+            "REST request latency: ingestion to delivered response",
+            labelnames=("route",),
+        ).labels(route)
+        self._m_requests = REGISTRY.counter(
+            "pathway_rest_requests_total",
+            "REST requests served, by route/method/status",
+            labelnames=("route", "method", "status"),
+        )
+        self._m_inflight = REGISTRY.gauge(
+            "pathway_rest_inflight_requests",
+            "requests currently awaiting their dataflow result",
+            labelnames=("route",),
+        ).labels(route)
         webserver._register_endpoint(
             route, self._handle, methods, schema, documentation
         )
@@ -181,6 +201,26 @@ class RestServerSubject(ConnectorSubject):
         threading.Event().wait()
 
     async def _handle(self, request: web.Request) -> web.Response:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._m_inflight.inc()
+        try:
+            response = await self._handle_inner(request)
+        except Exception:
+            self._m_requests.labels(
+                self._route, request.method, "500"
+            ).inc()
+            raise
+        finally:
+            self._m_inflight.dec()
+            self._m_seconds.observe(_time.perf_counter() - t0)
+        self._m_requests.labels(
+            self._route, request.method, str(response.status)
+        ).inc()
+        return response
+
+    async def _handle_inner(self, request: web.Request) -> web.Response:
         rid = uuid.uuid4().hex
         key = int(ref_scalar(rid))
         if self._format == "raw":

@@ -1,0 +1,52 @@
+"""Flight Recorder — process-wide observability for pathway_tpu.
+
+One registry (``REGISTRY``) collects counters/gauges/histograms from
+every layer (engine tick loop, KNN serving, embedder batches, REST
+handlers, host exchange, sharded routing); the monitoring server
+(internals/monitoring_server.py) renders it at ``/metrics`` and serves
+the debug surfaces (``/debug/threads``, ``/debug/graph``,
+``/debug/profile``). See README "Observability" for the metric
+inventory and scrape config.
+"""
+
+from pathway_tpu.observability.registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    get_registry,
+    log_linear_buckets,
+    sanitize_metric_name,
+)
+from pathway_tpu.observability.exposition import (
+    parse_exposition,
+    validate_exposition,
+)
+from pathway_tpu.observability.debug import (
+    ProfilerUnavailable,
+    graph_table,
+    take_profile,
+    thread_stack_dump,
+)
+from pathway_tpu.observability.jax_metrics import install_jax_metrics
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ProfilerUnavailable",
+    "escape_label_value",
+    "get_registry",
+    "graph_table",
+    "install_jax_metrics",
+    "log_linear_buckets",
+    "parse_exposition",
+    "sanitize_metric_name",
+    "take_profile",
+    "thread_stack_dump",
+    "validate_exposition",
+]

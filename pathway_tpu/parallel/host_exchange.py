@@ -113,6 +113,41 @@ class HostMesh:
         self.base_port = base_port
         self.host = host
         self._key = _job_key()
+        # Flight Recorder: DCN traffic accounting. Peer cardinality is the
+        # process-group size (small); every process also exposes its own
+        # id via the `process` label on pathway_build_info-adjacent scrape
+        # configs, so multi-host dashboards aggregate by (job, process).
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_sent_bytes = REGISTRY.counter(
+            "pathway_host_exchange_sent_bytes_total",
+            "framed bytes sent over the host mesh, by destination peer",
+            labelnames=("peer",),
+        )
+        self._m_sent_msgs = REGISTRY.counter(
+            "pathway_host_exchange_sent_messages_total",
+            "frames sent over the host mesh, by destination peer",
+            labelnames=("peer",),
+        )
+        self._m_recv_bytes = REGISTRY.counter(
+            "pathway_host_exchange_recv_bytes_total",
+            "framed bytes received over the host mesh, by source peer",
+            labelnames=("peer",),
+        )
+        self._m_recv_msgs = REGISTRY.counter(
+            "pathway_host_exchange_recv_messages_total",
+            "frames received over the host mesh, by source peer",
+            labelnames=("peer",),
+        )
+        self._m_barrier_seconds = REGISTRY.histogram(
+            "pathway_host_exchange_barrier_seconds",
+            "barrier round-trip: send-to-all until all peers' values "
+            "arrive (the lockstep tick scheduler's cadence)",
+        )
+        self._m_gather_seconds = REGISTRY.histogram(
+            "pathway_host_exchange_gather_seconds",
+            "wait for one payload from every peer on a data channel",
+        )
         self._cv = threading.Condition()
         # (channel, tick) -> {src: payload}
         self._data: dict[tuple[str, int], dict[int, Any]] = {}
@@ -273,6 +308,8 @@ class HostMesh:
                 ):
                     break  # forged/reflected/replayed frame: drop the link
                 recv_seq += 1
+                self._m_recv_bytes.labels(str(src)).inc(len(head) + len(body))
+                self._m_recv_msgs.labels(str(src)).inc()
                 frame = pickle.loads(body)
                 kind = frame[0]
                 with self._cv:
@@ -306,6 +343,8 @@ class HostMesh:
                 self._send_seq[dst] += 1
                 msg = struct.pack("<I", len(body)) + mac + body
                 self._out[dst].sendall(msg)
+            self._m_sent_bytes.labels(str(dst)).inc(len(msg))
+            self._m_sent_msgs.labels(str(dst)).inc()
         except OSError as e:
             raise HostMeshError(
                 f"process {self.pid}: send to peer {dst} failed ({e})"
@@ -319,12 +358,16 @@ class HostMesh:
     ) -> dict[int, Any]:
         """Wait for one payload from every other process on (channel, tick)."""
         want = self.n - 1
+        t0 = time.perf_counter()
         deadline = time.time() + timeout
         key = (channel, tick)
         with self._cv:
             while True:
                 got = self._data.get(key, {})
                 if len(got) >= want:
+                    self._m_gather_seconds.observe(
+                        time.perf_counter() - t0
+                    )
                     return self._data.pop(key)
                 if self._dead:
                     missing = set(range(self.n)) - {self.pid} - set(got)
@@ -348,6 +391,7 @@ class HostMesh:
         internal round counter is the channel."""
         rnd = self._round
         self._round += 1
+        t0 = time.perf_counter()
         for peer in range(self.n):
             if peer != self.pid:
                 self._send_frame(peer, ("bar", self.pid, rnd, value))
@@ -359,6 +403,9 @@ class HostMesh:
                 if len(got) >= want:
                     out = self._bars.pop(rnd)
                     out[self.pid] = value
+                    self._m_barrier_seconds.observe(
+                        time.perf_counter() - t0
+                    )
                     return out
                 if self._dead:
                     missing = set(range(self.n)) - {self.pid} - set(got)

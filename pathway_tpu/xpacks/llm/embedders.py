@@ -131,14 +131,52 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         )
         self.model = model
         self.kwargs = call_kwargs
+        # Flight Recorder: embed batch latency + the BASELINE.md
+        # docs/sec/chip figure, measured where the work happens instead of
+        # reconstructed by bench.py from the outside
+        from pathway_tpu.observability import REGISTRY
+
+        m_batch_seconds = REGISTRY.histogram(
+            "pathway_embed_batch_seconds",
+            "embedder batch latency (tokenize + device forward)",
+            labelnames=("model",),
+        ).labels(model)
+        m_docs = REGISTRY.counter(
+            "pathway_embed_docs_total",
+            "documents embedded",
+            labelnames=("model",),
+        ).labels(model)
+        m_rate = REGISTRY.gauge(
+            "pathway_embed_docs_per_sec_per_chip",
+            "throughput of the most recent embed batch, per local device",
+            labelnames=("model",),
+        ).labels(model)
+        chips: list[int] = []  # resolved after the first forward
 
         def embed_batch(texts: Sequence[str]) -> list[np.ndarray]:
+            import time as _time
+
+            t0 = _time.perf_counter()
             ids, mask = self.tokenizer.encode_batch(
                 # runtime.max_len is clamped to the checkpoint's position
                 # table; exceeding it would silently clamp position ids
                 [str(t) for t in texts], self.runtime.max_len
             )
             out = self.runtime.forward_ids(ids, mask)
+            dt = _time.perf_counter() - t0
+            m_batch_seconds.observe(dt)
+            m_docs.inc(len(texts))
+            if not chips:
+                # forward_ids just used the backend, so counting devices
+                # cannot trigger a fresh (possibly hanging) backend init
+                try:
+                    import jax
+
+                    chips.append(max(1, jax.local_device_count()))
+                except Exception:
+                    chips.append(1)
+            if dt > 0:
+                m_rate.set(len(texts) / dt / chips[0])
             return [out[i] for i in range(len(texts))]
 
         self._embed_batch = embed_batch

@@ -75,6 +75,24 @@ class VectorStoreServer:
             except Exception:
                 self.embedding_dimension = None
         self._index_params = index_params or {}
+        # Flight Recorder: document-pipeline + retrieval serving metrics
+        # (REST transport latency is measured in io/http; these cover the
+        # store-specific stages)
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_chunks = REGISTRY.counter(
+            "pathway_vector_store_chunks_total",
+            "chunks produced by the split stage (pre-embedding)",
+        )
+        self._m_retrievals = REGISTRY.counter(
+            "pathway_vector_store_retrievals_total",
+            "retrieve queries formatted",
+        )
+        self._m_results = REGISTRY.histogram(
+            "pathway_vector_store_result_docs",
+            "documents returned per retrieve query",
+            buckets=(0, 1, 2, 3, 5, 10, 20, 50, 100),
+        )
         self._graph = self._build_graph()
 
     # --- document pipeline ---------------------------------------------------
@@ -141,6 +159,8 @@ class VectorStoreServer:
 
             splitter = NullSplitter()
 
+        m_chunks = self._m_chunks
+
         def split_doc(data_json: Json) -> list:
             d = data_json.value
             fn = splitter.func if hasattr(splitter, "func") else splitter
@@ -151,6 +171,7 @@ class VectorStoreServer:
                 out.append(
                     Json({"text": text, "metadata": {**d["metadata"], **meta}})
                 )
+            m_chunks.inc(len(out))
             return out
 
         chunked = parsed.select(
@@ -270,6 +291,8 @@ class VectorStoreServer:
             scores=right[_SCORE],
         )
 
+        m_retrievals, m_results = self._m_retrievals, self._m_results
+
         def fmt(texts, metas, scores) -> Json:
             out = []
             if texts is not None:
@@ -282,6 +305,8 @@ class VectorStoreServer:
                             "dist": -float(s),
                         }
                     )
+            m_retrievals.inc()
+            m_results.observe(len(out))
             return Json(out)
 
         return raw.select(
