@@ -863,6 +863,51 @@ def _compute_tables(*tables: Table):
     return captures
 
 
+def trace(seconds: float | None = None, path: Any = None) -> dict:
+    """Notebook entry point for the Trace Weaver
+    (pathway_tpu/observability/tracing.py): return the recorded span ring
+    as a Chrome trace-event document — the same body the monitoring
+    server serves at ``/debug/trace``. Pass ``path`` to also write it to
+    a file you can drag into Perfetto (ui.perfetto.dev)."""
+    import json as _json
+
+    from pathway_tpu.observability.tracing import get_tracer
+
+    doc = get_tracer().chrome_trace(seconds=seconds)
+    if path is not None:
+        with open(path, "w") as f:
+            _json.dump(doc, f)
+    return doc
+
+
+def trace_tree(
+    trace_id: str | None = None, seconds: float | None = None
+) -> str:
+    """Human-readable parent/child breakdown of one trace (default: the
+    most recently finished root span's trace). Prints and returns it."""
+    from pathway_tpu.observability.tracing import get_tracer
+
+    tracer = get_tracer()
+    if trace_id is None:
+        recs = tracer.spans(seconds)
+        span_ids = {r.span_id for r in recs}
+        # local roots: no parent, OR a parent that lives outside this
+        # ring (a request that joined a caller's trace via traceparent)
+        roots = [
+            r
+            for r in recs
+            if r.parent_id is None or r.parent_id not in span_ids
+        ]
+        if not roots:
+            out = "(no root spans recorded)"
+            print(out)
+            return out
+        trace_id = roots[-1].trace_id
+    out = tracer.format_tree(trace_id, seconds)
+    print(out)
+    return out
+
+
 def diagnose(*tables: Table, min_severity: str = "info"):
     """Notebook entry point for the Graph Doctor (pathway_tpu.analysis):
     print and return the static-analysis report for the pipeline feeding

@@ -29,6 +29,7 @@ class _DcnRouter:
     host mesh; merge arrivals in process-id order (deterministic)."""
 
     def __init__(self, channel: str):
+        from pathway_tpu.observability.tracing import get_tracer
         from pathway_tpu.parallel.host_exchange import get_host_mesh
 
         self.mesh = get_host_mesh()
@@ -36,6 +37,7 @@ class _DcnRouter:
         self.n = self.mesh.n
         self.pid = self.mesh.pid
         self.exchanges = 0  # observability, mirrors _ShardRouter counter
+        self._tracer = get_tracer()
 
     def partition(
         self, batches: Sequence[DiffBatch], dests_fn
@@ -51,6 +53,25 @@ class _DcnRouter:
                     parts[p].append(b if m.all() else b.mask(m))
         return parts
 
+    def _all_to_all(self, span_name: str, t: int, payload_for) -> dict:
+        """Traced send-to-all + gather: the wire hop — frames carry this
+        span's traceparent (host_exchange stamps every frame); the lowest
+        received remote traceparent is attached so a cross-process trace
+        is inspectable from either side."""
+        with self._tracer.span(
+            span_name, channel=self.channel, tick=t
+        ) as sp:
+            for p in range(self.n):
+                if p != self.pid:
+                    self.mesh.send(p, self.channel, t, payload_for(p))
+            got = self.mesh.gather(self.channel, t)
+            remote = self.mesh.take_gather_tps(self.channel, t)
+            if remote:
+                sp.set_attribute(
+                    "remote_traceparent", remote[min(remote)]
+                )
+        return got
+
     def exchange_keep_src(
         self, t: int, parts: list[list[DiffBatch]]
     ) -> list[tuple[int, list[DiffBatch]]]:
@@ -59,10 +80,7 @@ class _DcnRouter:
         so order-sensitive state (last-write-wins triplets, acceptors)
         agrees group-wide. The src tags let ops route results back home."""
         self.exchanges += 1
-        for p in range(self.n):
-            if p != self.pid:
-                self.mesh.send(p, self.channel, t, parts[p])
-        got = self.mesh.gather(self.channel, t)
+        got = self._all_to_all("dcn.exchange", t, lambda p: parts[p])
         return [
             (p, parts[p] if p == self.pid else got.get(p, []))
             for p in range(self.n)
@@ -78,10 +96,7 @@ class _DcnRouter:
     def exchange_scalar(self, t: int, value: Any) -> list[Any]:
         """All-gather one picklable value per process (pid order)."""
         self.exchanges += 1
-        for p in range(self.n):
-            if p != self.pid:
-                self.mesh.send(p, self.channel, t, value)
-        got = self.mesh.gather(self.channel, t)
+        got = self._all_to_all("dcn.exchange_scalar", t, lambda p: value)
         got[self.pid] = value
         return [got[p] for p in sorted(got)]
 

@@ -131,13 +131,24 @@ class ExternalIndexExec(NodeExec):
             triples.append((q, k, flt))
         import time as _time
 
-        t0 = _time.perf_counter()
-        try:
-            results = self.index.search(triples)
-        except Exception as exc:
-            record_error(exc, str(self.node))
-            results = [() for _ in triples]
-        self._m_query_seconds.observe(_time.perf_counter() - t0)
+        from pathway_tpu.observability.tracing import get_tracer
+
+        # Trace Weaver: the device top-k child span — with the embed and
+        # HTTP spans this completes the per-request serving breakdown
+        with get_tracer().span(
+            "knn.search",
+            index=type(self.index).__name__,
+            queries=len(triples),
+        ) as sp:
+            t0 = _time.perf_counter()
+            try:
+                results = self.index.search(triples)
+            except Exception as exc:
+                record_error(exc, str(self.node))
+                results = [() for _ in triples]
+        self._m_query_seconds.observe(
+            _time.perf_counter() - t0, exemplar=sp.trace_id
+        )
         self._m_queries.inc(len(triples))
         out = {}
         for (qk, _vals), matches in zip(items, results):

@@ -49,7 +49,11 @@ ALL_NODES: list["Node"] = []  # every node built since the last G.clear()
 
 # package root used to find the user frame that declared a node (the
 # first stack frame outside pathway_tpu itself)
-_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# trailing separator: a SIBLING path that merely shares the directory
+# name as a prefix (".../pathway_tpu_demo.py") is user code, not ours
+_PKG_ROOT = (
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+)
 
 
 def _declaration_frame() -> tuple[str, int, str] | None:

@@ -350,6 +350,14 @@ class Runtime:
             n.id: _tick_hist.labels(self._node_names[n.id])
             for n in self.order
         }
+        # Trace Weaver: per-tick and per-operator spans. The tick span
+        # adopts the oldest pending REST request's context (or, in
+        # lockstep mode, the group traceparent the barrier agreed on), so
+        # the dataflow work serving a request lands in its trace.
+        from pathway_tpu.observability.tracing import get_tracer
+
+        self._tracer = get_tracer()
+        self._tick_traceparent: str | None = None  # lockstep: set per round
         self.http_server = None  # set by start_http_server when attached
         # intra-tick worker parallelism (reference: PATHWAY_THREADS timely
         # workers, src/engine/dataflow/config.rs:63-86): independent nodes
@@ -397,7 +405,10 @@ class Runtime:
 
     def _process_node(self, node, t, produced, injected, final, stats):
         ex = self.execs[node.id]
-        if isinstance(ex, InputExec) and injected and node.id in injected:
+        has_injected = (
+            isinstance(ex, InputExec) and injected and node.id in injected
+        )
+        if has_injected:
             for b in injected[node.id]:
                 ex.inject(b)
         inputs = [produced.get(inp.id, []) for inp in node.inputs]
@@ -405,10 +416,29 @@ class Runtime:
         from pathway_tpu.internals.errors import set_exec_scope
 
         set_exec_scope(getattr(node, "_error_scope", None))
+        # operator span only when the node has work this tick — idle
+        # autocommit passes must not flood the span ring
+        span = (
+            self._tracer.span(
+                f"op.{self._node_names[node.id]}",
+                node=f"{node.name}_{node.id}",
+            )
+            if self._tracer.enabled and (has_injected or any(inputs))
+            else None
+        )
         try:
-            out = ex.process(t, inputs)
-            if final:
-                out = list(out) + list(ex.on_end())
+            if span is not None:
+                with span:
+                    out = ex.process(t, inputs)
+                    if final:
+                        out = list(out) + list(ex.on_end())
+                    span.set_attribute(
+                        "rows", sum(len(b) for b in out)
+                    )
+            else:
+                out = ex.process(t, inputs)
+                if final:
+                    out = list(out) + list(ex.on_end())
         finally:
             set_exec_scope(None)
         produced[node.id] = out
@@ -430,13 +460,36 @@ class Runtime:
 
     def tick(self, t: int, injected: dict[int, list[DiffBatch]] | None = None) -> None:
         """Process one logical time: push diffs through all nodes in topo
-        order. `injected` maps input-node id -> batches."""
+        order. `injected` maps input-node id -> batches. The whole tick
+        runs under an ``engine.tick`` span parented on the trace being
+        served (pending REST request, or the barrier-agreed group trace
+        in lockstep mode) so per-operator child spans attribute the
+        tick's work to that request."""
+        if not self._tracer.enabled:
+            self._tick_inner(t, injected)
+            return
+        from pathway_tpu.observability import tracing
+
+        parent = tracing.parse_traceparent(self._tick_traceparent)
+        if parent is None:
+            parent = tracing.pending_context()
+        with self._tracer.span(
+            "engine.tick", parent=parent, root=True, t=t
+        ):
+            self._tick_inner(t, injected)
+
+    def _tick_inner(
+        self, t: int, injected: dict[int, list[DiffBatch]] | None
+    ) -> None:
         self.current_time = t
         produced: dict[int, list[DiffBatch]] = {}
         final = t >= END_OF_TIME
         stats = self.stats
         tick_start = _time.perf_counter_ns()
         if self._pool is not None and self._levels is not None:
+            import contextvars as _cv
+
+            traced = self._tracer.enabled
             for level in self._levels:
                 if len(level) == 1:
                     self._process_node(
@@ -444,7 +497,16 @@ class Runtime:
                     )
                     continue
                 futures = [
+                    # pool threads don't inherit the tick span's
+                    # contextvars; run each node in a fresh copy of the
+                    # submitting context so operator spans nest correctly
                     self._pool.submit(
+                        _cv.copy_context().run,
+                        self._process_node,
+                        node, t, produced, injected, final, stats,
+                    )
+                    if traced
+                    else self._pool.submit(
                         self._process_node,
                         node, t, produced, injected, final, stats,
                     )
@@ -509,6 +571,10 @@ class Runtime:
         while True:
             local_next = events[i][0] if i < n else END_OF_TIME
             vals = self.host_mesh.barrier(("tick", local_next))
+            # the barrier frames carried every process's traceparent:
+            # adopt the group's pick so all processes' tick spans (and
+            # their DCN exchanges) land in ONE trace
+            self._tick_traceparent = self.host_mesh.group_traceparent()
             t = min(v[1] for v in vals.values())
             if t >= END_OF_TIME:
                 break
@@ -622,6 +688,7 @@ class Runtime:
             group_stop = any(v[4] for v in vals.values())
             group_any = any(v[2] for v in vals.values())
             group_done = all(v[3] for v in vals.values())
+            self._tick_traceparent = self.host_mesh.group_traceparent()
             if group_any:
                 # rows already drained from sessions advanced their offset
                 # markers — they must be ticked (and so logged) even when

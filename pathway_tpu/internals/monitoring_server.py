@@ -5,10 +5,12 @@ TPU-native equivalent of the reference's per-process metrics server
 20000 + process_id with input/output latency gauges), rebuilt on the
 Flight Recorder registry (pathway_tpu/observability): ``/metrics`` renders
 the process-wide MetricsRegistry (runtime counters are promoted onto it
-at scrape time), and three debug endpoints answer the questions the
+at scrape time), and the debug endpoints answer the questions the
 BENCH_r05 hung-probe investigation couldn't: ``/debug/threads``
 (all-thread stack dump), ``/debug/graph`` (per-node rows/ns/backlog as
-JSON), ``/debug/profile?seconds=N`` (on-demand jax profiler trace).
+JSON), ``/debug/profile?seconds=N`` (on-demand jax profiler trace),
+``/debug/trace?seconds=N`` (the Trace Weaver span ring as Chrome
+trace-event JSON, loadable in Perfetto).
 
 Bind host comes from PATHWAY_MONITORING_HOST (default 127.0.0.1 — set
 0.0.0.0 for multi-host scrape); a taken port falls back to an ephemeral
@@ -236,6 +238,8 @@ def start_http_server(
                     )
                 elif route == "/debug/profile":
                     self._profile(parse_qs(parsed.query))
+                elif route == "/debug/trace":
+                    self._trace(parse_qs(parsed.query))
                 else:
                     self._reply(404, b"not found")
             except BrokenPipeError:
@@ -247,6 +251,29 @@ def start_http_server(
                     )
                 except Exception:
                     pass
+
+        def _trace(self, query: dict) -> None:
+            """Trace Weaver export: the span ring as Chrome trace-event
+            JSON — save the body to a file and load it in Perfetto
+            (ui.perfetto.dev) or chrome://tracing. ``seconds=N`` keeps
+            only spans that ended within the trailing window."""
+            from pathway_tpu.observability.tracing import get_tracer
+
+            raw = query.get("seconds", ["0"])[0]
+            try:
+                seconds = float(raw)
+            except ValueError:
+                self._reply(400, b"seconds must be a number")
+                return
+            if seconds < 0:
+                self._reply(400, b"seconds must be non-negative")
+                return
+            doc = get_tracer().chrome_trace(
+                seconds=seconds if seconds > 0 else None
+            )
+            self._reply(
+                200, json.dumps(doc).encode(), "application/json"
+            )
 
         def _profile(self, query: dict) -> None:
             try:

@@ -1,66 +1,80 @@
-"""Telemetry — OpenTelemetry spans around graph build and execution.
+"""Telemetry — spans around graph build and execution + OTLP metrics.
 
 TPU-native counterpart of the reference's tracing stack
 (reference: src/engine/telemetry.rs — OTLP traces/metrics;
 internals/graph_runner/telemetry.py — python build spans share one trace
-with engine spans via trace_parent). The image ships the OTel API but no
-SDK/exporter, so spans are real when an SDK is configured by the host
-application and free no-ops otherwise. Enable by passing
-``monitoring_server=...`` / setting PATHWAY_MONITORING_SERVER (the
-reference gates OTLP export the same way).
+with engine spans via trace_parent). The span path is the Trace Weaver
+(pathway_tpu/observability/tracing.py): every ``Telemetry.span`` records
+into the built-in ring buffer with no external SDK, and dual-emits
+through OpenTelemetry when the host application configures a real SDK
+TracerProvider. Metrics still go OTLP-only (the Flight Recorder registry
+is the in-repo metrics surface).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Iterator
 
-try:
-    from opentelemetry import trace as _trace
-
-    _tracer = _trace.get_tracer("pathway_tpu")
-    _HAS_OTEL = True
-except ImportError:  # pragma: no cover
-    _tracer = None
-    _HAS_OTEL = False
+from pathway_tpu.observability.tracing import (
+    current_traceparent,
+    get_tracer,
+    otel_sdk_provider_active,
+)
 
 
 class Telemetry:
-    """Span factory + lightweight local timings (always collected)."""
+    """Span factory + lightweight local timings (always collected).
+
+    Spans delegate to the Trace Weaver tracer; ``timings`` accumulation
+    is lock-guarded — spans close concurrently on the engine's topo-level
+    worker pool (engine/runtime.py), and the bare dict read-modify-write
+    dropped updates under that concurrency."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self.timings: dict[str, float] = {}
+        self._timings_lock = threading.Lock()
+
+    def _add_timing(self, name: str, dt: float) -> None:
+        with self._timings_lock:
+            self.timings[name] = self.timings.get(name, 0.0) + dt
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes: Any) -> Iterator[None]:
         t0 = time.perf_counter()
         try:
-            if self.enabled and _HAS_OTEL:
-                with _tracer.start_as_current_span(name) as sp:
-                    for k, v in attributes.items():
-                        try:
-                            sp.set_attribute(k, v)
-                        except Exception:
-                            pass
+            if self.enabled:
+                with get_tracer().span(name, **attributes):
                     yield
             else:
                 yield
         finally:
-            self.timings[name] = self.timings.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            self._add_timing(name, time.perf_counter() - t0)
 
     def trace_parent(self) -> str | None:
         """W3C traceparent of the current span — the reference forwards
-        this across the Python/engine boundary (python_api.rs:3343)."""
-        if not _HAS_OTEL:
+        this across the Python/engine boundary (python_api.rs:3343).
+        Prefers the built-in tracer's ambient context; falls back to an
+        ambient OTel span when only the host application's SDK is
+        tracing."""
+        tp = current_traceparent()
+        if tp is not None:
+            return tp
+        try:
+            from opentelemetry import trace as _trace
+
+            ctx = _trace.get_current_span().get_span_context()
+            if not ctx.is_valid:
+                return None
+            return (
+                f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-"
+                f"{ctx.trace_flags:02x}"
+            )
+        except Exception:
             return None
-        ctx = _trace.get_current_span().get_span_context()
-        if not ctx.is_valid:
-            return None
-        return f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-{ctx.trace_flags:02x}"
 
 
 def process_gauges() -> dict[str, float]:
@@ -103,14 +117,14 @@ class _OtelMetrics:
         self._hist = None
         self.enabled = False
         try:
-            from opentelemetry import metrics as _metrics
-
             # a bare OTel API (no SDK) hands out proxy instruments that
             # accept-and-drop every record — skip the per-tick cost unless
             # a real SDK provider is configured at Runtime build time
-            provider = _metrics.get_meter_provider()
-            if not type(provider).__module__.startswith("opentelemetry.sdk"):
+            # (shared gate with the tracer's dual-emit: tracing.py)
+            if not _sdk_provider_active():
                 return
+            from opentelemetry import metrics as _metrics
+
             meter = _metrics.get_meter("pathway_tpu")
             self._hist = meter.create_histogram(
                 "pathway.operator.latency",
@@ -161,14 +175,7 @@ def get_telemetry() -> Telemetry:
 
 
 def _sdk_provider_active() -> bool:
-    try:
-        from opentelemetry import metrics as _metrics
-
-        return type(_metrics.get_meter_provider()).__module__.startswith(
-            "opentelemetry.sdk"
-        )
-    except Exception:
-        return False
+    return otel_sdk_provider_active("metrics")
 
 
 def get_metrics() -> _OtelMetrics:

@@ -203,10 +203,31 @@ class RestServerSubject(ConnectorSubject):
     async def _handle(self, request: web.Request) -> web.Response:
         import time as _time
 
+        from pathway_tpu.observability import tracing
+
         t0 = _time.perf_counter()
         self._m_inflight.inc()
+        # Trace Weaver ingress: continue the caller's W3C trace when a
+        # `traceparent` header arrives (the cross-service contract the
+        # reference keeps across the Python/engine boundary,
+        # python_api.rs:3343), mint a fresh root otherwise. The span
+        # covers the whole dataflow round trip; the engine tick adopts
+        # this context via the pending-request registry, so embed/KNN/
+        # operator spans downstream share the trace id.
+        span = tracing.get_tracer().span(
+            "http.request",
+            parent=tracing.parse_traceparent(
+                request.headers.get("traceparent")
+            ),
+            root=True,
+            ingress=True,
+            route=self._route,
+            method=request.method,
+        )
         try:
-            response = await self._handle_inner(request)
+            with span:
+                response = await self._handle_inner(request)
+                span.set_attribute("status", response.status)
         except Exception:
             self._m_requests.labels(
                 self._route, request.method, "500"
@@ -214,13 +235,21 @@ class RestServerSubject(ConnectorSubject):
             raise
         finally:
             self._m_inflight.dec()
-            self._m_seconds.observe(_time.perf_counter() - t0)
+            self._m_seconds.observe(
+                _time.perf_counter() - t0, exemplar=span.trace_id
+            )
         self._m_requests.labels(
             self._route, request.method, str(response.status)
         ).inc()
+        if span.context is not None:
+            # echo the trace identity so callers can find this request in
+            # /debug/trace (response contract: same trace id, our span id)
+            response.headers["traceparent"] = span.context.traceparent()
         return response
 
     async def _handle_inner(self, request: web.Request) -> web.Response:
+        from pathway_tpu.observability import tracing
+
         rid = uuid.uuid4().hex
         key = int(ref_scalar(rid))
         if self._format == "raw":
@@ -250,8 +279,14 @@ class RestServerSubject(ConnectorSubject):
         coerced = self._coerce_values(values)
         vals = self._vals(coerced)
         assert self._session is not None
-        self._session.insert(key, vals)
-        result = await future
+        # hand the request's span context to the engine: the tick that
+        # processes this row parents itself on it (tracing registry)
+        tracing.register_pending(key, tracing.current_context())
+        try:
+            self._session.insert(key, vals)
+            result = await future
+        finally:
+            tracing.unregister_pending(key)
         if self._delete_completed:
             self._session.remove(key, vals)
         return web.json_response(result)

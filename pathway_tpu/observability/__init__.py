@@ -5,8 +5,11 @@ every layer (engine tick loop, KNN serving, embedder batches, REST
 handlers, host exchange, sharded routing); the monitoring server
 (internals/monitoring_server.py) renders it at ``/metrics`` and serves
 the debug surfaces (``/debug/threads``, ``/debug/graph``,
-``/debug/profile``). See README "Observability" for the metric
-inventory and scrape config.
+``/debug/profile``, ``/debug/trace``). The Trace Weaver
+(``observability/tracing.py``) adds end-to-end request tracing on top:
+a built-in span ring buffer with W3C traceparent propagation across
+every serving hop and the host mesh. See README "Observability" for the
+metric inventory, scrape config, and tracing guide.
 """
 
 from pathway_tpu.observability.registry import (
@@ -31,6 +34,15 @@ from pathway_tpu.observability.debug import (
     thread_stack_dump,
 )
 from pathway_tpu.observability.jax_metrics import install_jax_metrics
+from pathway_tpu.observability.tracing import (
+    SpanContext,
+    Tracer,
+    current_traceparent,
+    get_tracer,
+    otel_sdk_provider_active,
+    parse_traceparent,
+    validate_chrome_trace,
+)
 
 __all__ = [
     "REGISTRY",
@@ -39,14 +51,21 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "ProfilerUnavailable",
+    "SpanContext",
+    "Tracer",
+    "current_traceparent",
     "escape_label_value",
     "get_registry",
+    "get_tracer",
     "graph_table",
     "install_jax_metrics",
     "log_linear_buckets",
+    "otel_sdk_provider_active",
     "parse_exposition",
+    "parse_traceparent",
     "sanitize_metric_name",
     "take_profile",
     "thread_stack_dump",
+    "validate_chrome_trace",
     "validate_exposition",
 ]

@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Any, Callable, Iterable, Mapping, Sequence
 
@@ -274,7 +275,7 @@ class Gauge(_Metric):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count", "exemplar")
 
     def __init__(self, bounds: tuple[float, ...]):
         self._lock = threading.Lock()
@@ -282,13 +283,21 @@ class _HistogramChild:
         self.counts = [0] * (len(bounds) + 1)  # last slot = +Inf
         self.sum = 0.0
         self.count = 0
+        # most recent (trace_id, value, unix_seconds) exemplar — links the
+        # latency distribution back to a concrete trace in the Trace
+        # Weaver ring (served under /debug/trace "otherData.exemplars";
+        # the 0.0.4 text exposition has no exemplar syntax, so /metrics
+        # output is unchanged)
+        self.exemplar: tuple[str, float, float] | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         idx = bisect_left(self._bounds, value)
         with self._lock:
             self.counts[idx] += 1
             self.sum += value
             self.count += 1
+            if exemplar is not None:
+                self.exemplar = (str(exemplar), float(value), time.time())
 
     def quantile(self, q: float) -> float:
         """Estimate the q-quantile (0..1) from bucket counts by linear
@@ -342,8 +351,8 @@ class Histogram(_Metric):
     def _make_child(self, key):
         return _HistogramChild(self.bounds)
 
-    def observe(self, value: float) -> None:
-        self._unlabeled().observe(value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._unlabeled().observe(value, exemplar)
 
     def quantile(self, q: float) -> float:
         return self._unlabeled().quantile(q)
@@ -443,6 +452,33 @@ class MetricsRegistry:
         with self._lock:
             if fn in self._collectors:
                 self._collectors.remove(fn)
+
+    def exemplars(self) -> list[dict]:
+        """Every histogram child's most recent exemplar: which trace id
+        last contributed to which latency series (Trace Weaver's
+        metrics→traces link)."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        out: list[dict] = []
+        for m in metrics:
+            if not isinstance(m, Histogram):
+                continue
+            with m._lock:
+                items = sorted(m._children.items())
+            for key, child in items:
+                ex = child.exemplar
+                if ex is None:
+                    continue
+                out.append(
+                    {
+                        "metric": m.name,
+                        "labels": dict(zip(m.labelnames, key)),
+                        "trace_id": ex[0],
+                        "value": ex[1],
+                        "time_unix": ex[2],
+                    }
+                )
+        return out
 
     def get(self, name: str) -> _Metric | None:
         with self._lock:

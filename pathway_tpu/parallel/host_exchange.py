@@ -44,7 +44,15 @@ import threading
 import time
 from typing import Any
 
-_HELLO_MAGIC = b"PWHX4"  # protocol version tag (networking.rs handshake analog)
+from pathway_tpu.observability.tracing import (
+    get_tracer,
+    pending_traceparent,
+    propagation_traceparent,
+)
+
+_HELLO_MAGIC = b"PWHX5"  # protocol version tag (networking.rs handshake
+# analog); v5 appends a W3C traceparent slot to every data/bar frame so
+# traces stitch across processes (Trace Weaver, observability/tracing.py)
 _MAC_LEN = 32  # HMAC-SHA256
 _NONCE_LEN = 32
 _OK_TAG = b"PWOK"  # acceptor's authenticated handshake acknowledgment
@@ -96,8 +104,15 @@ class HostMesh:
     (src -> dst) has exactly one connection used for src's sends. Frames
     are length-prefixed pickles:
 
-      ("data", src, channel, tick, payload)   — DiffBatch partitions
-      ("bar",  src, round, value)             — barrier value exchange
+      ("data", src, channel, tick, payload, tp)  — DiffBatch partitions
+      ("bar",  src, round, value, tp)            — barrier value exchange
+
+    `tp` is the sender's W3C traceparent (or None): cross-host context
+    propagation for the Trace Weaver. ``barrier()`` records the group's
+    traceparents in ``last_barrier_tps`` — the lockstep tick scheduler
+    reads it to agree on ONE tick trace group-wide (engine/runtime.py);
+    ``gather()`` records them in ``last_gather_tps`` for the DCN
+    exchange spans.
     """
 
     def __init__(
@@ -153,6 +168,16 @@ class HostMesh:
         self._data: dict[tuple[str, int], dict[int, Any]] = {}
         # round -> {src: value}
         self._bars: dict[int, dict[int, Any]] = {}
+        # received traceparents, keyed like their payloads; gather moves
+        # its key's entry into _gathered_tps for take_gather_tps (keyed,
+        # not a shared "last" slot — concurrent gathers on different
+        # channels must not clobber each other's remote traces)
+        self._data_tps: dict[tuple[str, int], dict[int, str]] = {}
+        self._bar_tps: dict[int, dict[int, str]] = {}
+        self._gathered_tps: dict[tuple[str, int], dict[int, str]] = {}
+        # {pid: traceparent|None} of the last completed barrier (barriers
+        # are lockstep on one thread, so a single slot is race-free)
+        self.last_barrier_tps: dict[int, str | None] = {}
         self._round = 0
         self._dead: set[int] = set()
         self._send_locks: dict[int, threading.Lock] = {}
@@ -204,9 +229,9 @@ class HostMesh:
                 # later as a confusing EPIPE on the first large send
                 ok = self._read_exact(s, _MAC_LEN)
                 if ok is None:
-                    # clean close mid-handshake (peer tearing down, or a
-                    # pre-PWHX4 acceptor dropping the unknown magic): a
-                    # retryable startup race, NOT an auth verdict
+                    # clean close mid-handshake (peer tearing down, or an
+                    # older-protocol acceptor dropping the unknown magic):
+                    # a retryable startup race, NOT an auth verdict
                     raise OSError("peer closed during handshake")
                 if ok == _REJECT:
                     s.close()
@@ -314,13 +339,19 @@ class HostMesh:
                 kind = frame[0]
                 with self._cv:
                     if kind == "data":
-                        _k, fsrc, channel, tick, payload = frame
+                        _k, fsrc, channel, tick, payload, tp = frame
                         self._data.setdefault((channel, tick), {})[
                             fsrc
                         ] = payload
+                        if tp is not None:
+                            self._data_tps.setdefault(
+                                (channel, tick), {}
+                            )[fsrc] = tp
                     elif kind == "bar":
-                        _k, fsrc, rnd, value = frame
+                        _k, fsrc, rnd, value, tp = frame
                         self._bars.setdefault(rnd, {})[fsrc] = value
+                        if tp is not None:
+                            self._bar_tps.setdefault(rnd, {})[fsrc] = tp
                     self._cv.notify_all()
         except OSError:
             pass
@@ -351,7 +382,12 @@ class HostMesh:
             ) from e
 
     def send(self, dst: int, channel: str, tick: int, payload: Any) -> None:
-        self._send_frame(dst, ("data", self.pid, channel, tick, payload))
+        # disabled tracing must not cost a contextvar read + pending-lock
+        # acquisition per frame on the mesh hot path
+        tp = propagation_traceparent() if get_tracer().enabled else None
+        self._send_frame(
+            dst, ("data", self.pid, channel, tick, payload, tp)
+        )
 
     def gather(
         self, channel: str, tick: int, timeout: float = 300.0
@@ -368,6 +404,15 @@ class HostMesh:
                     self._m_gather_seconds.observe(
                         time.perf_counter() - t0
                     )
+                    tps = self._data_tps.pop(key, None)
+                    if tps:
+                        self._gathered_tps[key] = tps
+                        # bound the stash: a caller that never takes its
+                        # entry must not leak memory over a long run
+                        while len(self._gathered_tps) > 1024:
+                            self._gathered_tps.pop(
+                                next(iter(self._gathered_tps))
+                            )
                     return self._data.pop(key)
                 if self._dead:
                     missing = set(range(self.n)) - {self.pid} - set(got)
@@ -388,13 +433,26 @@ class HostMesh:
     def barrier(self, value: Any, timeout: float = 300.0) -> dict[int, Any]:
         """Exchange `value` with every process; returns {pid: value} for all
         N processes (including self). Must be called in lockstep — the
-        internal round counter is the channel."""
+        internal round counter is the channel. ``last_barrier_tps`` holds
+        every participant's traceparent afterwards (None for processes
+        with no active trace).
+
+        Barriers carry the PENDING-request traceparent only, never the
+        ambient span: the barrier is the lockstep tick scheduler, and the
+        trace the next tick should serve is the oldest in-flight REST
+        request. The ambient context on the run-loop thread is the
+        whole-run ``pathway.run`` span — adopting it would collapse every
+        tick of every process into one giant run-long trace and starve
+        request attribution."""
         rnd = self._round
         self._round += 1
         t0 = time.perf_counter()
+        own_tp = pending_traceparent() if get_tracer().enabled else None
         for peer in range(self.n):
             if peer != self.pid:
-                self._send_frame(peer, ("bar", self.pid, rnd, value))
+                self._send_frame(
+                    peer, ("bar", self.pid, rnd, value, own_tp)
+                )
         want = self.n - 1
         deadline = time.time() + timeout
         with self._cv:
@@ -403,6 +461,9 @@ class HostMesh:
                 if len(got) >= want:
                     out = self._bars.pop(rnd)
                     out[self.pid] = value
+                    tps = self._bar_tps.pop(rnd, {})
+                    tps[self.pid] = own_tp
+                    self.last_barrier_tps = tps
                     self._m_barrier_seconds.observe(
                         time.perf_counter() - t0
                     )
@@ -421,6 +482,24 @@ class HostMesh:
                         f"process {self.pid}: timeout at barrier {rnd}"
                     )
                 self._cv.wait(timeout=min(left, 1.0))
+
+    def take_gather_tps(self, channel: str, tick: int) -> dict[int, str]:
+        """Remove and return the remote traceparents that arrived with the
+        (channel, tick) payloads of a completed gather. Keyed per gather,
+        so concurrent exchanges on different channels stay isolated."""
+        with self._cv:
+            return self._gathered_tps.pop((channel, tick), {})
+
+    def group_traceparent(self) -> str | None:
+        """The group's agreed trace context for the round the last barrier
+        scheduled: the lowest-pid non-None traceparent (deterministic —
+        every process sees the same set, so every process picks the same
+        one and the whole group's tick spans join one trace)."""
+        tps = self.last_barrier_tps
+        for pid in sorted(tps):
+            if tps[pid] is not None:
+                return tps[pid]
+        return None
 
     def close(self) -> None:
         self._closed = True

@@ -152,19 +152,28 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             labelnames=("model",),
         ).labels(model)
         chips: list[int] = []  # resolved after the first forward
+        from pathway_tpu.observability.tracing import get_tracer
+
+        _tracer = get_tracer()
 
         def embed_batch(texts: Sequence[str]) -> list[np.ndarray]:
             import time as _time
 
-            t0 = _time.perf_counter()
-            ids, mask = self.tokenizer.encode_batch(
-                # runtime.max_len is clamped to the checkpoint's position
-                # table; exceeding it would silently clamp position ids
-                [str(t) for t in texts], self.runtime.max_len
-            )
-            out = self.runtime.forward_ids(ids, mask)
-            dt = _time.perf_counter() - t0
-            m_batch_seconds.observe(dt)
+            # Trace Weaver: one child span per device batch (nested under
+            # the operator span of the tick that carried these rows)
+            with _tracer.span(
+                "embed.batch", model=model, docs=len(texts)
+            ) as sp:
+                t0 = _time.perf_counter()
+                ids, mask = self.tokenizer.encode_batch(
+                    # runtime.max_len is clamped to the checkpoint's
+                    # position table; exceeding it would silently clamp
+                    # position ids
+                    [str(t) for t in texts], self.runtime.max_len
+                )
+                out = self.runtime.forward_ids(ids, mask)
+                dt = _time.perf_counter() - t0
+            m_batch_seconds.observe(dt, exemplar=sp.trace_id)
             m_docs.inc(len(texts))
             if not chips:
                 # forward_ids just used the backend, so counting devices

@@ -160,17 +160,27 @@ class VectorStoreServer:
             splitter = NullSplitter()
 
         m_chunks = self._m_chunks
+        from pathway_tpu.observability.tracing import get_tracer
+
+        _tracer = get_tracer()
 
         def split_doc(data_json: Json) -> list:
-            d = data_json.value
-            fn = splitter.func if hasattr(splitter, "func") else splitter
-            chunks = fn(d["text"])
-            out = []
-            for entry in chunks:
-                text, meta = _coerce_doc_tuple(entry)
-                out.append(
-                    Json({"text": text, "metadata": {**d["metadata"], **meta}})
-                )
+            with _tracer.span("vector_store.chunk") as sp:
+                d = data_json.value
+                fn = splitter.func if hasattr(splitter, "func") else splitter
+                chunks = fn(d["text"])
+                out = []
+                for entry in chunks:
+                    text, meta = _coerce_doc_tuple(entry)
+                    out.append(
+                        Json(
+                            {
+                                "text": text,
+                                "metadata": {**d["metadata"], **meta},
+                            }
+                        )
+                    )
+                sp.set_attribute("chunks", len(out))
             m_chunks.inc(len(out))
             return out
 
@@ -292,21 +302,30 @@ class VectorStoreServer:
         )
 
         m_retrievals, m_results = self._m_retrievals, self._m_results
+        from pathway_tpu.observability.tracing import get_tracer
+
+        _tracer = get_tracer()
 
         def fmt(texts, metas, scores) -> Json:
-            out = []
-            if texts is not None:
-                for t, m, s in zip(texts, metas, scores):
-                    out.append(
-                        {
-                            "text": t,
-                            "metadata": m.value if isinstance(m, Json) else m,
-                            # scores are negative distances (cos - 1)
-                            "dist": -float(s),
-                        }
-                    )
+            # Trace Weaver: retrieval formatting span — the last store
+            # stage a request crosses before the REST response writer
+            with _tracer.span("vector_store.retrieve") as sp:
+                out = []
+                if texts is not None:
+                    for t, m, s in zip(texts, metas, scores):
+                        out.append(
+                            {
+                                "text": t,
+                                "metadata": (
+                                    m.value if isinstance(m, Json) else m
+                                ),
+                                # scores are negative distances (cos - 1)
+                                "dist": -float(s),
+                            }
+                        )
+                sp.set_attribute("results", len(out))
             m_retrievals.inc()
-            m_results.observe(len(out))
+            m_results.observe(len(out), exemplar=sp.trace_id)
             return Json(out)
 
         return raw.select(
