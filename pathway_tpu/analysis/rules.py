@@ -407,7 +407,40 @@ def shard_reducer(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
-# 5. graph stats
+# 5. serving admission
+
+
+@rule("serving-admission")
+def serving_admission(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """REST ingress with no Surge Gate: every HTTP request drops
+    straight into the InputSession, so overload manifests as unbounded
+    queueing (and unbounded memory) instead of explicit shedding."""
+    for node in facts.order:
+        if not isinstance(node, InputNode):
+            continue
+        subject = getattr(getattr(node, "source", None), "subject", None)
+        # type-name check: the http module (aiohttp) need not be loaded
+        # for graphs that don't use it
+        if subject is None or type(subject).__name__ != "RestServerSubject":
+            continue
+        if getattr(subject, "_qos", None) is not None:
+            continue
+        yield Diagnostic(
+            "serving-admission",
+            Severity.WARNING,
+            "rest_connector ingress has no admission bound: under "
+            "overload, requests queue without limit instead of shedding "
+            "with 429/Retry-After, and nothing batches or expires them",
+            node,
+            fix_hint="pass qos=pathway_tpu.serving.QoSConfig(...) to "
+            "rest_connector / run_server (or set "
+            "PATHWAY_SERVING_ENABLED=1) to put the endpoint behind the "
+            "Surge Gate",
+        )
+
+
+# ---------------------------------------------------------------------------
+# 6. graph stats
 
 _STATE_ESTIMATES = {
     "GroupByNode": "O(distinct groups x reducer state)",

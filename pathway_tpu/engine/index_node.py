@@ -88,6 +88,9 @@ class ExternalIndexExec(NodeExec):
             "upserts/removals applied to the index corpus",
             labelnames=("index",),
         ).labels(index_label)
+        from pathway_tpu.serving import metrics as serving_metrics
+
+        self._m_expired = serving_metrics.expired_counter().labels("knn")
         dcols = node.inputs[0].column_names
         qcols = node.inputs[1].column_names
         self.d_data = dcols.index("_data")
@@ -174,11 +177,23 @@ class ExternalIndexExec(NodeExec):
                         record_error(exc, str(node))
                 else:
                     self.index.remove(k)
+        # Surge Gate deadline propagation: queries whose REST deadline
+        # already expired answer empty WITHOUT a device search — the
+        # client got its 504, so the top-k would burn a batch slot for a
+        # response nobody reads (the empty reply keeps the output
+        # universe aligned for downstream row-wise stages).
+        from pathway_tpu.serving import deadline as _deadline
+
         to_answer: list[tuple[int, tuple]] = []
+        expired_keys: list[int] = []
         retracted: list[int] = []
         for b in inputs[1]:
             for k, d, vals in b.iter_rows():
                 if d > 0:
+                    if _deadline.expired(k):
+                        self._m_expired.inc()
+                        expired_keys.append(k)
+                        continue
                     if not node.as_of_now:
                         self.live_queries[k] = vals
                     to_answer.append((k, vals))
@@ -196,8 +211,10 @@ class ExternalIndexExec(NodeExec):
             old = self.emitted.pop(k, None)
             if old is not None:
                 out_rows.append((k, -1, old))
+        replies: dict[int, tuple] = {k: () for k in expired_keys}
         if to_answer:
-            replies = self._answer(to_answer)
+            replies.update(self._answer(to_answer))
+        if replies:
             for k, reply in replies.items():
                 new = (reply,)
                 old = self.emitted.get(k)

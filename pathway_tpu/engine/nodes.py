@@ -508,6 +508,10 @@ class GroupByExec(NodeExec):
         tight per-group bulk update — no per-row Python tuples."""
         if self.sort_idx is not None or len(b) < 256:
             return False
+        if not self.g_idx:
+            # global reduce (no grouping columns): _bulk_codes has no
+            # column to factorize — use the per-row path
+            return False
         for s in self.specs:
             if s.kind in self._BULK_SEMIGROUP:
                 # count(col) must see its argument column (ERROR poison,

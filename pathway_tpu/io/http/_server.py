@@ -8,6 +8,7 @@ from __future__ import annotations
 import asyncio
 import json as _json
 import threading
+import time as _time
 import uuid
 from typing import Any, Mapping, Sequence
 
@@ -66,9 +67,12 @@ class PathwayWebserver:
             "paths": {},
         }
         self._started = False
+        self._stopped = False
         self._lock = threading.Lock()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
+        self._runner: web.AppRunner | None = None
+        self._gates: list[Any] = []  # SurgeGates of this server's routes
         if with_schema_endpoint:
             self._app.router.add_get("/_schema", self._schema_handler)
 
@@ -125,14 +129,57 @@ class PathwayWebserver:
             loop = asyncio.new_event_loop()
             self._loop = loop
             asyncio.set_event_loop(loop)
-            runner = web.AppRunner(self._app)
+            # short shutdown_timeout: stop() must not hang behind a
+            # stuck keep-alive connection (drain already waited for the
+            # responses that matter)
+            runner = web.AppRunner(self._app, shutdown_timeout=1.0)
+            self._runner = runner
             loop.run_until_complete(runner.setup())
             site = web.TCPSite(runner, self.host, self.port)
             loop.run_until_complete(site.start())
             loop.run_forever()
+            # stop() arrived: release sockets + pending handlers, then
+            # close the loop so the thread exits without leaking fds
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
 
         self._thread = threading.Thread(target=run_loop, daemon=True)
         self._thread.start()
+
+    def register_gate(self, gate: Any) -> None:
+        with self._lock:
+            self._gates.append(gate)
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """Graceful shutdown: every attached SurgeGate stops admitting
+        (503 + Retry-After), flushes its queue, and waits for in-flight
+        responses; then the listener closes. Returns True if all gates
+        went idle within their grace period."""
+        with self._lock:
+            gates = list(self._gates)
+        all_idle = True
+        for gate in gates:
+            all_idle = gate.drain(grace_s) and all_idle
+        for gate in gates:
+            gate.close()
+        self.stop()
+        return all_idle
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Close the listener and join the server thread (idempotent).
+        In-flight aiohttp handlers are cancelled by runner.cleanup()."""
+        with self._lock:
+            if not self._started or self._stopped:
+                return
+            self._stopped = True
+        loop = self._loop
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass  # loop already closed
+        if self._thread is not None:
+            self._thread.join(timeout)
 
 
 def _openapi_type(d: dt.DType) -> str:
@@ -161,12 +208,17 @@ class RestServerSubject(ConnectorSubject):
         delete_completed_queries: bool,
         format: str = "raw",
         documentation: EndpointDocumentation | None = None,
+        qos: Any = None,
     ):
         self._webserver = webserver
         self._route = route
         self._format = format
         self._request_schema = schema
         self._delete_completed = delete_completed_queries
+        self._qos = qos  # serving.QoSConfig | None (None = ungated seed path)
+        self._gate: Any = None  # SurgeGate, built in run() once the
+        # InputSession exists
+        self._stop_event = threading.Event()
         self._futures: dict[int, asyncio.Future] = {}
         self._futures_lock = threading.Lock()
         # Flight Recorder: serving-path latency, request-in to
@@ -195,14 +247,40 @@ class RestServerSubject(ConnectorSubject):
         self._ready = threading.Event()
 
     def run(self) -> None:
+        if self._qos is not None:
+            # Surge Gate: the QoS layer between this endpoint and the
+            # engine tick. Built here (not __init__) because it feeds
+            # the connector's InputSession, which exists only once the
+            # runtime wires the source.
+            from pathway_tpu.serving import SurgeGate
+
+            self._gate = SurgeGate(
+                self._qos,
+                self._session,
+                route=self._route,
+                webserver=self._webserver,
+            )
+            self._webserver.register_gate(self._gate)
         self._webserver.start()
         self._ready.set()
-        # stay alive for the lifetime of the graph
-        threading.Event().wait()
+        # stay alive for the lifetime of the graph (on_stop releases us)
+        self._stop_event.wait()
+
+    def on_stop(self) -> None:
+        """Runtime stop: fail queued requests, close the gate, shut the
+        webserver down so tests (and drains) don't leak servers."""
+        if self._gate is not None:
+            try:
+                self._gate.close()
+            except Exception:
+                pass
+        try:
+            self._webserver.stop()
+        except Exception:
+            pass
+        self._stop_event.set()
 
     async def _handle(self, request: web.Request) -> web.Response:
-        import time as _time
-
         from pathway_tpu.observability import tracing
 
         t0 = _time.perf_counter()
@@ -272,13 +350,15 @@ class RestServerSubject(ConnectorSubject):
                     return web.json_response(
                         {"error": f"missing field {name!r}"}, status=400
                     )
+        coerced = self._coerce_values(values)
+        vals = self._vals(coerced)
+        assert self._session is not None
+        if self._gate is not None:
+            return await self._handle_gated(request, key, vals)
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         with self._futures_lock:
             self._futures[key] = future
-        coerced = self._coerce_values(values)
-        vals = self._vals(coerced)
-        assert self._session is not None
         # hand the request's span context to the engine: the tick that
         # processes this row parents itself on it (tracing registry)
         tracing.register_pending(key, tracing.current_context())
@@ -291,7 +371,124 @@ class RestServerSubject(ConnectorSubject):
             self._session.remove(key, vals)
         return web.json_response(result)
 
+    def _deadline_for(self, request: web.Request) -> float:
+        """Absolute monotonic deadline: the ``x-pathway-deadline-ms``
+        budget header (clamped to the configured cap), or the endpoint
+        default when absent/garbled."""
+        import math
+
+        cfg = self._qos
+        budget_ms = None
+        raw = request.headers.get("x-pathway-deadline-ms")
+        if raw is not None:
+            try:
+                budget_ms = float(raw)
+            except ValueError:
+                budget_ms = None
+            # nan/inf would bypass the clamp AND both sides of the
+            # batcher's live/dead partition — treat as absent
+            if budget_ms is not None and not math.isfinite(budget_ms):
+                budget_ms = None
+        if budget_ms is None:
+            budget_ms = cfg.default_deadline_ms
+        budget_ms = min(budget_ms, cfg.max_deadline_ms)
+        return _time.monotonic() + budget_ms / 1000.0
+
+    async def _handle_gated(
+        self, request: web.Request, key: int, vals: tuple
+    ) -> web.Response:
+        """Surge Gate serving path: admission → EDF queue → micro-batch
+        dispatch → engine tick → response, with explicit shedding."""
+        from pathway_tpu.observability import tracing
+        from pathway_tpu.serving import (
+            DeadlineExceeded,
+            PendingRequest,
+            ShedError,
+        )
+
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        dispatched: asyncio.Future = loop.create_future()
+        deadline = self._deadline_for(request)
+        req = PendingRequest(
+            key, vals, deadline, loop=loop, dispatched=dispatched
+        )
+        with self._futures_lock:
+            self._futures[key] = future
+        tracing.register_pending(key, tracing.current_context())
+        admitted = False
+        timed_out = False
+        try:
+            try:
+                self._gate.submit(req)
+                admitted = True
+            except ShedError as e:
+                return web.json_response(
+                    {"error": f"request shed: {e.reason}"},
+                    status=e.status,
+                    headers={"Retry-After": f"{e.retry_after_s:.3f}"},
+                )
+            except DeadlineExceeded:
+                return web.json_response(
+                    {"error": "deadline exceeded"}, status=504
+                )
+            try:
+                # queue.wait: admission to micro-batch release — the
+                # QoS-added latency, as a child of the request span
+                with tracing.get_tracer().span(
+                    "queue.wait", route=self._route
+                ) as qs:
+                    batch_size = await dispatched
+                    qs.set_attribute("batch", batch_size)
+            except DeadlineExceeded:
+                # dropped at flush: the engine never saw this request
+                return web.json_response(
+                    {"error": "deadline exceeded before dispatch"},
+                    status=504,
+                )
+            except ShedError as e:
+                return web.json_response(
+                    {"error": f"request shed: {e.reason}"},
+                    status=e.status,
+                    headers={"Retry-After": f"{e.retry_after_s:.3f}"},
+                )
+            try:
+                result = await asyncio.wait_for(
+                    future, timeout=max(0.001, deadline - _time.monotonic())
+                )
+            except asyncio.TimeoutError:
+                # dispatched but the result missed the deadline; KEEP
+                # the registry entry so the tick that eventually reaches
+                # this row skips its device work (index_node) — _deliver
+                # or the registry's lazy sweep cleans it up
+                timed_out = True
+                return web.json_response(
+                    {"error": "deadline exceeded"}, status=504
+                )
+        finally:
+            tracing.unregister_pending(key)
+            with self._futures_lock:
+                self._futures.pop(key, None)
+            if admitted:
+                self._gate.complete(
+                    None if timed_out else key,
+                    was_dispatched=req.was_dispatched,
+                )
+            if req.was_dispatched and self._delete_completed:
+                try:
+                    self._session.remove(key, vals)
+                except Exception:
+                    pass
+        return web.json_response(result)
+
     def _deliver(self, key: int, payload: Any) -> None:
+        if self._gate is not None:
+            # late result for a 504'd request: its deadline entry was
+            # deliberately left registered so the engine could skip the
+            # work — this is the natural cleanup point
+            from pathway_tpu.serving import deadline as _sdl
+
+            _sdl.unregister(key)
         with self._futures_lock:
             future = self._futures.pop(key, None)
         if future is None:
@@ -318,12 +515,24 @@ def rest_connector(
     delete_completed_queries: bool | None = None,
     request_validator: Any = None,
     documentation: EndpointDocumentation | None = None,
+    qos: Any = None,
 ) -> tuple[Table, Any]:
     """Returns (queries_table, response_writer). Call
     ``response_writer(result_table)`` where result_table has columns
-    ``query_id`` (Pointer) and ``result`` (reference: _server.py:624)."""
+    ``query_id`` (Pointer) and ``result`` (reference: _server.py:624).
+
+    ``qos``: a :class:`pathway_tpu.serving.QoSConfig` puts the endpoint
+    behind a Surge Gate (micro-batching + deadline-aware admission
+    control + graceful overload). ``None`` keeps the ungated per-request
+    path unless ``PATHWAY_SERVING_ENABLED=1``, in which case the
+    env-configured gate applies."""
     if delete_completed_queries is None:
         delete_completed_queries = not bool(keep_queries)
+    if qos is None:
+        from pathway_tpu.serving import QoSConfig, serving_enabled_via_env
+
+        if serving_enabled_via_env():
+            qos = QoSConfig.from_env()
     if webserver is None:
         assert host is not None and port is not None
         webserver = PathwayWebserver(host, port)
@@ -340,6 +549,7 @@ def rest_connector(
         delete_completed_queries,
         format=fmt,
         documentation=documentation,
+        qos=qos,
     )
     queries = python_read(subject, schema=schema)
 

@@ -1,0 +1,46 @@
+"""Surge Gate: the serving QoS subsystem — dynamic micro-batching,
+deadline-aware admission control and graceful overload/drain for the
+REST serving path.
+
+Layering: ``io/http`` (ingress) builds a ``SurgeGate`` per endpoint when
+``rest_connector(..., qos=QoSConfig(...))`` is passed (or
+``PATHWAY_SERVING_ENABLED=1``); the gate feeds the engine's
+``InputSession`` in bucketed releases; ``engine/index_node`` and the
+embedders consult :mod:`pathway_tpu.serving.deadline` so expired work is
+dropped before it burns a device batch slot. Everything here is
+stdlib-only — safe to import from the engine layer.
+"""
+
+from pathway_tpu.serving.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    ShedError,
+    TokenBucket,
+)
+from pathway_tpu.serving.batcher import MicroBatcher
+from pathway_tpu.serving.config import (
+    QoSConfig,
+    default_bucket_ladder,
+    serving_enabled_via_env,
+)
+from pathway_tpu.serving.gate import (
+    PendingRequest,
+    SurgeGate,
+    drain_all,
+    gates,
+)
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "PendingRequest",
+    "QoSConfig",
+    "ShedError",
+    "SurgeGate",
+    "TokenBucket",
+    "default_bucket_ladder",
+    "drain_all",
+    "gates",
+    "serving_enabled_via_env",
+]

@@ -1,0 +1,136 @@
+"""Deadline-aware admission control: the decision whether a request may
+enter the serving queue at all.
+
+Checks run in shed-priority order — draining beats everything (the
+endpoint is going away), then the queue bound (the overload signal),
+then the per-endpoint concurrency cap, then the token-bucket rate
+limit. Every refusal carries an HTTP status and a ``Retry-After`` hint
+so clients back off instead of hammering a saturated endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pathway_tpu.serving import metrics as _metrics
+from pathway_tpu.serving.config import QoSConfig
+
+
+class ShedError(Exception):
+    """Request refused admission — explicit load shedding."""
+
+    def __init__(self, status: int, reason: str, retry_after_s: float):
+        super().__init__(f"shed ({reason}): retry after {retry_after_s:.3f}s")
+        self.status = status
+        self.reason = reason
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before its work could run."""
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket; not thread-safe by itself (the
+    admission controller serializes access)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst
+        self._last = time.monotonic()
+
+    def try_acquire(self, now: float | None = None) -> float:
+        """0.0 = token taken; otherwise seconds until one accrues."""
+        if now is None:
+            now = time.monotonic()
+        self.tokens = min(
+            self.burst, self.tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+class AdmissionController:
+    """Per-endpoint admission state: queue depth, in-flight count, rate
+    limiter, drain flag. ``admit`` raises ``ShedError``; callers pair it
+    with ``on_flushed`` (requests left the queue) and ``complete`` (the
+    response went out)."""
+
+    def __init__(self, config: QoSConfig, route: str = "/"):
+        self.config = config
+        self.route = route
+        self._lock = threading.Lock()
+        self.queued = 0
+        self.inflight = 0
+        self.draining = False
+        self._bucket = (
+            TokenBucket(config.rate_limit_rps, config.burst())
+            if config.rate_limit_rps
+            else None
+        )
+        self._idle = threading.Event()
+        self._idle.set()
+        self._m_shed = _metrics.shed_counter()
+        self._m_admitted = _metrics.admitted_counter().labels(route)
+        _metrics.queue_depth_gauge().labels(route).set_function(
+            lambda: self.queued
+        )
+        _metrics.inflight_gauge().labels(route).set_function(
+            lambda: self.inflight
+        )
+
+    def _shed(self, status: int, reason: str, retry_after_s: float):
+        self._m_shed.labels(self.route, reason).inc()
+        raise ShedError(status, reason, retry_after_s)
+
+    def admit(self, now: float | None = None) -> None:
+        cfg = self.config
+        with self._lock:
+            if self.draining:
+                self._shed(503, "draining", cfg.drain_grace_s)
+            if self.queued >= cfg.max_queue:
+                # the queue clears one micro-batch per flush window —
+                # hint a backoff of one full wait window
+                self._shed(
+                    429, "queue_full", max(cfg.max_wait_ms / 1000.0, 0.05)
+                )
+            if (
+                cfg.max_inflight is not None
+                and self.inflight >= cfg.max_inflight
+            ):
+                self._shed(
+                    429, "concurrency", max(cfg.max_wait_ms / 1000.0, 0.05)
+                )
+            if self._bucket is not None:
+                wait = self._bucket.try_acquire(now)
+                if wait > 0.0:
+                    self._shed(429, "rate_limit", wait)
+            self.queued += 1
+            self.inflight += 1
+            self._idle.clear()
+        self._m_admitted.inc()
+
+    def on_flushed(self, n: int) -> None:
+        with self._lock:
+            self.queued = max(0, self.queued - n)
+
+    def complete(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+            if self.inflight == 0:
+                self._idle.set()
+
+    def start_drain(self) -> None:
+        with self._lock:
+            self.draining = True
+            if self.inflight == 0:
+                self._idle.set()
+
+    def wait_idle(self, timeout: float | None) -> bool:
+        """Block until no request is in flight (drain helper)."""
+        return self._idle.wait(timeout)

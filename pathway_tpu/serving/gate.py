@@ -1,0 +1,263 @@
+"""SurgeGate — the serving QoS layer between REST ingress and the
+engine tick.
+
+One gate per rest_connector endpoint. The aiohttp handler builds a
+``PendingRequest`` and calls ``submit``: admission control (bounded
+queue, per-endpoint concurrency cap, token-bucket rate limit) may shed
+it with an explicit 429/503 + Retry-After; otherwise it joins the
+micro-batcher's EDF queue and, at flush, the whole release is inserted
+atomically into the endpoint's ``InputSession`` so a single engine tick
+(and a single jitted embed/KNN batch) carries it. ``drain`` stops
+admission, flushes in-flight batches, waits for every admitted request
+to finish, and then the webserver can shut down cleanly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any
+
+from pathway_tpu.serving import deadline as _deadline
+from pathway_tpu.serving import metrics as _metrics
+from pathway_tpu.serving.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    ShedError,
+)
+from pathway_tpu.serving.batcher import MicroBatcher
+from pathway_tpu.serving.config import QoSConfig
+
+# all live gates of this process (drain_all / debug); weak so cleared
+# graphs release their gates without an explicit unregister
+_GATES: "weakref.WeakSet[SurgeGate]" = weakref.WeakSet()
+_GATES_LOCK = threading.Lock()
+
+INTERACTIVE_PRIORITY = 0  # InputSession.priority value for gated queries
+
+
+class PendingRequest:
+    """One admitted-or-not REST request crossing the gate."""
+
+    __slots__ = (
+        "key",
+        "vals",
+        "deadline",
+        "enqueued_at",
+        "loop",
+        "dispatched",
+        "was_dispatched",
+    )
+
+    def __init__(
+        self,
+        key: int,
+        vals: tuple,
+        deadline: float,
+        loop: Any = None,
+        dispatched: Any = None,
+    ):
+        self.key = key
+        self.vals = vals
+        self.deadline = float(deadline)
+        self.enqueued_at = time.monotonic()
+        # asyncio plumbing: `dispatched` resolves (with the batch size)
+        # when the micro-batcher releases the request into the engine,
+        # or errors with DeadlineExceeded/ShedError when it is dropped
+        self.loop = loop
+        self.dispatched = dispatched
+        self.was_dispatched = False
+
+    def resolve_dispatched(self, batch_size: int) -> None:
+        self.was_dispatched = True
+        if self.loop is None or self.dispatched is None:
+            return
+        fut = self.dispatched
+
+        def _set() -> None:
+            if not fut.done():
+                fut.set_result(batch_size)
+
+        try:
+            self.loop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # loop already closed (server shutting down)
+
+    def reject(self, exc: BaseException) -> None:
+        if self.loop is None or self.dispatched is None:
+            return
+        fut = self.dispatched
+
+        def _set() -> None:
+            if not fut.done():
+                fut.set_exception(exc)
+
+        try:
+            self.loop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass
+
+
+class SurgeGate:
+    def __init__(
+        self,
+        config: QoSConfig,
+        session: Any,
+        route: str = "/",
+        webserver: Any = None,
+    ):
+        self.config = config
+        self.session = session
+        self.route = route
+        self.webserver = webserver
+        self.admission = AdmissionController(config, route)
+        self._m_wait = _metrics.queue_wait_histogram().labels(route)
+        self._m_batch_rows = _metrics.batch_rows_histogram().labels(route)
+        self._m_occupancy = _metrics.occupancy_histogram()
+        self._m_expired = _metrics.expired_counter().labels("gate")
+        self._closed = False
+        # dispatch window: requests released into the engine but whose
+        # response has not gone out yet; the batcher holds further
+        # releases while the window is full so overload accumulates in
+        # the bounded admission queue, not the InputSession
+        self._disp_lock = threading.Lock()
+        self._dispatched_pending = 0
+        self.batcher = MicroBatcher(
+            config,
+            dispatch=self._dispatch,
+            reject=self._reject,
+            capacity=self._dispatch_capacity,
+            name=f"surge-gate{route.replace('/', '-')}",
+        )
+        if getattr(session, "priority", None) is not None and (
+            config.priority == "interactive"
+        ):
+            session.priority = INTERACTIVE_PRIORITY
+            # the scheduler's hot-check: queries waiting in the batcher
+            # are about to land in this session, so bulk sessions should
+            # already be deferring (session.has_data() alone only sees
+            # rows AFTER a flush)
+            session.backlog = lambda: self.admission.queued
+        with _GATES_LOCK:
+            _GATES.add(self)
+
+    # --- ingress ----------------------------------------------------------
+
+    def submit(self, req: PendingRequest) -> None:
+        """Admit + enqueue. Raises ShedError (shed with a status and a
+        Retry-After) or DeadlineExceeded (budget already spent)."""
+        now = time.monotonic()
+        if req.deadline <= now:
+            self._m_expired.inc()
+            raise DeadlineExceeded()
+        self.admission.admit(now)
+        req.enqueued_at = now
+        _deadline.register(req.key, req.deadline)
+        try:
+            self.batcher.put(req)
+        except RuntimeError:
+            _deadline.unregister(req.key)
+            self.admission.complete()
+            raise ShedError(503, "shutdown", 1.0) from None
+
+    def complete(
+        self, key: int | None = None, was_dispatched: bool = False
+    ) -> None:
+        """The response for an admitted request went out (any status)."""
+        if key is not None:
+            _deadline.unregister(key)
+        self.admission.complete()
+        if was_dispatched:
+            with self._disp_lock:
+                self._dispatched_pending = max(
+                    0, self._dispatched_pending - 1
+                )
+            self.batcher.notify()
+
+    def _dispatch_capacity(self) -> int:
+        with self._disp_lock:
+            return self.config.dispatch_window() - self._dispatched_pending
+
+    # --- batcher callbacks (batcher thread) -------------------------------
+
+    def _dispatch(self, reqs: list) -> None:
+        n = len(reqs)
+        now = time.monotonic()
+        self.session.insert_batch([(r.key, 1, r.vals) for r in reqs])
+        self.admission.on_flushed(n)
+        with self._disp_lock:
+            self._dispatched_pending += n
+        self._m_batch_rows.observe(n)
+        bucket = self.config.bucket_for(n)
+        self._m_occupancy.labels("gate", str(bucket)).observe(
+            min(1.0, n / bucket)
+        )
+        for r in reqs:
+            self._m_wait.observe(max(0.0, now - r.enqueued_at))
+            r.resolve_dispatched(n)
+
+    def _reject(self, req: Any, exc: BaseException) -> None:
+        self.admission.on_flushed(1)
+        if isinstance(exc, DeadlineExceeded):
+            self._m_expired.inc()
+        req.reject(exc)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """Stop admitting (503 + Retry-After), flush everything queued,
+        then wait for every admitted request's response. Returns True if
+        the gate went fully idle within the grace period."""
+        if grace_s is None:
+            grace_s = self.config.drain_grace_s
+        self.admission.start_drain()
+        self.batcher.drain()
+        return self.admission.wait_idle(grace_s)
+
+    def close(self) -> None:
+        """Hard stop: queued-but-undispatched requests fail with 503."""
+        if self._closed:
+            return
+        self._closed = True
+        self.admission.start_drain()
+        self.batcher.close(reject_queued=ShedError(503, "shutdown", 1.0))
+
+    @property
+    def queue_depth(self) -> int:
+        return self.admission.queued
+
+    @property
+    def inflight(self) -> int:
+        return self.admission.inflight
+
+
+def gates() -> list[SurgeGate]:
+    with _GATES_LOCK:
+        return list(_GATES)
+
+
+def drain_all(
+    grace_s: float | None = None, stop_webservers: bool = True
+) -> bool:
+    """Drain every live gate (stop admitting, flush, wait for in-flight
+    responses) and then stop their webservers. Returns True when every
+    gate went idle within its grace period."""
+    all_idle = True
+    current = gates()
+    for gate in current:
+        all_idle = gate.drain(grace_s) and all_idle
+    for gate in current:
+        gate.close()
+    if stop_webservers:
+        seen: set[int] = set()
+        for gate in current:
+            ws = gate.webserver
+            if ws is None or id(ws) in seen:
+                continue
+            seen.add(id(ws))
+            try:
+                ws.stop()
+            except Exception:
+                pass
+    return all_idle

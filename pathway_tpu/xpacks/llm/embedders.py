@@ -153,7 +153,10 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         ).labels(model)
         chips: list[int] = []  # resolved after the first forward
         from pathway_tpu.observability.tracing import get_tracer
+        from pathway_tpu.serving.metrics import occupancy_histogram
+        from pathway_tpu.xpacks.llm._encoder import _bucket_batch
 
+        m_occupancy = occupancy_histogram()
         _tracer = get_tracer()
 
         def embed_batch(texts: Sequence[str]) -> list[np.ndarray]:
@@ -175,6 +178,12 @@ class SentenceTransformerEmbedder(BaseEmbedder):
                 dt = _time.perf_counter() - t0
             m_batch_seconds.observe(dt, exemplar=sp.trace_id)
             m_docs.inc(len(texts))
+            # Surge Gate ladder visibility: how well realized batches
+            # fill the encoder's pad bucket (the shape XLA compiled for)
+            pad_bucket = _bucket_batch(len(texts))
+            m_occupancy.labels("embed", str(pad_bucket)).observe(
+                min(1.0, len(texts) / pad_bucket)
+            )
             if not chips:
                 # forward_ids just used the backend, so counting devices
                 # cannot trigger a fresh (possibly hanging) backend init

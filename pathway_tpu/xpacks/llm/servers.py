@@ -12,9 +12,10 @@ from pathway_tpu.io.http import PathwayWebserver, rest_connector
 
 
 class BaseRestServer:
-    def __init__(self, host: str, port: int, **rest_kwargs):
+    def __init__(self, host: str, port: int, qos: Any = None, **rest_kwargs):
         self.host = host
         self.port = port
+        self.qos = qos  # serving.QoSConfig applied to every route
         self.webserver = PathwayWebserver(host=host, port=port)
 
     def serve(
@@ -32,9 +33,16 @@ class BaseRestServer:
             methods=("POST",),
             delete_completed_queries=True,
             documentation=documentation,
+            qos=kwargs.pop("qos", self.qos),
         )
         result = handler(queries)
         writer(result.select(query_id=result.id, result=result.result))
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """Graceful overload exit: stop admitting (503 + Retry-After),
+        flush in-flight micro-batches, wait for every admitted request's
+        response, then shut the webserver down."""
+        return self.webserver.drain(grace_s)
 
     def run(
         self,

@@ -406,11 +406,13 @@ class VectorStoreServer:
         with_cache: bool = True,
         cache_backend: Any = None,
         terminate_on_error: bool = True,
+        qos: Any = None,
         **kwargs,
     ):
         from pathway_tpu.io.http import PathwayWebserver, rest_connector
 
         webserver = PathwayWebserver(host=host, port=port)
+        self._webserver = webserver
 
         def serve(route, schema, handler):
             queries, writer = rest_connector(
@@ -419,6 +421,7 @@ class VectorStoreServer:
                 schema=schema,
                 methods=("GET", "POST"),
                 delete_completed_queries=True,
+                qos=qos,
             )
             result = handler(queries)
             writer(result.select(query_id=result.id, result=result.result))
@@ -435,6 +438,16 @@ class VectorStoreServer:
             t.start()
             return t
         run()
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """Graceful shutdown of a running ``run_server``: stop admitting,
+        flush in-flight micro-batches, answer every admitted query, then
+        close the webserver (requires ``qos=`` to have enabled the gate;
+        ungated servers just stop the listener)."""
+        ws = getattr(self, "_webserver", None)
+        if ws is None:
+            return True
+        return ws.drain(grace_s)
 
     def __repr__(self):
         return f"VectorStoreServer({self.embedder!r})"

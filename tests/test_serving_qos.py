@@ -1,0 +1,664 @@
+"""Surge Gate (pathway_tpu/serving) tests: config, admission, EDF
+micro-batching, overload shedding, deadline drops, drain, and the
+webserver lifecycle fix."""
+
+import socket
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.serving import (
+    AdmissionController,
+    DeadlineExceeded,
+    MicroBatcher,
+    QoSConfig,
+    ShedError,
+    TokenBucket,
+    default_bucket_ladder,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Req:
+    def __init__(self, key, deadline):
+        self.key = key
+        self.vals = (key,)
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+
+
+# --- config ----------------------------------------------------------------
+
+
+def test_bucket_ladder():
+    assert default_bucket_ladder(32) == (1, 2, 4, 8, 16, 32)
+    cfg = QoSConfig(max_batch_size=32)
+    assert cfg.bucket_for(1) == 1
+    assert cfg.bucket_for(3) == 4
+    assert cfg.bucket_for(32) == 32
+    assert cfg.bucket_for(100) == 32  # clamped to the top rung
+    custom = QoSConfig(max_batch_size=10, batch_buckets=(4, 10))
+    assert custom.bucket_for(5) == 10
+
+
+def test_qos_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_QUEUE", "7")
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_BATCH", "4")
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_WAIT_MS", "2.5")
+    monkeypatch.setenv("PATHWAY_SERVING_RPS", "100")
+    cfg = QoSConfig.from_env()
+    assert cfg.max_queue == 7
+    assert cfg.max_batch_size == 4
+    assert cfg.max_wait_ms == 2.5
+    assert cfg.rate_limit_rps == 100.0
+    # base config survives where no env override exists
+    base = QoSConfig(default_deadline_ms=1234.0)
+    assert QoSConfig.from_env(base).default_deadline_ms == 1234.0
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_QUEUE", "nope")
+    with pytest.raises(ValueError):
+        QoSConfig.from_env()
+
+
+def test_qos_config_env_empty_values(monkeypatch):
+    # empty value on a mandatory knob = no override (common CI YAML
+    # artifact); on a None-able knob = clear back to None
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_QUEUE", "")
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_WAIT_MS", "")
+    monkeypatch.setenv("PATHWAY_SERVING_RPS", "")
+    cfg = QoSConfig.from_env(QoSConfig(rate_limit_rps=5.0))
+    assert cfg.max_queue == 256
+    assert cfg.max_wait_ms == 5.0
+    assert cfg.rate_limit_rps is None
+
+
+def test_qos_config_validation():
+    with pytest.raises(ValueError):
+        QoSConfig(max_queue=0)
+    with pytest.raises(ValueError):
+        QoSConfig(priority="bogus")
+    assert QoSConfig(max_dispatched=None).dispatch_window() == 64
+    assert QoSConfig(max_dispatched=5).dispatch_window() == 5
+
+
+# --- admission -------------------------------------------------------------
+
+
+def test_token_bucket():
+    tb = TokenBucket(rate=10.0, burst=2.0)
+    now = time.monotonic()
+    assert tb.try_acquire(now) == 0.0
+    assert tb.try_acquire(now) == 0.0
+    wait = tb.try_acquire(now)
+    assert 0.0 < wait <= 0.1  # ~1/rate until the next token
+    # tokens accrue with time
+    assert tb.try_acquire(now + 0.2) == 0.0
+
+
+def test_admission_queue_bound_and_reasons():
+    ctl = AdmissionController(
+        QoSConfig(max_queue=2, rate_limit_rps=None), route="/t"
+    )
+    ctl.admit()
+    ctl.admit()
+    with pytest.raises(ShedError) as e:
+        ctl.admit()
+    assert e.value.status == 429
+    assert e.value.reason == "queue_full"
+    assert e.value.retry_after_s > 0
+    ctl.on_flushed(2)
+    ctl.admit()  # space again
+    ctl.start_drain()
+    with pytest.raises(ShedError) as e:
+        ctl.admit()
+    assert e.value.status == 503
+    assert e.value.reason == "draining"
+    for _ in range(3):
+        ctl.complete()
+    assert ctl.wait_idle(1.0)
+
+
+def test_admission_concurrency_cap():
+    ctl = AdmissionController(QoSConfig(max_inflight=1), route="/c")
+    ctl.admit()
+    with pytest.raises(ShedError) as e:
+        ctl.admit()
+    assert e.value.reason == "concurrency"
+    ctl.complete()
+    ctl.admit()  # freed
+
+
+def test_admission_rate_limit():
+    ctl = AdmissionController(
+        QoSConfig(rate_limit_rps=5.0, rate_limit_burst=1.0), route="/r"
+    )
+    ctl.admit()
+    with pytest.raises(ShedError) as e:
+        ctl.admit()
+    assert e.value.reason == "rate_limit"
+    assert 0 < e.value.retry_after_s <= 0.5
+
+
+# --- micro-batcher ---------------------------------------------------------
+
+
+def test_microbatcher_edf_order_and_expiry():
+    got = []
+    mb = MicroBatcher(
+        QoSConfig(max_batch_size=8, max_wait_ms=20),
+        dispatch=lambda rs: got.append([r.key for r in rs]),
+        reject=lambda r, e: got.append(("rej", r.key, type(e).__name__)),
+    )
+    try:
+        now = time.monotonic()
+        mb.put(_Req(1, now + 5))
+        mb.put(_Req(2, now + 1))
+        mb.put(_Req(3, now + 3))
+        deadline = time.time() + 2
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [[2, 3, 1]]  # earliest deadline first
+        mb.put(_Req(4, now - 1))  # already expired: dropped at flush
+        deadline = time.time() + 2
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got[1] == ("rej", 4, "DeadlineExceeded")
+    finally:
+        mb.close()
+
+
+def test_microbatcher_flushes_full_batch_immediately():
+    got = []
+    mb = MicroBatcher(
+        QoSConfig(max_batch_size=4, max_wait_ms=10_000),
+        dispatch=lambda rs: got.append(len(rs)),
+        reject=lambda r, e: None,
+    )
+    try:
+        now = time.monotonic()
+        for i in range(4):
+            mb.put(_Req(i, now + 60))
+        deadline = time.time() + 2
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        # size trigger fired long before the 10 s wait trigger
+        assert got == [4]
+    finally:
+        mb.close()
+
+
+def test_microbatcher_respects_dispatch_window():
+    got = []
+    cap = {"n": 2}  # like the gate: dispatch consumes window capacity
+
+    def dispatch(rs):
+        cap["n"] -= len(rs)
+        got.append([r.key for r in rs])
+
+    mb = MicroBatcher(
+        QoSConfig(max_batch_size=8, max_wait_ms=5),
+        dispatch=dispatch,
+        reject=lambda r, e: got.append(("rej", r.key)),
+        capacity=lambda: cap["n"],
+    )
+    try:
+        now = time.monotonic()
+        for i in range(5):
+            mb.put(_Req(i, now + 60))
+        deadline = time.time() + 2
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)
+        # only the window's worth released while capacity is exhausted
+        assert got == [[0, 1]]
+        cap["n"] = 8  # responses went out: window frees up
+        mb.notify()
+        deadline = time.time() + 2
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got[1] == [2, 3, 4]
+    finally:
+        mb.close()
+
+
+def test_microbatcher_close_rejects_queued():
+    got = []
+    mb = MicroBatcher(
+        QoSConfig(max_batch_size=8, max_wait_ms=10_000),
+        dispatch=lambda rs: got.append(len(rs)),
+        reject=lambda r, e: got.append(("rej", r.key, type(e).__name__)),
+    )
+    now = time.monotonic()
+    mb.put(_Req(1, now + 60))
+    mb.close(reject_queued=ShedError(503, "shutdown", 1.0))
+    assert ("rej", 1, "ShedError") in got
+
+
+# --- REST end-to-end -------------------------------------------------------
+
+
+def _serve_slow_pipeline(qos, sleep_s=0.25):
+    """rest_connector + a deliberately slow per-row UDF; returns
+    (port, run_thread, seen_texts)."""
+    import requests  # noqa: F401  (ensures dep present before server up)
+
+    from pathway_tpu.io.http import rest_connector
+
+    seen: list[str] = []
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    @pw.udf
+    def slow_upper(text: str) -> str:
+        seen.append(text)
+        time.sleep(sleep_s)
+        return text.upper()
+
+    port = _free_port()
+    queries, writer = rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=QuerySchema,
+        route="/upper",
+        qos=qos,
+    )
+    writer(
+        queries.select(query_id=queries.id, result=slow_upper(queries.text))
+    )
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    return port, t, seen
+
+
+def _await_up(port, route="/upper", payload=None, timeout=20):
+    import requests
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            r = requests.post(
+                f"http://127.0.0.1:{port}{route}",
+                json=payload or {"text": "warmup"},
+                timeout=5,
+            )
+            if r.status_code == 200:
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError("server did not come up")
+
+
+def test_rest_overload_sheds_429_with_retry_after():
+    import requests
+
+    qos = QoSConfig(
+        max_batch_size=2,
+        max_wait_ms=5,
+        max_queue=3,
+        max_dispatched=2,
+        default_deadline_ms=30_000,
+    )
+    port, t, _seen = _serve_slow_pipeline(qos)
+    try:
+        _await_up(port)
+        results = []
+
+        def worker(i):
+            try:
+                r = requests.post(
+                    f"http://127.0.0.1:{port}/upper",
+                    json={"text": f"w{i}"},
+                    timeout=30,
+                )
+                results.append(
+                    (r.status_code, r.headers.get("Retry-After"), r.json())
+                )
+            except Exception as e:  # pragma: no cover - diagnostics
+                results.append(("err", None, str(e)))
+
+        ws = [
+            threading.Thread(target=worker, args=(i,)) for i in range(12)
+        ]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        statuses = Counter(s for s, _, _ in results)
+        assert statuses[200] >= 1
+        assert statuses[429] >= 1, statuses  # explicit shed, not queueing
+        assert "err" not in statuses, results
+        for status, retry_after, body in results:
+            if status == 429:
+                assert retry_after is not None
+                assert float(retry_after) >= 0
+            if status == 200:
+                assert body.startswith("W")
+    finally:
+        pw.internals.parse_graph.G.runtime.stop()
+        t.join(timeout=10)
+
+
+def test_rest_expired_deadline_never_dispatched():
+    """A request whose deadline passes while stuck behind a full
+    dispatch window is dropped server-side: 504, and the pipeline UDF
+    never sees its payload."""
+    import requests
+
+    qos = QoSConfig(
+        max_batch_size=1,
+        max_wait_ms=2,
+        max_queue=8,
+        max_dispatched=1,
+        default_deadline_ms=30_000,
+    )
+    port, t, seen = _serve_slow_pipeline(qos, sleep_s=0.4)
+    try:
+        _await_up(port)
+        # occupy the dispatch window with slow requests...
+        blockers = [
+            threading.Thread(
+                target=lambda i=i: __import__("requests").post(
+                    f"http://127.0.0.1:{port}/upper",
+                    json={"text": f"blocker{i}"},
+                    timeout=30,
+                ),
+            )
+            for i in range(3)
+        ]
+        for b in blockers:
+            b.start()
+        time.sleep(0.15)  # let blockers reach the engine
+        # ...then a tight-deadline request that must expire while queued
+        r = requests.post(
+            f"http://127.0.0.1:{port}/upper",
+            json={"text": "mustexpire"},
+            headers={"x-pathway-deadline-ms": "50"},
+            timeout=10,
+        )
+        assert r.status_code == 504
+        for b in blockers:
+            b.join()
+        time.sleep(0.5)  # any wrong dispatch would have been seen by now
+        assert "mustexpire" not in seen
+    finally:
+        pw.internals.parse_graph.G.runtime.stop()
+        t.join(timeout=10)
+
+
+def test_rest_drain_completes_admitted_requests():
+    """Drain under in-flight load: every admitted request is answered,
+    post-drain requests are refused, the listener closes."""
+    import requests
+
+    from pathway_tpu.serving import drain_all
+
+    qos = QoSConfig(
+        max_batch_size=4,
+        max_wait_ms=5,
+        max_queue=32,
+        default_deadline_ms=30_000,
+    )
+    port, t, _seen = _serve_slow_pipeline(qos, sleep_s=0.05)
+    try:
+        _await_up(port)
+        results = []
+        stop_firing = threading.Event()
+
+        def worker(i):
+            while not stop_firing.is_set():
+                try:
+                    r = requests.post(
+                        f"http://127.0.0.1:{port}/upper",
+                        json={"text": f"d{i}"},
+                        timeout=30,
+                    )
+                    results.append((r.status_code, r.json()))
+                except Exception:
+                    results.append(("conn", None))
+                    return
+
+        ws = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for w in ws:
+            w.start()
+        time.sleep(0.5)  # load in flight
+        assert drain_all(grace_s=15)  # True = all gates went idle
+        stop_firing.set()
+        for w in ws:
+            w.join(timeout=10)
+        statuses = Counter(s for s, _ in results)
+        assert statuses[200] >= 1
+        # every non-200 is an explicit drain refusal or the closed
+        # listener — nothing hung, nothing lost mid-pipeline
+        assert set(statuses) <= {200, 503, "conn"}, statuses
+        for status, body in results:
+            if status == 200:
+                assert body and body.startswith("D")
+        # listener is really closed
+        with pytest.raises(Exception):
+            requests.post(
+                f"http://127.0.0.1:{port}/upper",
+                json={"text": "late"},
+                timeout=2,
+            )
+    finally:
+        pw.internals.parse_graph.G.runtime.stop()
+        t.join(timeout=10)
+
+
+def test_webserver_stop_releases_port_on_runtime_stop():
+    """Satellite: runtime.stop() must close the aiohttp listener (the
+    seed leaked the daemon thread + socket forever)."""
+    import requests
+
+    from pathway_tpu.io.http import rest_connector
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    port = _free_port()
+    queries, writer = rest_connector(
+        host="127.0.0.1", port=port, schema=QuerySchema, route="/echo"
+    )
+    writer(queries.select(query_id=queries.id, result=queries.text))
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    _await_up(port, route="/echo")
+    pw.internals.parse_graph.G.runtime.stop()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    deadline = time.time() + 5
+    closed = False
+    while time.time() < deadline:
+        try:
+            requests.post(
+                f"http://127.0.0.1:{port}/echo",
+                json={"text": "x"},
+                timeout=1,
+            )
+        except Exception:
+            closed = True
+            break
+        time.sleep(0.1)
+    assert closed, "webserver still accepting connections after stop"
+
+
+def test_non_finite_deadline_header_falls_back_to_default():
+    """A 'nan' budget must not slip past the clamp (it would hang the
+    handler and permanently leak a queue slot) — it reads as absent."""
+    import requests
+
+    qos = QoSConfig(max_batch_size=4, max_wait_ms=5, max_queue=8)
+    port, t, _seen = _serve_slow_pipeline(qos, sleep_s=0.01)
+    try:
+        _await_up(port)
+        for bad in ("nan", "inf", "-inf", "garbage"):
+            r = requests.post(
+                f"http://127.0.0.1:{port}/upper",
+                json={"text": "ok"},
+                headers={"x-pathway-deadline-ms": bad},
+                timeout=10,
+            )
+            assert r.status_code == 200, (bad, r.status_code)
+        from pathway_tpu.serving import gates
+
+        assert all(g.queue_depth == 0 and g.inflight == 0 for g in gates())
+    finally:
+        pw.internals.parse_graph.G.runtime.stop()
+        t.join(timeout=10)
+
+
+def test_input_session_drain_bounds_upserts():
+    """The bulk-chunk bound applies to upsert-fed sessions too, and the
+    offset marker only surfaces once everything it covers drained."""
+    from pathway_tpu.engine.runtime import InputSession
+
+    sess = InputSession(["v"])
+    sess.insert_batch(
+        [(i, 1, (i,)) for i in range(3)], offsets={"at": 3}
+    )
+    for k in range(100, 110):
+        sess.upsert(k, (k,))
+    first = sess.drain(max_rows=5)
+    assert len(first) == 5  # 3 rows + 2 upserts
+    assert sess.last_offsets is None  # partial: offsets still pending
+    rest = sess.drain(max_rows=100)
+    assert len(rest) == 8
+    assert sess.last_offsets == {"at": 3}
+    assert {r[0] for r in first + rest} == set(range(3)) | set(
+        range(100, 110)
+    )
+
+
+def test_gated_session_is_interactive_priority():
+    from pathway_tpu.engine.runtime import InputSession
+    from pathway_tpu.serving.gate import SurgeGate
+
+    session = InputSession(["text"])
+    assert session.priority == InputSession.PRIORITY_BULK
+    gate = SurgeGate(QoSConfig(), session, route="/p")
+    try:
+        assert session.priority == InputSession.PRIORITY_INTERACTIVE
+    finally:
+        gate.close()
+    session2 = InputSession(["text"])
+    gate2 = SurgeGate(QoSConfig(priority="bulk"), session2, route="/p2")
+    try:
+        assert session2.priority == InputSession.PRIORITY_BULK
+    finally:
+        gate2.close()
+
+
+def test_graph_doctor_serving_admission_rule():
+    from pathway_tpu.analysis import run_doctor
+    from pathway_tpu.io.http import rest_connector
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    ungated, writer = rest_connector(
+        host="127.0.0.1",
+        port=_free_port(),
+        schema=QuerySchema,
+        route="/ungated",
+    )
+    writer(ungated.select(query_id=ungated.id, result=ungated.text))
+    gated, writer2 = rest_connector(
+        host="127.0.0.1",
+        port=_free_port(),
+        schema=QuerySchema,
+        route="/gated",
+        qos=QoSConfig(),
+    )
+    writer2(gated.select(query_id=gated.id, result=gated.text))
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    hits = report.by_rule("serving-admission")
+    assert len(hits) == 1  # exactly the ungated ingress
+
+
+def test_serving_enabled_via_env_gates_rest_connector(monkeypatch):
+    from pathway_tpu.io.http import rest_connector
+
+    monkeypatch.setenv("PATHWAY_SERVING_ENABLED", "1")
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_QUEUE", "5")
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    queries, writer = rest_connector(
+        host="127.0.0.1",
+        port=_free_port(),
+        schema=QuerySchema,
+        route="/env",
+    )
+    writer(queries.select(query_id=queries.id, result=queries.text))
+    from pathway_tpu.analysis import run_doctor
+
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    assert not report.by_rule("serving-admission")
+
+
+def test_knn_skips_expired_queries(monkeypatch):
+    """Deadline propagation through the tick: the external-index exec
+    answers expired queries empty without calling the index."""
+    from pathway_tpu.serving import deadline as sdl
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import USearchKnn
+
+    import numpy as np
+
+    @pw.udf
+    def emb(text: str) -> np.ndarray:
+        v = np.zeros(4, dtype=np.float32)
+        for ch in str(text).lower():
+            v[ord(ch) % 4] += 1.0
+        n = np.linalg.norm(v)
+        return v / n if n > 0 else v
+
+    from pathway_tpu.debug import T, table_to_dicts
+
+    docs = T(
+        """
+        text
+        apple
+        banana
+        """
+    )
+    docs = docs.with_columns(embedding=emb(docs.text))
+    index = DataIndex(
+        docs, USearchKnn(docs.embedding, dimensions=4)
+    )
+    queries = T(
+        """
+        qtext | k
+        apple | 1
+        """
+    )
+    queries = queries.with_columns(_q=emb(queries.qtext))
+    # register an expired deadline for the query row key
+    [qkey] = list(table_to_dicts(queries)[0])
+    sdl.register(int(qkey), time.monotonic() - 1.0)
+    try:
+        jr = index.query_as_of_now(queries._q, number_of_matches=queries.k)
+        from pathway_tpu.internals.thisclass import right
+        from pathway_tpu.stdlib.indexing.colnames import _SCORE
+
+        out = jr.select(score=right[_SCORE])
+        _keys, cols = table_to_dicts(out)
+        # expired query got the empty reply without a search: no match
+        # scores (a live query would carry a non-empty score tuple)
+        assert cols["score"] and all(not v for v in cols["score"].values())
+    finally:
+        sdl.unregister(int(qkey))
