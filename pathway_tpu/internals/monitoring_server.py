@@ -41,6 +41,14 @@ BASE_PORT = 20000
 
 logger = logging.getLogger("pathway_tpu")
 
+# one server per requested (host, port) per process: a second monitored
+# run re-attaches its runtime to the existing server instead of leaking
+# a new thread per run and falling back to an ephemeral port — which
+# would leave the canonical scrape port serving the finished run's
+# frozen stats forever
+_servers: dict[tuple[str, int], ThreadingHTTPServer] = {}
+_servers_lock = threading.Lock()
+
 
 def _monitoring_host() -> str:
     return os.environ.get("PATHWAY_MONITORING_HOST", "127.0.0.1")
@@ -198,6 +206,32 @@ def start_http_server(
     if runtime is not None:
         bridge.attach(runtime)
     install_jax_metrics(REGISTRY)
+    with _servers_lock:
+        existing = _servers.get((host, port))
+        if existing is not None and existing.socket.fileno() == -1:
+            # closed without going through the shutdown wrapper
+            del _servers[(host, port)]
+            existing = None
+    if existing is not None:
+        existing._pw_set_runtime(runtime)  # type: ignore[attr-defined]
+        if runtime is not None:
+            runtime.http_server = existing
+        return existing
+
+    # the handler resolves the runtime per request through this weak
+    # cell: serving must not pin a finished run's whole graph in memory
+    # (the bridge holds runtimes weakly for the same reason), and the
+    # next run re-points the cell at its runtime
+    cell: dict = {"ref": None}
+
+    def set_runtime(rt) -> None:
+        cell["ref"] = weakref.ref(rt) if rt is not None else None
+
+    def current_runtime():
+        ref = cell["ref"]
+        return ref() if ref is not None else None
+
+    set_runtime(runtime)
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(
@@ -210,6 +244,7 @@ def start_http_server(
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
+            runtime = current_runtime()
             parsed = urlparse(self.path)
             route = parsed.path.rstrip("/")
             try:
@@ -314,6 +349,25 @@ def start_http_server(
             "ephemeral port %d instead",
             host, port, exc, server.server_address[1],
         )
+    server._pw_set_runtime = set_runtime  # type: ignore[attr-defined]
+    real_shutdown = server.shutdown
+
+    def shutdown_and_deregister() -> None:
+        with _servers_lock:
+            if _servers.get((host, port)) is server:
+                del _servers[(host, port)]
+        real_shutdown()
+        # shutdown() only stops serve_forever; the listening socket
+        # would stay bound and its backlog would swallow scrapes of the
+        # canonical port without ever replying
+        server.server_close()
+
+    server.shutdown = shutdown_and_deregister  # type: ignore[method-assign]
+    with _servers_lock:
+        # keyed by the REQUESTED port: the next run asking for the
+        # canonical port reuses this server even when a foreign process
+        # forced the ephemeral fallback
+        _servers[(host, port)] = server
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     if runtime is not None:

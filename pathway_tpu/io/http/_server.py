@@ -70,6 +70,8 @@ class PathwayWebserver:
         self._stopped = False
         self._lock = threading.Lock()
         self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_ready = threading.Event()
+        self._stop_async: Any = None  # threadsafe resolver of the stop event
         self._thread: threading.Thread | None = None
         self._runner: web.AppRunner | None = None
         self._gates: list[Any] = []  # SurgeGates of this server's routes
@@ -129,19 +131,32 @@ class PathwayWebserver:
             loop = asyncio.new_event_loop()
             self._loop = loop
             asyncio.set_event_loop(loop)
-            # short shutdown_timeout: stop() must not hang behind a
-            # stuck keep-alive connection (drain already waited for the
-            # responses that matter)
-            runner = web.AppRunner(self._app, shutdown_timeout=1.0)
-            self._runner = runner
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, self.host, self.port)
-            loop.run_until_complete(site.start())
-            loop.run_forever()
-            # stop() arrived: release sockets + pending handlers, then
-            # close the loop so the thread exits without leaking fds
-            loop.run_until_complete(runner.cleanup())
-            loop.close()
+            stop_ev = asyncio.Event()
+            self._stop_async = lambda: loop.call_soon_threadsafe(stop_ev.set)
+            self._loop_ready.set()
+
+            async def main():
+                # short shutdown_timeout: stop() must not hang behind a
+                # stuck keep-alive connection (drain already waited for
+                # the responses that matter)
+                runner = web.AppRunner(self._app, shutdown_timeout=1.0)
+                self._runner = runner
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                # serve until stop(); a stop that landed while the site
+                # was coming up (including one whose _loop_ready wait
+                # timed out, so _stop_async was never called) skips the
+                # wait — startup is never interrupted mid-await, and
+                # cleanup always releases sockets + pending handlers
+                if not self._stopped:
+                    await stop_ev.wait()
+                await runner.cleanup()
+
+            try:
+                loop.run_until_complete(main())
+            finally:
+                loop.close()
 
         self._thread = threading.Thread(target=run_loop, daemon=True)
         self._thread.start()
@@ -172,10 +187,16 @@ class PathwayWebserver:
             if not self._started or self._stopped:
                 return
             self._stopped = True
-        loop = self._loop
-        if loop is not None:
+        # stop() can race the server thread's startup: wait until the
+        # loop exists (the ready event is set before any aiohttp setup
+        # work, so this wait is bounded by loop creation alone), then
+        # resolve the async stop event — it lands whether main() is
+        # still starting up or already serving.
+        self._loop_ready.wait(timeout)
+        stop_async = self._stop_async
+        if stop_async is not None:
             try:
-                loop.call_soon_threadsafe(loop.stop)
+                stop_async()
             except RuntimeError:
                 pass  # loop already closed
         if self._thread is not None:
@@ -470,15 +491,21 @@ class RestServerSubject(ConnectorSubject):
             with self._futures_lock:
                 self._futures.pop(key, None)
             if admitted:
+                # settle the race with the batcher atomically: a
+                # handler cancelled (client disconnect) while its
+                # request is still queued abandons it, so the flush
+                # skips the row — it must not claim an engine batch
+                # slot or a dispatch-window slot nobody will ever free
+                was_dispatched = not req.abandon()
                 self._gate.complete(
                     None if timed_out else key,
-                    was_dispatched=req.was_dispatched,
+                    was_dispatched=was_dispatched,
                 )
-            if req.was_dispatched and self._delete_completed:
-                try:
-                    self._session.remove(key, vals)
-                except Exception:
-                    pass
+                if was_dispatched and self._delete_completed:
+                    try:
+                        self._session.remove(key, vals)
+                    except Exception:
+                        pass
         return web.json_response(result)
 
     def _deliver(self, key: int, payload: Any) -> None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from pathway_tpu.serving import metrics as _metrics
 from pathway_tpu.serving.config import QoSConfig
@@ -77,12 +78,21 @@ class AdmissionController:
         self._idle.set()
         self._m_shed = _metrics.shed_counter()
         self._m_admitted = _metrics.admitted_counter().labels(route)
-        _metrics.queue_depth_gauge().labels(route).set_function(
-            lambda: self.queued
-        )
-        _metrics.inflight_gauge().labels(route).set_function(
-            lambda: self.inflight
-        )
+        # the process-wide registry holds these callbacks forever: keep
+        # the controller weakly referenced so a torn-down endpoint's
+        # admission state can be collected (the gauge then reads 0)
+        ref = weakref.ref(self)
+
+        def _queued_now() -> int:
+            ctl = ref()
+            return ctl.queued if ctl is not None else 0
+
+        def _inflight_now() -> int:
+            ctl = ref()
+            return ctl.inflight if ctl is not None else 0
+
+        _metrics.queue_depth_gauge().labels(route).set_function(_queued_now)
+        _metrics.inflight_gauge().labels(route).set_function(_inflight_now)
 
     def _shed(self, status: int, reason: str, retry_after_s: float):
         self._m_shed.labels(self.route, reason).inc()

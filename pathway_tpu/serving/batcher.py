@@ -97,7 +97,12 @@ class MicroBatcher:
             self._cond.notify()
         for req in leftovers:
             self._reject(req, reject_queued)
-        if self._thread.is_alive():
+        # close may run from a GC finalizer on an arbitrary thread —
+        # including this batcher's own (joining yourself raises)
+        if (
+            self._thread.is_alive()
+            and self._thread is not threading.current_thread()
+        ):
             self._thread.join(timeout=5)
 
     # --- flush loop -------------------------------------------------------

@@ -36,6 +36,15 @@ _GATES_LOCK = threading.Lock()
 
 INTERACTIVE_PRIORITY = 0  # InputSession.priority value for gated queries
 
+# PendingRequest lifecycle. The handler's teardown (client may have
+# disconnected while the request sat in the batcher queue) and the
+# batcher's flush race on the same request from two threads; the state
+# transition decides, atomically, whether the request reaches the
+# engine (DISPATCHED) or is forgotten (ABANDONED) — never both.
+_PENDING = 0
+_DISPATCHED = 1
+_ABANDONED = 2
+
 
 class PendingRequest:
     """One admitted-or-not REST request crossing the gate."""
@@ -47,7 +56,8 @@ class PendingRequest:
         "enqueued_at",
         "loop",
         "dispatched",
-        "was_dispatched",
+        "_state",
+        "_state_lock",
     )
 
     def __init__(
@@ -67,10 +77,33 @@ class PendingRequest:
         # or errors with DeadlineExceeded/ShedError when it is dropped
         self.loop = loop
         self.dispatched = dispatched
-        self.was_dispatched = False
+        self._state = _PENDING
+        self._state_lock = threading.Lock()
+
+    @property
+    def was_dispatched(self) -> bool:
+        return self._state == _DISPATCHED
+
+    def try_mark_dispatched(self) -> bool:
+        """Batcher side: claim the request for dispatch. False means
+        the handler abandoned it — skip it entirely."""
+        with self._state_lock:
+            if self._state == _ABANDONED:
+                return False
+            self._state = _DISPATCHED
+            return True
+
+    def abandon(self) -> bool:
+        """Handler side: True iff the request never reached (and now
+        never will reach) the engine, so the handler owes no
+        dispatch-window slot; False = it was dispatched."""
+        with self._state_lock:
+            if self._state == _DISPATCHED:
+                return False
+            self._state = _ABANDONED
+            return True
 
     def resolve_dispatched(self, batch_size: int) -> None:
-        self.was_dispatched = True
         if self.loop is None or self.dispatched is None:
             return
         fut = self.dispatched
@@ -123,13 +156,7 @@ class SurgeGate:
         # the bounded admission queue, not the InputSession
         self._disp_lock = threading.Lock()
         self._dispatched_pending = 0
-        self.batcher = MicroBatcher(
-            config,
-            dispatch=self._dispatch,
-            reject=self._reject,
-            capacity=self._dispatch_capacity,
-            name=f"surge-gate{route.replace('/', '-')}",
-        )
+        self.batcher = _make_batcher(self)
         if getattr(session, "priority", None) is not None and (
             config.priority == "interactive"
         ):
@@ -137,8 +164,11 @@ class SurgeGate:
             # the scheduler's hot-check: queries waiting in the batcher
             # are about to land in this session, so bulk sessions should
             # already be deferring (session.has_data() alone only sees
-            # rows AFTER a flush)
-            session.backlog = lambda: self.admission.queued
+            # rows AFTER a flush). Closes over the admission controller,
+            # not the gate — sessions outlive runs (G.last_runtime) and
+            # must not pin the gate (and its batcher thread) with them.
+            admission = self.admission
+            session.backlog = lambda: admission.queued
         with _GATES_LOCK:
             _GATES.add(self)
 
@@ -157,7 +187,10 @@ class SurgeGate:
         try:
             self.batcher.put(req)
         except RuntimeError:
+            # the request never entered the queue: undo BOTH admission
+            # counters (admit bumped queued and inflight)
             _deadline.unregister(req.key)
+            self.admission.on_flushed(1)
             self.admission.complete()
             raise ShedError(503, "shutdown", 1.0) from None
 
@@ -182,20 +215,41 @@ class SurgeGate:
     # --- batcher callbacks (batcher thread) -------------------------------
 
     def _dispatch(self, reqs: list) -> None:
-        n = len(reqs)
         now = time.monotonic()
-        self.session.insert_batch([(r.key, 1, r.vals) for r in reqs])
-        self.admission.on_flushed(n)
+        # window slots are claimed for the WHOLE batch before any
+        # request is marked dispatched: a handler releases its slot
+        # only after try_mark_dispatched flipped the state, so the
+        # release can never run ahead of this increment and be clamped
+        # away (which would leak the slot and wedge the gate); if the
+        # insert below raises, the handlers still observe
+        # was_dispatched and release their slots in complete()
         with self._disp_lock:
-            self._dispatched_pending += n
-        self._m_batch_rows.observe(n)
-        bucket = self.config.bucket_for(n)
-        self._m_occupancy.labels("gate", str(bucket)).observe(
-            min(1.0, n / bucket)
-        )
-        for r in reqs:
-            self._m_wait.observe(max(0.0, now - r.enqueued_at))
-            r.resolve_dispatched(n)
+            self._dispatched_pending += len(reqs)
+        # claim each request atomically: a handler whose client went
+        # away while the request sat in the queue marked it abandoned —
+        # it must not burn an engine batch slot, and its window slots
+        # are returned right here (nobody else will)
+        live = [r for r in reqs if r.try_mark_dispatched()]
+        n = len(live)
+        if n < len(reqs):
+            with self._disp_lock:
+                self._dispatched_pending = max(
+                    0, self._dispatched_pending - (len(reqs) - n)
+                )
+        if n:
+            self.session.insert_batch([(r.key, 1, r.vals) for r in live])
+            self._m_batch_rows.observe(n)
+            bucket = self.config.bucket_for(n)
+            self._m_occupancy.labels("gate", str(bucket)).observe(
+                min(1.0, n / bucket)
+            )
+            for r in live:
+                self._m_wait.observe(max(0.0, now - r.enqueued_at))
+                r.resolve_dispatched(n)
+        # counted LAST: if anything above raised, the batcher's
+        # catch-all _rejects every request and _reject does its own
+        # on_flushed — counting here too would double-decrement queued
+        self.admission.on_flushed(len(reqs))
 
     def _reject(self, req: Any, exc: BaseException) -> None:
         self.admission.on_flushed(1)
@@ -230,6 +284,43 @@ class SurgeGate:
     @property
     def inflight(self) -> int:
         return self.admission.inflight
+
+
+def _make_batcher(gate: SurgeGate) -> MicroBatcher:
+    """Wire the batcher callbacks through a weakref so the daemon flush
+    thread never keeps the gate alive: a graph torn down without an
+    explicit stop lets the gate (and its metric callbacks) be
+    collected, at which point the finalizer closes the thread instead
+    of leaking one per endpoint."""
+    ref = weakref.ref(gate)
+    config = gate.config
+
+    def dispatch(reqs: list) -> None:
+        g = ref()
+        if g is None:
+            raise RuntimeError("gate collected")
+        g._dispatch(reqs)
+
+    def reject(req: Any, exc: BaseException) -> None:
+        g = ref()
+        if g is None:
+            req.reject(exc)
+        else:
+            g._reject(req, exc)
+
+    def capacity() -> int:
+        g = ref()
+        return config.max_batch_size if g is None else g._dispatch_capacity()
+
+    batcher = MicroBatcher(
+        config,
+        dispatch=dispatch,
+        reject=reject,
+        capacity=capacity,
+        name=f"surge-gate{gate.route.replace('/', '-')}",
+    )
+    weakref.finalize(gate, batcher.close)
+    return batcher
 
 
 def gates() -> list[SurgeGate]:

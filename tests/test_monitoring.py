@@ -76,6 +76,52 @@ def test_metrics_http_endpoint():
         server.shutdown()
 
 
+def test_monitoring_server_reused_across_runs_without_pinning_runtime():
+    """A second monitored run must re-attach to the existing server on
+    the same port (no thread leak, no ephemeral-port fallback serving
+    stale stats) and a finished run's graph must stay collectable — the
+    handler holds the runtime weakly."""
+    import gc
+    import weakref
+
+    from pathway_tpu.engine.nodes import InputNode
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+    from pathway_tpu.internals import monitoring_server as ms
+
+    class _Empty(StaticSource):
+        def events(self):
+            return iter(())
+
+    port = _free_port()
+    rt1 = Runtime([InputNode(_Empty(["a"]), ["a"])])
+    rt1.run_static()
+    server = ms.start_http_server(rt1, port=port)
+    try:
+        rt2 = Runtime([InputNode(_Empty(["a"]), ["a"])])
+        rt2.run_static()
+        assert ms.start_http_server(rt2, port=port) is server
+        ref = weakref.ref(rt1)
+        del rt1
+        gc.collect()
+        assert ref() is None, "monitoring handler pinned a finished run"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=5
+        ) as resp:
+            status = json.loads(resp.read().decode())
+        assert status["ticks"] >= 1  # rt2's stats, served live
+    finally:
+        server.shutdown()
+    # shutdown deregisters AND releases the socket: a fresh start must
+    # bind the canonical port again, not fall back to ephemeral
+    assert (ms._monitoring_host(), port) not in ms._servers
+    fresh = ms.start_http_server(None, port=port)
+    try:
+        assert fresh is not server
+        assert fresh.server_address[1] == port
+    finally:
+        fresh.shutdown()
+
+
 def test_process_gauges_and_metrics_endpoint():
     """Process CPU/mem gauges (reference: telemetry.rs:359-416) surface on
     the Prometheus endpoint alongside operator latency and frontier lag."""
@@ -432,12 +478,17 @@ def test_monitoring_host_env(monkeypatch):
 
 
 def test_port_conflict_falls_back_to_ephemeral(caplog):
+    """A port held by a FOREIGN process falls back to ephemeral with a
+    warning; this process's own server on that port is reused instead
+    (no per-run server leak)."""
     import logging
 
     from pathway_tpu.internals.monitoring_server import start_http_server
 
-    first = start_http_server(None, port=_free_port())
-    taken = first.server_address[1]
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
     try:
         with caplog.at_level(logging.WARNING, logger="pathway_tpu"):
             second = start_http_server(None, port=taken)
@@ -448,7 +499,10 @@ def test_port_conflict_falls_back_to_ephemeral(caplog):
                 "ephemeral" in rec.message for rec in caplog.records
             )
             assert "pathway_build_info" in _scrape(actual)
+            # same requested port from THIS process: reuse, not another
+            # fallback server
+            assert start_http_server(None, port=taken) is second
         finally:
             second.shutdown()
     finally:
-        first.shutdown()
+        blocker.close()

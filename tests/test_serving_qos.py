@@ -243,6 +243,141 @@ def test_microbatcher_close_rejects_queued():
     assert ("rej", 1, "ShedError") in got
 
 
+# --- gate accounting (review regressions) ----------------------------------
+
+
+class _FakeSession:
+    """Minimal InputSession stand-in for gate-level unit tests (no
+    `priority` attribute, so the gate skips the scheduler wiring)."""
+
+    def __init__(self, fail: bool = False):
+        self.rows: list = []
+        self.fail = fail
+
+    def insert_batch(self, rows) -> None:
+        if self.fail:
+            raise RuntimeError("insert failed")
+        self.rows.extend(rows)
+
+
+def _pending(key, deadline):
+    from pathway_tpu.serving.gate import PendingRequest
+
+    return PendingRequest(key, (key,), deadline)
+
+
+def test_abandoned_request_skipped_and_window_slot_not_leaked():
+    """Client disconnect while the request is still queued: the flush
+    must skip the row (never reaches the engine) and must not claim a
+    dispatch-window slot — a leaked slot would wedge the gate for good
+    once _dispatch_capacity() hits zero."""
+    from pathway_tpu.serving.gate import SurgeGate
+
+    session = _FakeSession()
+    gate = SurgeGate(
+        QoSConfig(max_batch_size=4, max_wait_ms=5), session, route="/ab"
+    )
+    try:
+        now = time.monotonic()
+        live = _pending(1, now + 60)
+        gone = _pending(2, now + 60)
+        gate.submit(live)
+        gate.submit(gone)
+        # handler teardown on cancellation: abandon, then complete
+        assert gone.abandon()
+        gate.complete(gone.key, was_dispatched=False)
+        deadline = time.time() + 2
+        while not session.rows and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)  # a wrong dispatch of `gone` would land now
+        assert [r[0] for r in session.rows] == [1]
+        assert gone.abandon()  # still abandoned, never flipped
+        assert gate._dispatched_pending == 1  # only the live request
+        assert gate.queue_depth == 0  # both left the queue exactly once
+        gate.complete(live.key, was_dispatched=not live.abandon())
+        assert gate._dispatched_pending == 0
+        assert gate.inflight == 0
+    finally:
+        gate.close()
+
+
+def test_dispatch_wins_abandon_race_claims_slot():
+    """The losing side of the teardown race must see was_dispatched:
+    once the batcher claimed the request, abandon() returns False and
+    the handler releases the window slot it owns."""
+    req = _pending(1, time.monotonic() + 60)
+    assert req.try_mark_dispatched()
+    assert not req.abandon()  # handler: owes the slot
+    assert req.was_dispatched
+    req2 = _pending(2, time.monotonic() + 60)
+    assert req2.abandon()
+    assert not req2.try_mark_dispatched()  # batcher: skip entirely
+    assert not req2.was_dispatched
+
+
+def test_submit_shutdown_race_does_not_leak_queue_depth():
+    """Batcher already closed but admission not yet draining: the
+    ShedError path must roll back BOTH admission counters."""
+    from pathway_tpu.serving.gate import SurgeGate
+
+    session = _FakeSession()
+    gate = SurgeGate(QoSConfig(), session, route="/cl")
+    try:
+        gate.batcher.close()
+        with pytest.raises(ShedError):
+            gate.submit(_pending(1, time.monotonic() + 60))
+        assert gate.queue_depth == 0
+        assert gate.inflight == 0
+    finally:
+        gate.close()
+
+
+def test_failed_dispatch_decrements_queue_depth_exactly_once():
+    """Engine insert raising mid-flush: the rejected batch must leave
+    the queue exactly once — requests queued behind it keep their
+    admission accounting (no phantom queue capacity)."""
+    from pathway_tpu.serving.gate import SurgeGate
+
+    session = _FakeSession(fail=True)
+    gate = SurgeGate(QoSConfig(), session, route="/ff")
+    try:
+        for _ in range(6):  # 4 about to flush + 2 queued behind them
+            gate.admission.admit()
+        now = time.monotonic()
+        batch = [_pending(i, now + 60) for i in range(4)]
+        with pytest.raises(RuntimeError):
+            gate._dispatch(batch)
+        # the batcher's catch-all then rejects the failed batch
+        for r in batch:
+            gate._reject(r, RuntimeError("insert failed"))
+        assert gate.queue_depth == 2
+        # the handlers observe was_dispatched and release their slots
+        for r in batch:
+            assert not r.abandon()
+            gate.complete(r.key, was_dispatched=True)
+        assert gate._dispatched_pending == 0
+    finally:
+        gate.close()
+
+
+def test_collected_gate_stops_batcher_thread():
+    """A graph torn down without an explicit stop must not leak a flush
+    thread per endpoint: the batcher holds its gate weakly and a
+    finalizer closes the thread once the gate is collected."""
+    import gc
+
+    from pathway_tpu.serving.gate import SurgeGate
+
+    gate = SurgeGate(QoSConfig(), _FakeSession(), route="/gc")
+    thread = gate.batcher._thread
+    assert thread.is_alive()
+    del gate
+    for _ in range(3):
+        gc.collect()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
 # --- REST end-to-end -------------------------------------------------------
 
 
@@ -493,6 +628,28 @@ def test_webserver_stop_releases_port_on_runtime_stop():
             break
         time.sleep(0.1)
     assert closed, "webserver still accepting connections after stop"
+
+
+def test_webserver_stop_during_startup_does_not_leak_thread(monkeypatch):
+    """stop() racing the server thread's startup must still land: it
+    waits for the loop to exist instead of silently skipping loop.stop
+    (which left run_forever holding the port for the process lifetime,
+    with the idempotence guard blocking any retry)."""
+    import asyncio
+
+    from pathway_tpu.io.http._server import PathwayWebserver
+
+    real_new_loop = asyncio.new_event_loop
+
+    def slow_new_loop():
+        time.sleep(0.3)  # widen the window stop() must wait through
+        return real_new_loop()
+
+    monkeypatch.setattr(asyncio, "new_event_loop", slow_new_loop)
+    ws = PathwayWebserver("127.0.0.1", _free_port())
+    ws.start()
+    ws.stop(timeout=10)
+    assert not ws._thread.is_alive()
 
 
 def test_non_finite_deadline_header_falls_back_to_default():
