@@ -747,9 +747,10 @@ def _bench_wordcount_stream(np):
 
 
 def _bench_join(np):
-    """Inner-join rows/s through the engine's columnar hash-join path
-    (engine/nodes.py JoinExec._try_bulk; reference bar: differential's
-    batched join_core merges, measured operator-side). The sink is the
+    """Bulk inner-join rows/s through the engine's columnar delta-join
+    path (engine/nodes.py JoinExec._delta_tick over arrangement.py;
+    reference bar: differential's batched join_core merges, measured
+    operator-side). The sink is the
     engine's output operator with a counting batch callback — the same
     altitude differential's join benches measure at; a debug sink that
     builds one Python dict entry per output row would measure the sink,
@@ -806,6 +807,119 @@ def _bench_join(np):
     assert counts["rows"] == n_l, counts
     assert counts["a_sum"] == n_l * (n_l - 1) // 2, counts
     return float((n_l + n_r) / dt)
+
+
+def _bench_join_incremental(np):
+    """Incremental-join tier: steady-state streaming delta ticks probing a
+    1M-row pre-arranged right side through JoinExec's columnar delta-join
+    path (engine/arrangement.py), with 20% retractions per tick, plus a
+    skewed-key variant and a rowwise-oracle baseline
+    (PATHWAY_JOIN_ROWWISE=1) for the vs ratio.  The bulk arrange tick
+    stays outside the timed region — this measures the steady state the
+    bulk `_bench_join` tier cannot see."""
+    import gc
+    import os
+
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.nodes import InputNode, JoinNode, OutputNode
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+
+    n_right = 1_000_000
+    tick_rows = 20_000
+
+    def run(
+        n_ticks: int, skewed: bool, rowwise: bool, retract_frac: float
+    ) -> float:
+        prev = os.environ.pop("PATHWAY_JOIN_ROWWISE", None)
+        if rowwise:
+            os.environ["PATHWAY_JOIN_ROWWISE"] = "1"
+        try:
+            inp_l = InputNode(StaticSource(["k", "a"]), ["k", "a"])
+            inp_r = InputNode(StaticSource(["k", "b"]), ["k", "b"])
+            join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+            counts = {"rows": 0}
+
+            def on_batch(t, b):
+                counts["rows"] += int(b.diffs.sum())
+
+            out = OutputNode(join, on_batch)
+            rt = Runtime([out], worker_threads=False)
+            # the typical join→select pipeline does not read the
+            # _left_id/_right_id pointer columns; mirror its liveness
+            join._live_cols = {"l.a", "r.b"}
+            rng = np.random.default_rng(7)
+            rk = np.arange(n_right, dtype=np.int64)
+            bulk = DiffBatch(
+                np.arange(n_right, dtype=np.uint64) + 1,
+                np.ones(n_right, np.int64),
+                {"k": rk, "b": rk},
+            )
+            rt.tick(0, {inp_r.id: [bulk]})  # arrange phase: untimed
+            n_ins = tick_rows - int(tick_rows * retract_frac)
+            n_ret = int(tick_rows * retract_frac)
+            prev_tick: tuple | None = None
+            total = net = 0
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                for i in range(n_ticks):
+                    if skewed:
+                        lk = (rng.zipf(1.2, size=n_ins) - 1) % n_right
+                    else:
+                        lk = rng.integers(0, n_right, size=n_ins)
+                    keys = np.arange(
+                        10_000_000 + i * tick_rows,
+                        10_000_000 + i * tick_rows + n_ins,
+                        dtype=np.uint64,
+                    )
+                    parts = [
+                        DiffBatch(
+                            keys,
+                            np.ones(n_ins, np.int64),
+                            {"k": lk, "a": lk},
+                        )
+                    ]
+                    total += n_ins
+                    net += n_ins
+                    if prev_tick is not None and n_ret:
+                        # retract a slice of the previous tick's inserts:
+                        # diff-weighted deltas against arranged state
+                        pk, plk = prev_tick
+                        parts.append(
+                            DiffBatch(
+                                pk[:n_ret],
+                                -np.ones(n_ret, np.int64),
+                                {"k": plk[:n_ret], "a": plk[:n_ret]},
+                            )
+                        )
+                        total += n_ret
+                        net -= n_ret
+                    prev_tick = (keys, lk)
+                    rt.tick(2 + 2 * i, {inp_l.id: parts})
+                dt = time.perf_counter() - t0
+            finally:
+                gc.enable()
+            # FK-shaped: every live left row matches exactly one right row
+            assert counts["rows"] == net, (counts["rows"], net)
+            return float(total / dt)
+        finally:
+            os.environ.pop("PATHWAY_JOIN_ROWWISE", None)
+            if prev is not None:
+                os.environ["PATHWAY_JOIN_ROWWISE"] = prev
+
+    uniform = run(25, skewed=False, rowwise=False, retract_frac=0.0)
+    mixed = run(25, skewed=False, rowwise=False, retract_frac=0.2)
+    skewed = run(25, skewed=True, rowwise=False, retract_frac=0.0)
+    base = run(10, skewed=False, rowwise=True, retract_frac=0.0)
+    base_mixed = run(10, skewed=False, rowwise=True, retract_frac=0.2)
+    return {
+        "join_delta_rows_per_sec": round(uniform, 1),
+        "vs_baseline": round(uniform / base, 2),
+        "join_delta_rows_per_sec_mixed": round(mixed, 1),
+        "vs_baseline_mixed": round(mixed / base_mixed, 2),
+        "join_delta_rows_per_sec_skewed": round(skewed, 1),
+        "join_delta_rows_per_sec_rowwise": round(base, 1),
+    }
 
 
 def _bench_rag_qps(np, on_accel):
@@ -1240,6 +1354,11 @@ def main() -> None:
         extra["join_rows_per_sec"] = round(_bench_join(np), 1)
     except Exception as e:
         errors.append(f"join:{type(e).__name__}:{e}")
+
+    try:
+        extra["join_incremental"] = _bench_join_incremental(np)
+    except Exception as e:
+        errors.append(f"join-incremental:{type(e).__name__}:{e}")
 
     try:
         extra["wordcount_rows_per_sec"] = round(

@@ -440,7 +440,53 @@ def serving_admission(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
-# 6. graph stats
+# 6. join vectorization
+
+_ROWWISE_JOINS = (IntervalJoinNode, AsofJoinNode, AsofNowJoinNode)
+
+
+@rule("join-vectorization")
+def join_vectorization(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Joins whose declared mode statically forces the rowwise path.
+    Equijoins run on the columnar arrangement (delta-join) engine —
+    roughly an order of magnitude faster per steady-state tick — unless
+    PATHWAY_JOIN_ROWWISE pins them to the dict oracle; temporal joins
+    (interval/asof/asof_now) have no vectorized path yet."""
+    import os
+
+    rowwise_forced = os.environ.get("PATHWAY_JOIN_ROWWISE", "") not in (
+        "",
+        "0",
+    )
+    for node in facts.order:
+        if isinstance(node, JoinNode) and rowwise_forced:
+            yield Diagnostic(
+                "join-vectorization",
+                Severity.WARNING,
+                "PATHWAY_JOIN_ROWWISE=1 pins this join to the rowwise "
+                "dict oracle: every steady-state tick loops per row in "
+                "Python instead of probing the columnar arrangement "
+                "(~5-10x slower)",
+                node,
+                fix_hint="unset PATHWAY_JOIN_ROWWISE (the oracle path "
+                "exists for differential testing, not serving)",
+            )
+        elif isinstance(node, _ROWWISE_JOINS):
+            yield Diagnostic(
+                "join-vectorization",
+                Severity.INFO,
+                f"{type(node).__name__} always runs the rowwise "
+                "touched-group path — its match rules (interval/asof "
+                "bounds) have no columnar delta-join implementation yet; "
+                "expect per-row Python cost on every tick",
+                node,
+                fix_hint="for equality-only match conditions prefer a "
+                "plain join, which runs on the arrangement engine",
+            )
+
+
+# ---------------------------------------------------------------------------
+# 7. graph stats
 
 _STATE_ESTIMATES = {
     "GroupByNode": "O(distinct groups x reducer state)",

@@ -148,7 +148,7 @@ class DiffBatch:
             np.concatenate([b.keys for b in batches]),
             np.concatenate([b.diffs for b in batches]),
             {
-                n: np.concatenate([_as_obj_safe(b.columns[n]) for b in batches])
+                n: concat_columns([b.columns[n] for b in batches])
                 for n in names
             },
         )
@@ -205,8 +205,21 @@ class DiffBatch:
         return out
 
 
-def _as_obj_safe(col: np.ndarray) -> np.ndarray:
-    return col
+def concat_columns(parts: Sequence[np.ndarray]) -> np.ndarray:
+    """Dtype-preserving column concat: same-dtype parts concatenate
+    directly; mixed dtypes go through object arrays so values are never
+    silently promoted (an int64 batch concatenated with a float64 one
+    used to floatify the ints mid-tick; arrangement state outlives the
+    tick and shares this helper)."""
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return np.empty(0, dtype=object)
+    if len(parts) == 1:
+        return parts[0]
+    d0 = parts[0].dtype
+    if all(p.dtype == d0 for p in parts[1:]):
+        return np.concatenate(parts)
+    return np.concatenate([p.astype(object) for p in parts])
 
 
 def _values_eq(a: tuple, b: tuple) -> bool:

@@ -22,6 +22,16 @@ from typing import Any, Callable, Iterable, Sequence
 import numpy as np
 import pandas as pd  # factorize powers the columnar groupby/join paths
 
+from pathway_tpu.engine.arrangement import (
+    Arrangement,
+    Rows,
+    concat_columns,
+    consolidate_mixed,
+    merge_rows_sorted,
+    merge_sorted,
+    mix_keys,
+    sorted_member,
+)
 from pathway_tpu.engine.batch import (
     END_OF_TIME,
     DiffBatch,
@@ -35,7 +45,14 @@ from pathway_tpu.engine.expression_eval import (
 )
 from pathway_tpu.engine.reducers import ReducerSpec
 from pathway_tpu.internals import expression as expr_mod
-from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
+from pathway_tpu.internals.api import (
+    ERROR,
+    Pointer,
+    match_keys,
+    ptr_column,
+    ref_scalar,
+    ref_scalars_columns,
+)
 from pathway_tpu.internals.errors import record_error
 from pathway_tpu.internals.json import Json
 
@@ -751,61 +768,19 @@ class JoinNode(Node):
 
 
 class _SideState:
-    __slots__ = ("by_jk", "_pending", "_pending_set", "_pending_unindexed")
+    """Rowwise dict state — jk -> {rowkey: [vals, count]}.  Only the
+    oracle/fallback representation: the engine's steady-state join keeps
+    its state in columnar Arrangements (engine/arrangement.py); this dict
+    form survives for the differential-testing oracle
+    (PATHWAY_JOIN_ROWWISE=1) and as the degraded-but-running escape hatch
+    when the vectorized path hits something unexpected."""
+
+    __slots__ = ("by_jk",)
 
     def __init__(self):
-        # jk -> {rowkey: [vals, count]}
         self.by_jk: dict[int, dict[int, list]] = {}
-        # bulk-loaded batches whose dict state hasn't been needed yet: a
-        # batch-analytics join never probes its own build side again, so
-        # the per-row dict build is deferred until an incremental tick
-        # actually touches the state (columnar-first, reference analog:
-        # differential arrangements are also built lazily from batches).
-        # jks/keys stay as ndarrays end-to-end; the membership set over
-        # pending jks is ALSO built lazily — a single-bulk-tick join never
-        # pays for it, while multi-batch bulk streams amortize to one
-        # set.update per deferred array (linear total, not quadratic).
-        self._pending: list[tuple[np.ndarray, np.ndarray, list]] = []
-        self._pending_set: set[int] = set()
-        self._pending_unindexed: list[np.ndarray] = []
-
-    def defer_bulk(self, jks: np.ndarray, keys: np.ndarray, cols: list[np.ndarray]):
-        self._pending.append((jks, keys, cols))
-        self._pending_unindexed.append(jks)
-
-    def pending_lookup(self) -> set[int]:
-        for a in self._pending_unindexed:
-            self._pending_set.update(a.tolist())
-        self._pending_unindexed.clear()
-        return self._pending_set
-
-    def _materialize(self):
-        by = self.by_jk
-        for jks_a, keys_a, cols in self._pending:
-            jks, keys = jks_a.tolist(), keys_a.tolist()
-            vals: Any = (
-                zip(*[c.tolist() for c in cols]) if cols else iter(
-                    [()] * len(keys)
-                )
-            )
-            for jk, k, v in zip(jks, keys, vals):
-                rows = by.get(jk)
-                if rows is None:
-                    by[jk] = {k: [v, 1]}
-                else:
-                    e = rows.get(k)
-                    if e is None:
-                        rows[k] = [v, 1]
-                    else:
-                        e[1] += 1
-                        e[0] = v
-        self._pending.clear()
-        self._pending_set.clear()
-        self._pending_unindexed.clear()
 
     def apply(self, jk: int, k: int, d: int, vals: tuple):
-        if self._pending:
-            self._materialize()
         rows = self.by_jk.setdefault(jk, {})
         e = rows.get(k)
         if e is None:
@@ -821,24 +796,127 @@ class _SideState:
             del self.by_jk[jk]
 
     def rows(self, jk: int) -> dict[int, list]:
-        if self._pending:
-            self._materialize()
         return self.by_jk.get(jk, {})
 
 
+def _none_col(n: int) -> np.ndarray:
+    return np.full(n, None, dtype=object)
+
+
+# vectorized Pointer boxing for the _left_id/_right_id output columns
+_box_pointers = np.frompyfunc(Pointer, 1, 1)
+
+
+class _TickDelta:
+    """One side's delta for one tick, pre-sorted and fingerprinted once —
+    shared by the overlay, the changed-row seeds, and the arrangement
+    append (which reuses the sort instead of redoing it)."""
+
+    __slots__ = ("n", "jks", "keys", "diffs", "cols", "order",
+                 "mix", "mix_sorted", "clean")
+
+    def __init__(self, jks: np.ndarray, batch: DiffBatch):
+        self.n = len(jks)
+        self.jks = jks
+        self.keys = batch.keys
+        self.diffs = batch.diffs
+        self.cols = list(batch.columns.values())
+        if self.n:
+            self.order = np.argsort(jks, kind="stable")
+            self.mix = mix_keys(jks, batch.keys)
+            self.mix_sorted = np.sort(self.mix)
+            self.clean = bool((batch.diffs > 0).all()) and not bool(
+                (self.mix_sorted[1:] == self.mix_sorted[:-1]).any()
+            )
+        else:
+            self.order = np.empty(0, dtype=np.int64)
+            self.mix = np.empty(0, dtype=np.uint64)
+            self.mix_sorted = np.empty(0, dtype=np.uint64)
+            self.clean = True
+
+
 class JoinExec(NodeExec):
+    """Incremental equijoin over columnar arranged state.
+
+    Every tick applies the delta-join rule (ΔL ⋈ R ∪ L′ ⋈ ΔR): both
+    sides' state lives in Arrangements (engine/arrangement.py), a tick
+    probes them for the touched join keys only, overlays the delta, and
+    builds the output diff with vectorized pair expansion
+    (api.match_keys / searchsorted), diff-weighted retractions,
+    per-jk match-count tracking for left/right/outer unmatched padding,
+    and batch-hashed output keys — the general path, not a bulk special
+    case.  The rowwise dict path survives solely as the differential-
+    testing oracle (PATHWAY_JOIN_ROWWISE=1) and as a runtime escape hatch
+    (counted in pathway_engine_join_fallbacks, labeled by reason)."""
+
     def __init__(self, node: JoinNode):
         super().__init__(node)
-        self.left = _SideState()
-        self.right = _SideState()
         lcols = node.inputs[0].column_names
         rcols = node.inputs[1].column_names
         self.l_on_idx = [lcols.index(c) for c in node.left_on]
         self.r_on_idx = [rcols.index(c) for c in node.right_on]
         self.n_l = len(lcols)
         self.n_r = len(rcols)
-        # emitted multiset: outkey -> [vals, count]
-        self.emitted: dict[int, list] = {}
+        self.arr_l = Arrangement(self.n_l)
+        self.arr_r = Arrangement(self.n_r)
+        # rowwise fallback state (materialized from the arrangements only
+        # if the fallback ever fires)
+        self.left: _SideState | None = None
+        self.right: _SideState | None = None
+        self._rowwise = False
+        self._fallback_reason: str | None = None
+        # Flight Recorder counters ("_m_" attrs are excluded from operator
+        # snapshots — registry children hold locks)
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_hits = REGISTRY.counter(
+            "pathway_engine_join_bulk_hits_total",
+            "join ticks fully served by the columnar arrangement "
+            "(delta-join) path",
+        )
+        self._m_fallbacks = REGISTRY.counter(
+            "pathway_engine_join_fallbacks_total",
+            "join ticks served by the rowwise fallback path, by reason",
+            ("reason",),
+        )
+        if os.environ.get("PATHWAY_JOIN_ROWWISE", "") not in ("", "0"):
+            self._to_rowwise("env")
+
+    # --- operator snapshots: skip registry handles ----------------------
+
+    def state_dict(self) -> dict | None:
+        state = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k != "node" and not k.startswith("_m_")
+        }
+        return state or None
+
+    # --- fallback management --------------------------------------------
+
+    def _to_rowwise(self, reason: str) -> None:
+        """Materialize dict state from the arrangements and stay rowwise
+        from here on (degraded-but-running contract)."""
+        self._rowwise = True
+        self._fallback_reason = reason
+        if self.left is None:
+            self.left = self._materialize_side(self.arr_l)
+            self.right = self._materialize_side(self.arr_r)
+            self.arr_l = Arrangement(self.n_l)
+            self.arr_r = Arrangement(self.n_r)
+
+    @staticmethod
+    def _materialize_side(arr: Arrangement) -> _SideState:
+        side = _SideState()
+        rows = arr.entries()
+        cols = [c.tolist() for c in rows.cols]
+        vals: Any = zip(*cols) if cols else iter([()] * len(rows))
+        by = side.by_jk
+        for jk, k, c, v in zip(
+            rows.jk.tolist(), rows.key.tolist(), rows.count.tolist(), vals
+        ):
+            by.setdefault(jk, {})[k] = [v, c]
+        return side
 
     def _jk(self, vals: tuple, idx: list[int]) -> int:
         return int(ref_scalar(*(vals[i] for i in idx)))
@@ -923,110 +1001,436 @@ class JoinExec(NodeExec):
                     continue
                 null_rows = m if null_rows is None else (null_rows | m)
         if null_rows is not None and null_rows.any():
+            # batch the private-key derivation through the C columns
+            # hasher: constant ("__pw_null", side) columns + the row-key
+            # buffer, byte-identical to the old per-row ref_scalar loop
+            idx = np.nonzero(null_rows)[0]
+            n_null = len(idx)
+            priv = ref_scalars_columns(
+                [
+                    np.full(n_null, "__pw_null", dtype=object),
+                    np.full(n_null, side_tag, dtype=object),
+                    ptr_column(b.keys[idx]),
+                ],
+                n_null,
+            )
             jks = np.array(jks, copy=True)
-            keys = b.keys
-            for i in np.nonzero(null_rows)[0]:
-                jks[i] = int(
-                    ref_scalar("__pw_null", side_tag, Pointer(int(keys[i])))
-                ) & 0xFFFFFFFFFFFFFFFF
+            jks[idx] = priv
         return jks
 
-    def _try_bulk(self, lb, rb, jks_l, jks_r):
-        """Columnar hash-join fast path (the batched analog of
-        differential's join_core merge, reference src/engine/dataflow.rs:
-        2834): for insert-only inner-join batches whose join keys are all
-        new to the operator state, matching pairs are found with one sort +
-        searchsorted and output columns are built by fancy indexing — no
-        per-row Python tuples on the emit path. Returns the output batches
-        or None when ineligible (the per-row incremental path then runs)."""
+    # --- columnar delta join --------------------------------------------
+
+    @staticmethod
+    def _overlay(
+        before: Rows,
+        d: "_TickDelta",
+        age_base: int,
+        before_seed: np.ndarray,
+        before_mix: np.ndarray,
+    ) -> Rows:
+        """State after this tick's delta.  A clean delta (insert-only, no
+        duplicate pairs) touching no existing entry merges in with two
+        searchsorteds; anything else re-consolidates the before-rows with
+        the delta entries appended at strictly later ages."""
+        if not d.n:
+            return before
+        if d.clean and not before_seed.any():
+            ages = (age_base + d.order).astype(np.int64)
+            delta_rows = Rows(
+                d.jks[d.order],
+                d.keys[d.order],
+                d.diffs[d.order],
+                ages,
+                [np.asarray(c)[d.order] for c in d.cols],
+            )
+            return merge_rows_sorted(before, delta_rows)
+        ages = np.arange(age_base, age_base + d.n, dtype=np.int64)
+        cols = [np.asarray(c) for c in d.cols]
+        if not len(before):
+            return consolidate_mixed(
+                d.jks, d.keys, d.diffs, ages, cols, d.mix
+            )
+        return consolidate_mixed(
+            np.concatenate([before.jk, d.jks]),
+            np.concatenate([before.key, d.keys]),
+            np.concatenate([before.count, d.diffs]),
+            np.concatenate([before.age, ages]),
+            [
+                concat_columns([bc, dc])
+                for bc, dc in zip(before.cols, cols)
+            ],
+            np.concatenate([before_mix, d.mix]),
+        )
+
+    @staticmethod
+    def _jk_positions(
+        rows: Rows, touched: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(per-row index into ``touched``, entries per touched jk) — the
+        per-jk match-count tracking behind unmatched-padding deltas."""
+        jpos = np.searchsorted(touched, rows.jk)
+        return jpos, np.bincount(jpos, minlength=len(touched))
+
+    def _drop_duplicate_ids(self, L, R, li, ri):
+        """id_from output keys with non-unique matches: per jk, the first
+        pair (in emission order) wins; later collisions poison + log —
+        same contract as the rowwise path's per-jk emit() check."""
         node = self.node
-        if node.mode != "inner" or node.id_from is not None:
-            return None
-        n_l, n_r = len(lb), len(rb)
-        if n_l + n_r < 1024:
-            return None  # small ticks: per-row path is cheap and general
-        if (lb.diffs != 1).any() or (rb.diffs != 1).any():
-            return None
-        lbj, rbj = self.left.by_jk, self.right.by_jk
-        if lbj or rbj or self.left._pending or self.right._pending:
-            lps = self.left.pending_lookup()
-            rps = self.right.pending_lookup()
-            for j in np.unique(np.concatenate([jks_l, jks_r])).tolist():
-                if j in lbj or j in rbj or j in lps or j in rps:
-                    return None
-        from pathway_tpu.internals.api import _get_native
-
-        nat = _get_native()
-        if nat is not None and hasattr(nat, "match_fk"):
-            # C hash-probe match (threaded, GIL released): ~6x the numpy
-            # sort+searchsorted path below on large batches
-            li_b, ri_b = nat.match_fk(
-                np.ascontiguousarray(jks_l), np.ascontiguousarray(jks_r)
-            )
-            li = np.frombuffer(li_b, np.int64)
-            ri = np.frombuffer(ri_b, np.int64)
-            total = len(li)
-        else:
-            order_r = np.argsort(jks_r, kind="stable")
-            jr_sorted = jks_r[order_r]
-            lo = np.searchsorted(jr_sorted, jks_l, "left")
-            hi = np.searchsorted(jr_sorted, jks_l, "right")
-            counts = hi - lo
-            total = int(counts.sum())
-            if total:
-                li = np.repeat(np.arange(n_l), counts)
-                starts = np.repeat(lo, counts)
-                offs = np.arange(total) - np.repeat(
-                    np.cumsum(counts) - counts, counts
+        okeys = L.key[li] if node.id_from == "left" else R.key[ri]
+        jk = L.jk[li]
+        n = len(li)
+        order = np.lexsort((np.arange(n), okeys, jk))
+        jk_o = jk[order]
+        ok_o = okeys[order]
+        dup = np.zeros(n, dtype=bool)
+        dup[1:] = (jk_o[1:] == jk_o[:-1]) & (ok_o[1:] == ok_o[:-1])
+        if dup.any():
+            for _ in range(int(dup.sum())):
+                record_error(
+                    KeyError(
+                        "duplicate row id in join output (id= used with "
+                        "non-unique matches)"
+                    ),
+                    str(self.node),
                 )
-                ri = order_r[starts + offs]
-        out = []
+            keep = np.ones(n, dtype=bool)
+            keep[order[dup]] = False
+            li, ri = li[keep], ri[keep]
+        return li, ri
+
+    def _state_output(
+        self,
+        L: Rows,
+        R: Rows,
+        seed_l,
+        seed_r,
+        flip_l,
+        flip_r,
+        jpos_l,
+        jpos_r,
+        l_cnt,
+        r_cnt,
+        full: bool,
+    ) -> list[tuple]:
+        """Output rows of ONE state (before or after) restricted to rows
+        that can differ across the tick: pairs with at least one delta-
+        touched endpoint (all pairs when ``full``) plus unmatched-padding
+        rows whose row changed or whose other-side presence flipped.
+        Returns chunks (kind, L, li, R, ri)."""
+        node = self.node
+        parts: list[tuple] = []
+        if len(L) and len(R):
+            if full:
+                li, ri = match_keys(L.jk, R.jk, right_sorted=True)
+            else:
+                l_seed_idx = np.nonzero(seed_l)[0]
+                a1, b1 = match_keys(
+                    L.jk[l_seed_idx], R.jk, right_sorted=True
+                )
+                l_rest_idx = np.nonzero(~seed_l)[0]
+                r_seed_idx = np.nonzero(seed_r)[0]
+                a2, b2 = match_keys(
+                    L.jk[l_rest_idx], R.jk[r_seed_idx], right_sorted=True
+                )
+                li = np.concatenate([l_seed_idx[a1], l_rest_idx[a2]])
+                ri = np.concatenate([b1, r_seed_idx[b2]])
+            if len(li):
+                # a pair is in the output iff the product of its net
+                # weights is positive (matching the dict path's lc*rc>0)
+                m = (L.count[li] * R.count[ri]) > 0
+                li, ri = li[m], ri[m]
+            if len(li) and node.id_from is not None:
+                li, ri = self._drop_duplicate_ids(L, R, li, ri)
+            if len(li):
+                parts.append(("pair", L, li, R, ri))
+        if node.mode in ("left", "outer") and len(L):
+            elig = (r_cnt[jpos_l] == 0) & (L.count > 0)
+            if not full:
+                elig &= seed_l | flip_r[jpos_l]
+            idx = np.nonzero(elig)[0]
+            if len(idx):
+                parts.append(("lpad", L, idx, None, None))
+        if node.mode in ("right", "outer") and len(R):
+            elig = (l_cnt[jpos_r] == 0) & (R.count > 0)
+            if not full:
+                elig &= seed_r | flip_l[jpos_r]
+            idx = np.nonzero(elig)[0]
+            if len(idx):
+                parts.append(("rpad", None, None, R, idx))
+        return parts
+
+    _PAIR_C1 = np.uint64(0x9E3779B97F4A7C15)
+    _PAIR_C2 = np.uint64(0xC2B2AE3D27D4EB4F)
+    _PAIR_C3 = np.uint64(0x165667B19E3779F9)
+    _PAIR_C4 = np.uint64(0x27D4EB2F165667C5)
+
+    @classmethod
+    def _chunk_pair_ids(cls, kind: str, L, li, R, ri) -> np.ndarray:
+        """64-bit identity of each output row's (pair, kind) — used to
+        detect whether the before and after emit-sets can overlap at all
+        (only then can retraction-vs-insert rows cancel and the value-hash
+        consolidation pay for itself)."""
+        if kind == "pair":
+            return (L.key[li] * cls._PAIR_C1) ^ (R.key[ri] * cls._PAIR_C2)
+        if kind == "lpad":
+            return L.key[li] * cls._PAIR_C3
+        return R.key[ri] * cls._PAIR_C4
+
+    def _chunk_okeys(self, kind: str, L, li, R, ri) -> np.ndarray:
+        """Output keys for one chunk, derived through the batch hasher
+        (byte-identical to the rowwise path's per-row ref_scalar)."""
+        node = self.node
+        if kind == "pair":
+            if node.id_from == "left":
+                return L.key[li]
+            if node.id_from == "right":
+                return R.key[ri]
+            return ref_scalars_columns(
+                [ptr_column(L.key[li]), ptr_column(R.key[ri])], len(li)
+            )
+        if kind == "lpad":
+            lk = L.key[li]
+            if node.id_from == "left":
+                return lk
+            return ref_scalars_columns(
+                [ptr_column(lk), _none_col(len(li))], len(li)
+            )
+        rk = R.key[ri]
+        if node.id_from == "right":
+            return rk
+        return ref_scalars_columns(
+            [_none_col(len(ri)), ptr_column(rk)], len(ri)
+        )
+
+    def _chunk_columns(self, kind: str, L, li, R, ri, n: int) -> list:
+        """Output value columns for one chunk: gathered side columns,
+        None-padding for the unmatched side, and the _left_id/_right_id
+        pointer columns (boxed only when the liveness pass says a
+        downstream expression reads them)."""
+        live = getattr(self.node, "_live_cols", None)
+        cols: list[np.ndarray] = []
+        if L is not None:
+            cols.extend(c[li] for c in L.cols)
+        else:
+            cols.extend(_none_col(n) for _ in range(self.n_l))
+        if R is not None:
+            cols.extend(c[ri] for c in R.cols)
+        else:
+            cols.extend(_none_col(n) for _ in range(self.n_r))
+        if L is not None and (live is None or "_left_id" in live):
+            cols.append(_box_pointers(L.key[li]))
+        else:
+            cols.append(_none_col(n))
+        if R is not None and (live is None or "_right_id" in live):
+            cols.append(_box_pointers(R.key[ri]))
+        else:
+            cols.append(_none_col(n))
+        return cols
+
+    def _bulk_first_tick(self, dl: "_TickDelta", dr: "_TickDelta") -> list[DiffBatch]:
+        """Insert-only inner join into empty state (the batch-analytics
+        bulk load): no before-set exists, so matches emit straight from
+        the C probe over the raw delta key arrays."""
+        out: list[DiffBatch] = []
+        li, ri = match_keys(dl.jks, dr.jks)
+        total = len(li)
         if total:
-            lcols = list(lb.columns.values())
-            rcols = list(rb.columns.values())
-            from pathway_tpu.internals.api import (
-                ptr_column,
-                ref_scalars_columns,
-            )
-
-            # raw key buffers: no per-row Pointer boxing on the hot path
             okeys = ref_scalars_columns(
-                [ptr_column(lb.keys[li]), ptr_column(rb.keys[ri])], total
+                [ptr_column(dl.keys[li]), ptr_column(dr.keys[ri])], total
             )
-            # the source-id columns need boxed Pointers as VALUES — but
-            # only when a downstream expression actually reads them (the
-            # liveness pass marks the common join→select pipeline as not
-            # touching _left_id/_right_id; boxing 2 Pointers per output
-            # row dominated the bulk profile otherwise)
-            from pathway_tpu.engine.batch import _obj_column
-
             live = getattr(self.node, "_live_cols", None)
-            if live is None or "_left_id" in live:
-                lptr = _obj_column(list(map(Pointer, lb.keys[li].tolist())))
-            else:
-                lptr = np.full(total, None, dtype=object)
-            if live is None or "_right_id" in live:
-                rptr = _obj_column(list(map(Pointer, rb.keys[ri].tolist())))
-            else:
-                rptr = np.full(total, None, dtype=object)
-            columns = {}
             names = self.node.column_names
+            columns = {}
             ncol = 0
-            for c in lcols:
+            for c in dl.cols:
                 columns[names[ncol]] = c[li]
                 ncol += 1
-            for c in rcols:
+            for c in dr.cols:
                 columns[names[ncol]] = c[ri]
                 ncol += 1
-            columns[names[ncol]] = lptr
-            columns[names[ncol + 1]] = rptr
+            columns[names[ncol]] = (
+                _box_pointers(dl.keys[li])
+                if live is None or "_left_id" in live
+                else _none_col(total)
+            )
+            columns[names[ncol + 1]] = (
+                _box_pointers(dr.keys[ri])
+                if live is None or "_right_id" in live
+                else _none_col(total)
+            )
             out.append(
                 DiffBatch(okeys, np.ones(total, dtype=np.int64), columns)
             )
-        # state update deferred: dict state materializes only if a later
-        # tick probes it (see _SideState.defer_bulk)
-        self.left.defer_bulk(jks_l, lb.keys, list(lb.columns.values()))
-        self.right.defer_bulk(jks_r, rb.keys, list(rb.columns.values()))
+        self._commit_deltas(dl, dr)
+        return out
+
+    def _commit_deltas(self, dl: "_TickDelta", dr: "_TickDelta") -> None:
+        """Apply the tick's deltas to BOTH arrangements atomically: stage
+        (all allocations, may raise) before committing either side, so
+        the exception fallback can never see one side's delta applied
+        without the other's."""
+        staged_l = self.arr_l.stage(
+            dl.jks, dl.keys, dl.diffs, dl.cols,
+            jk_order=dl.order, mix_sorted=dl.mix_sorted, clean=dl.clean,
+        )
+        staged_r = self.arr_r.stage(
+            dr.jks, dr.keys, dr.diffs, dr.cols,
+            jk_order=dr.order, mix_sorted=dr.mix_sorted, clean=dr.clean,
+        )
+        self.arr_l.commit(staged_l)
+        self.arr_r.commit(staged_r)
+
+    def _delta_tick(self, lb, rb, jks_l, jks_r) -> list[DiffBatch]:
+        """One tick on the columnar path: probe arranged state for the
+        touched jks, overlay the delta, emit the (before ⊖ after) diff."""
+        node = self.node
+        dl = _TickDelta(jks_l, lb)
+        dr = _TickDelta(jks_r, rb)
+        inner_simple = node.mode == "inner" and node.id_from is None
+        if (
+            inner_simple
+            and dl.clean
+            and dr.clean
+            and not len(self.arr_l)
+            and not len(self.arr_r)
+        ):
+            # first-tick bulk load into empty state: no probe, no
+            # overlay, no before-set — emit the matches directly (the
+            # batch-analytics fast path, on the same machinery)
+            return self._bulk_first_tick(dl, dr)
+        # touched jks from the per-side sorted deltas (no extra sort)
+        if dl.n and dr.n:
+            tj = merge_sorted(jks_l[dl.order], jks_r[dr.order])
+        elif dl.n:
+            tj = jks_l[dl.order]
+        else:
+            tj = jks_r[dr.order]
+        if len(tj) > 1:
+            keep = np.empty(len(tj), dtype=bool)
+            keep[0] = True
+            keep[1:] = tj[1:] != tj[:-1]
+            touched = tj[keep]
+        else:
+            touched = tj
+        # inner joins with a one-sided, collision-free delta never read
+        # the quiet side's existing rows: pairs with two unchanged
+        # endpoints cancel, there is no padding, and the overlay adds
+        # only brand-new entries — skip that probe entirely
+        skip_l = (
+            inner_simple
+            and dr.n == 0
+            and dl.clean
+            and not self.arr_l.overlaps(dl.mix)
+        )
+        skip_r = (
+            inner_simple
+            and dl.n == 0
+            and dr.clean
+            and not self.arr_r.overlaps(dr.mix)
+        )
+        before_l = (
+            Rows.empty(self.n_l) if skip_l else self.arr_l.probe(touched)
+        )
+        before_r = (
+            Rows.empty(self.n_r) if skip_r else self.arr_r.probe(touched)
+        )
+        # changed-row seeds: state rows whose (jk, key) the delta touches
+        mix_bl = mix_keys(before_l.jk, before_l.key)
+        mix_br = mix_keys(before_r.jk, before_r.key)
+        sl_b = sorted_member(mix_bl, dl.mix_sorted)
+        sr_b = sorted_member(mix_br, dr.mix_sorted)
+        after_l = self._overlay(
+            before_l, dl, self.arr_l.next_age(), sl_b, mix_bl
+        )
+        after_r = self._overlay(
+            before_r, dr, self.arr_r.next_age(), sr_b, mix_br
+        )
+        # empty before-state: every after-row came from this delta
+        sl_a = (
+            np.ones(len(after_l), dtype=bool)
+            if not len(before_l)
+            else sorted_member(
+                mix_keys(after_l.jk, after_l.key), dl.mix_sorted
+            )
+        )
+        sr_a = (
+            np.ones(len(after_r), dtype=bool)
+            if not len(before_r)
+            else sorted_member(
+                mix_keys(after_r.jk, after_r.key), dr.mix_sorted
+            )
+        )
+        # id_from can alias output keys across state versions, so those
+        # joins recompute the touched jks fully; otherwise only pairs with
+        # a delta-touched endpoint can change — everything else cancels
+        full = node.id_from is not None
+        if node.mode == "inner" and not full:
+            # no padding, no full recompute: the per-jk group counts and
+            # presence flips are never read
+            jp_lb = jp_rb = jp_la = jp_ra = None
+            lc_b = rc_b = lc_a = rc_a = None
+            flip_l = flip_r = None
+        else:
+            jp_lb, lc_b = self._jk_positions(before_l, touched)
+            jp_rb, rc_b = self._jk_positions(before_r, touched)
+            jp_la, lc_a = self._jk_positions(after_l, touched)
+            jp_ra, rc_a = self._jk_positions(after_r, touched)
+            flip_l = (lc_b > 0) != (lc_a > 0)
+            flip_r = (rc_b > 0) != (rc_a > 0)
+        bef_parts = self._state_output(
+            before_l, before_r, sl_b, sr_b, flip_l, flip_r,
+            jp_lb, jp_rb, lc_b, rc_b, full,
+        )
+        aft_parts = self._state_output(
+            after_l, after_r, sl_a, sr_a, flip_l, flip_r,
+            jp_la, jp_ra, lc_a, rc_a, full,
+        )
+        out: list[DiffBatch] = []
+        if bef_parts or aft_parts:
+            okeys_l: list[np.ndarray] = []
+            diffs_l: list[np.ndarray] = []
+            col_parts: list[list[np.ndarray]] = [
+                [] for _ in node.column_names
+            ]
+            for sign, chunks in ((-1, bef_parts), (1, aft_parts)):
+                for kind, L, li, R, ri in chunks:
+                    n = len(li) if li is not None else len(ri)
+                    okeys_l.append(self._chunk_okeys(kind, L, li, R, ri))
+                    diffs_l.append(np.full(n, sign, dtype=np.int64))
+                    for ci, col in enumerate(
+                        self._chunk_columns(kind, L, li, R, ri, n)
+                    ):
+                        col_parts[ci].append(col)
+            batch = DiffBatch(
+                np.concatenate(okeys_l).astype(np.uint64, copy=False),
+                np.concatenate(diffs_l),
+                {
+                    name: concat_columns(col_parts[ci])
+                    for ci, name in enumerate(node.column_names)
+                },
+            )
+            if bef_parts and aft_parts:
+                # unchanged re-emissions cancel retraction-vs-insert in
+                # consolidate() — but value-hashing every emitted row is
+                # the dominant cost of retraction ticks, so only pay it
+                # when the two emit-sets actually share a pair (disjoint
+                # sets — pure insert+retract churn — cannot cancel)
+                ids_b = np.sort(
+                    np.concatenate(
+                        [self._chunk_pair_ids(*c) for c in bef_parts]
+                    )
+                )
+                ids_a = np.concatenate(
+                    [self._chunk_pair_ids(*c) for c in aft_parts]
+                )
+                if sorted_member(ids_a, ids_b).any():
+                    batch = batch.consolidate()
+            if len(batch):
+                out.append(batch)
+        # commit the delta into arranged state only after the pure
+        # computation succeeded (the exception fallback must see pre-tick
+        # state); the append reuses this tick's sort + fingerprints
+        self._commit_deltas(dl, dr)
         return out
 
     def _drop_error_keys(
@@ -1112,9 +1516,22 @@ class JoinExec(NodeExec):
             if len(rb)
             else np.empty(0, np.uint64)
         )
-        bulk = self._try_bulk(lb, rb, jks_l, jks_r)
-        if bulk is not None:
-            return extra + bulk
+        if not self._rowwise:
+            try:
+                out = self._delta_tick(lb, rb, jks_l, jks_r)
+            except Exception as exc:
+                # degraded-but-running: log, materialize dict state from
+                # the (un-mutated) arrangements, finish the tick rowwise
+                record_error(exc, str(self.node))
+                self._to_rowwise("exception")
+            else:
+                self._m_hits.inc()
+                return extra + out
+        self._m_fallbacks.labels(self._fallback_reason or "unknown").inc()
+        return extra + self._process_rowwise(lb, rb, jks_l, jks_r)
+
+    def _process_rowwise(self, lb, rb, jks_l, jks_r) -> list[DiffBatch]:
+        """Touched-jk dict recompute — the differential-testing oracle."""
         touched: dict[int, None] = {}
         jl = jks_l.tolist()
         l_updates = []
@@ -1148,8 +1565,8 @@ class JoinExec(NodeExec):
                 if old is None or not _values_eq(old, vals):
                     out_rows.append((okey, 1, vals))
         if not out_rows:
-            return extra
-        return extra + [DiffBatch.from_rows(out_rows, self.node.column_names)]
+            return []
+        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
 
 
 # ---------------------------------------------------------------------------

@@ -336,21 +336,29 @@ class ShardedJoinExec(_ShardedExec):
 
     inner_cls = JoinExec
 
-    def _dests(self, b: DiffBatch, on_cols: Sequence[str]) -> np.ndarray:
-        from pathway_tpu.internals.api import ref_scalars_columns
-
-        cols = [b.columns[c] for c in on_cols]
+    def _dests(self, b: DiffBatch, on_idx, side_tag: str) -> np.ndarray:
+        # route by the EXACT join keys the inner exec arranges by
+        # (_batch_jks: null on-columns get per-row private keys, same
+        # contract as the DCN router) — hashing the raw columns instead
+        # would pile every null-keyed row onto the hash(None...) shard.
+        # The per-shard exec re-derives jks for its partition: routing
+        # needs them before the split, and NodeExec.process takes whole
+        # batches — threading precomputed jks through would change the
+        # exec interface for one extra C hash pass.
         jks = np.asarray(
-            ref_scalars_columns(cols, len(b)), dtype=np.uint64
+            self.shards[0]._batch_jks(b, on_idx, side_tag),
+            dtype=np.uint64,
         )
         return shard_of(jks, self.router.n_shards)
 
     def process(self, t, inputs):
+        l_on = self.shards[0].l_on_idx
+        r_on = self.shards[0].r_on_idx
         lparts = self._partition(
-            inputs[0], lambda b: self._dests(b, self.node.left_on)
+            inputs[0], lambda b: self._dests(b, l_on, "l")
         )
         rparts = self._partition(
-            inputs[1], lambda b: self._dests(b, self.node.right_on)
+            inputs[1], lambda b: self._dests(b, r_on, "r")
         )
         out: list[DiffBatch] = []
         for ex, lsub, rsub in zip(self.shards, lparts, rparts):

@@ -154,6 +154,64 @@ def ref_scalars_columns(columns: list, n: int) -> np.ndarray:
     return out
 
 
+def expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate [starts[i], starts[i]+counts[i]) ranges into one index
+    array (the vectorized range-expansion trick shared by the equijoin
+    probe fallback and the arrangement gather)."""
+    total = int(counts.sum())
+    if not total:
+        return np.empty(0, dtype=np.int64)
+    nz = counts > 0
+    reps = counts[nz]
+    offs = np.arange(total, dtype=np.int64) - np.repeat(
+        np.cumsum(reps) - reps, reps
+    )
+    return np.repeat(starts[nz].astype(np.int64), reps) + offs
+
+
+def match_keys(
+    left: np.ndarray, right: np.ndarray, right_sorted: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """Equijoin match step over two uint64 key arrays: every (li, ri) index
+    pair with ``left[li] == right[ri]``, ordered by li (and per li, by ri in
+    right order) — the probe signature the columnar delta join is built on
+    (native: pathway_native.cc match_fk, a threaded GIL-free hash probe;
+    fallback: sort + searchsorted).  Pass ``right_sorted=True`` when the
+    right side is already ascending (arrangement segments) to skip the
+    fallback's argsort."""
+    nl, nr = len(left), len(right)
+    if not nl or not nr:
+        e = np.empty(0, dtype=np.int64)
+        return e, e
+    nat = _get_native()
+    if nat is not None and hasattr(nat, "match_fk"):
+        li_b, ri_b = nat.match_fk(
+            np.ascontiguousarray(left, dtype=np.uint64),
+            np.ascontiguousarray(right, dtype=np.uint64),
+        )
+        return (
+            np.frombuffer(li_b, dtype=np.int64),
+            np.frombuffer(ri_b, dtype=np.int64),
+        )
+    if right_sorted:
+        order_r = None
+        r_sorted = right
+    else:
+        order_r = np.argsort(right, kind="stable")
+        r_sorted = right[order_r]
+    lo = np.searchsorted(r_sorted, left, "left")
+    hi = np.searchsorted(r_sorted, left, "right")
+    counts = hi - lo
+    if not counts.any():
+        e = np.empty(0, dtype=np.int64)
+        return e, e
+    li = np.repeat(np.arange(nl, dtype=np.int64), counts)
+    ri = expand_ranges(lo, counts)
+    if order_r is not None:
+        ri = order_r[ri]
+    return li, ri
+
+
 def ref_scalar_with_instance(*values: Any, instance: Any) -> Pointer:
     base = ref_scalar(*values, instance)
     inst = ref_scalar(instance)

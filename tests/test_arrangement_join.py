@@ -1,0 +1,540 @@
+"""Columnar arrangement engine + delta-join path (engine/arrangement.py,
+engine/nodes.py JoinExec): differential-oracle property tests (the
+vectorized path must emit the same diffs as the rowwise dict oracle for
+random insert/retract sequences across every mode/id_from combination,
+null keys, duplicate-id poisoning, multi-batch ticks), arrangement state
+semantics vs a dict replay, compaction/merge behavior, and the
+regression that a delta tick after a bulk backfill stays columnar (the
+PR-5 `_materialize()` cliff fix)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw  # noqa: F401  (conftest clears its graph)
+from pathway_tpu.engine.arrangement import (
+    Arrangement,
+    consolidate_entries,
+    mix_keys,
+)
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import InputNode, JoinNode, OutputNode
+from pathway_tpu.engine.runtime import Runtime, StaticSource
+from pathway_tpu.internals.api import (
+    Pointer,
+    _value_bytes,
+    match_keys,
+    ref_scalar,
+)
+
+L_COLS = ["k", "a"]
+R_COLS = ["k", "b"]
+
+
+def _run_join(mode, id_from, ticks, rowwise):
+    """Drive a JoinNode tick by tick; ticks is a list of
+    (left_batches, right_batches), each a list of row lists
+    [(key, diff, (jk_val, payload)), ...].  Returns the canonicalized
+    per-tick outputs: sorted (key, diff, value-bytes) triplets."""
+    if rowwise:
+        os.environ["PATHWAY_JOIN_ROWWISE"] = "1"
+    try:
+        inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+        inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+        join = JoinNode(inp_l, inp_r, ["k"], ["k"], mode, id_from)
+        emitted: dict[int, list] = {}
+
+        def on_batch(t, b):
+            rows = emitted.setdefault(t, [])
+            for k, d, vals in b.iter_rows():
+                rows.append((k, d, _value_bytes(vals)))
+
+        out = OutputNode(join, on_batch)
+        rt = Runtime([out], worker_threads=False)
+        for i, (l_batches, r_batches) in enumerate(ticks):
+            injected = {}
+            if any(l_batches):
+                injected[inp_l.id] = [
+                    DiffBatch.from_rows(rows, L_COLS) for rows in l_batches
+                ]
+            if any(r_batches):
+                injected[inp_r.id] = [
+                    DiffBatch.from_rows(rows, R_COLS) for rows in r_batches
+                ]
+            rt.tick(2 * i, injected)
+        ex = rt.execs[join.id]
+        assert ex._rowwise == rowwise, "unexpected fallback/oracle state"
+        return {t: sorted(rows) for t, rows in emitted.items()}
+    finally:
+        os.environ.pop("PATHWAY_JOIN_ROWWISE", None)
+
+
+def _random_ticks(seed, n_ticks=8, jk_pool=6, with_nulls=True):
+    """Random insert/retract tick sequences for both sides.  Retracted
+    row keys are never reused (dict insertion order and arrangement age
+    order then agree, which the duplicate-id winner choice depends on);
+    live keys may be re-inserted (multiplicity / value updates)."""
+    rng = np.random.default_rng(seed)
+    next_key = [1]
+    live = [{}, {}]  # side -> key -> vals tuple
+
+    def jk_val():
+        v = int(rng.integers(0, jk_pool))
+        if with_nulls and rng.random() < 0.15:
+            return None
+        return v
+
+    ticks = []
+    for _ in range(n_ticks):
+        per_side = []
+        for side in (0, 1):
+            rows = []
+            for _ in range(int(rng.integers(0, 12))):
+                op = rng.random()
+                if op < 0.30 and live[side]:
+                    # retract an existing row (exact values), retire key
+                    k = list(live[side])[
+                        int(rng.integers(0, len(live[side])))
+                    ]
+                    rows.append((k, -1, live[side].pop(k)))
+                elif op < 0.42 and live[side]:
+                    # value update: re-insert the same key, new payload
+                    k = list(live[side])[
+                        int(rng.integers(0, len(live[side])))
+                    ]
+                    vals = (live[side][k][0], int(rng.integers(0, 100)))
+                    live[side][k] = vals
+                    rows.append((k, 1, vals))
+                else:
+                    k = next_key[0]
+                    next_key[0] += 1
+                    vals = (jk_val(), int(rng.integers(0, 100)))
+                    live[side][k] = vals
+                    rows.append((k, 1, vals))
+            # multi-batch ticks: occasionally split the rows
+            if len(rows) > 2 and rng.random() < 0.4:
+                cut = int(rng.integers(1, len(rows)))
+                per_side.append([rows[:cut], rows[cut:]])
+            else:
+                per_side.append([rows] if rows else [])
+        ticks.append((per_side[0], per_side[1]))
+    return ticks
+
+
+@pytest.mark.parametrize("mode", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("id_from", [None, "left", "right"])
+def test_columnar_matches_rowwise_oracle(mode, id_from):
+    """The arrangement path must emit the same per-tick diffs as the
+    rowwise dict oracle for random insert/retract/update sequences with
+    null keys and multi-batch ticks, in every mode/id_from combination
+    (incl. duplicate-id poisoning for id_from with non-unique matches)."""
+    for seed in (3, 17, 92):
+        ticks = _random_ticks(seed)
+        got = _run_join(mode, id_from, ticks, rowwise=False)
+        want = _run_join(mode, id_from, ticks, rowwise=True)
+        assert got == want, f"divergence mode={mode} id_from={id_from} seed={seed}"
+
+
+@pytest.mark.parametrize("mode", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("id_from", [None, "left"])
+def test_columnar_matches_oracle_with_key_reuse(mode, id_from):
+    """Retract-then-reinsert of the SAME row key: the dict deletes and
+    re-creates the entry (fresh value memory + a fresh insertion
+    position); the arrangement's zero-crossing reset rule must agree."""
+    rng = np.random.default_rng(23)
+    ticks = []
+    live = [{}, {}]
+    for _ in range(10):
+        per_side = []
+        for side in (0, 1):
+            rows = []
+            for _ in range(int(rng.integers(0, 8))):
+                k = int(rng.integers(1, 8))  # tiny key pool: heavy reuse
+                if k in live[side] and rng.random() < 0.5:
+                    rows.append((k, -1, live[side].pop(k)))
+                else:
+                    vals = (int(rng.integers(0, 3)), int(rng.integers(0, 50)))
+                    live[side][k] = vals
+                    rows.append((k, 1, vals))
+            per_side.append([rows] if rows else [])
+        ticks.append((per_side[0], per_side[1]))
+    got = _run_join(mode, id_from, ticks, rowwise=False)
+    want = _run_join(mode, id_from, ticks, rowwise=True)
+    assert got == want
+
+
+def test_columnar_matches_oracle_heavy_churn():
+    """Retraction-heavy single-jk hot spot (every row shares one join
+    key) — exercises cross products, negative counts, and compaction."""
+    rng = np.random.default_rng(5)
+    live: dict[int, tuple] = {}
+    ticks = []
+    nk = 1
+    for _ in range(10):
+        rows = []
+        for _ in range(8):
+            if live and rng.random() < 0.45:
+                k = list(live)[int(rng.integers(0, len(live)))]
+                rows.append((k, -1, live.pop(k)))
+            else:
+                vals = (1, int(rng.integers(0, 50)))
+                live[nk] = vals
+                rows.append((nk, 1, vals))
+                nk += 1
+        ticks.append(([rows], [[(10_000 + nk, 1, (1, nk))]]))
+    got = _run_join("outer", None, ticks, rowwise=False)
+    want = _run_join("outer", None, ticks, rowwise=True)
+    assert got == want
+
+
+def test_retraction_before_insert_matches_oracle():
+    """A retraction arriving before its insert leaves a negative-count
+    entry; the old dict path emits the pair once both sides' counts have
+    the same sign (lc*rc>0) — the arrangement path must agree."""
+    ticks = [
+        ([[(1, -1, (7, 10))]], [[(2, -1, (7, 20))]]),  # both negative
+        ([[(1, 1, (7, 10))]], []),  # left back to 0
+        ([[(1, 1, (7, 10))]], [[(2, 1, (7, 20))]]),  # both at 0/positive
+        ([], [[(2, 1, (7, 20))]]),
+    ]
+    for mode in ("inner", "outer"):
+        got = _run_join(mode, None, ticks, rowwise=False)
+        want = _run_join(mode, None, ticks, rowwise=True)
+        assert got == want
+
+
+# --- arrangement state semantics ------------------------------------------
+
+
+def _dict_replay(entries):
+    """Reference semantics: _SideState.apply replayed on a plain dict."""
+    state: dict[tuple, list] = {}
+    for jk, k, d, val in entries:
+        e = state.get((jk, k))
+        if e is None:
+            if d != 0:
+                state[(jk, k)] = [val, d]
+        else:
+            e[1] += d
+            if d > 0:
+                e[0] = val
+            if e[1] == 0:
+                del state[(jk, k)]
+    return {kk: (v[0], v[1]) for kk, v in state.items()}
+
+
+def test_arrangement_matches_dict_replay():
+    rng = np.random.default_rng(11)
+    arr = Arrangement(1)
+    entries = []
+    for _tick in range(30):
+        n = int(rng.integers(1, 20))
+        jks = rng.integers(0, 5, size=n).astype(np.uint64)
+        keys = rng.integers(0, 12, size=n).astype(np.uint64)
+        diffs = rng.choice([-1, 1, 2], size=n).astype(np.int64)
+        vals = rng.integers(0, 1000, size=n)
+        arr.append(jks, keys, diffs, [vals])
+        entries.extend(
+            (int(j), int(k), int(d), int(v))
+            for j, k, d, v in zip(jks, keys, diffs, vals)
+        )
+        if rng.random() < 0.3:
+            rows = arr.entries()  # forces seal + consolidation
+            got = {
+                (int(j), int(k)): (int(val), int(c))
+                for j, k, c, val in zip(
+                    rows.jk, rows.key, rows.count, rows.cols[0]
+                )
+            }
+            assert got == _dict_replay(entries)
+    rows = arr.entries()
+    got = {
+        (int(j), int(k)): (int(val), int(c))
+        for j, k, c, val in zip(rows.jk, rows.key, rows.count, rows.cols[0])
+    }
+    assert got == _dict_replay(entries)
+
+
+def test_probe_returns_only_requested_jks():
+    arr = Arrangement(1)
+    jks = np.array([1, 2, 3, 2, 1], dtype=np.uint64)
+    keys = np.arange(5, dtype=np.uint64)
+    arr.append(jks, keys, np.ones(5, np.int64), [np.arange(5)])
+    rows = arr.probe(np.array([2], dtype=np.uint64))
+    assert sorted(rows.key.tolist()) == [1, 3]
+    assert (rows.jk == 2).all()
+
+
+def test_compaction_cancels_dead_entries():
+    arr = Arrangement(1, compact_ratio=0.2)
+    n = 1000
+    jks = np.arange(n, dtype=np.uint64)
+    keys = np.arange(n, dtype=np.uint64)
+    vals = np.arange(n)
+    arr.append(jks, keys, np.ones(n, np.int64), [vals])
+    # retract 40% — crosses the 20% retraction-density threshold
+    m = 400
+    arr.append(jks[:m], keys[:m], -np.ones(m, np.int64), [vals[:m]])
+    rows = arr.entries()
+    assert arr.compactions >= 1
+    assert len(rows) == n - m
+    assert len(arr) == n - m  # dead insert+retract pairs are gone
+    assert sorted(rows.key.tolist()) == list(range(m, n))
+
+
+def test_seal_survives_midway_exception_without_double_count():
+    """A seal that raises halfway (e.g. allocation failure during a
+    merge) must not re-seal already-committed batches on retry — sealed
+    entries would double their net weights."""
+    arr = Arrangement(1)
+    for start in (0, 10):
+        keys = np.arange(start, start + 5, dtype=np.uint64)
+        arr.append(keys, keys, np.ones(5, np.int64), [keys.astype(np.int64)])
+    orig = arr._merge_last_two
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise MemoryError("boom")
+
+    arr._merge_last_two = boom
+    with pytest.raises(MemoryError):
+        arr.entries()
+    arr._merge_last_two = orig
+    rows = arr.entries()  # retry after the failure
+    assert len(rows) == 10
+    assert rows.count.tolist() == [1] * 10  # nothing sealed twice
+
+
+def test_merge_keeps_dtype_and_values():
+    arr = Arrangement(1, max_segments=2)
+    a = np.array([5, 7], dtype=np.int64)
+    b = np.empty(2, dtype=object)
+    b[:] = ["x", "y"]
+    arr.append(np.array([1, 2], np.uint64), np.array([1, 2], np.uint64),
+               np.ones(2, np.int64), [a])
+    arr.append(np.array([3, 4], np.uint64), np.array([3, 4], np.uint64),
+               np.ones(2, np.int64), [b])
+    rows = arr.entries()
+    got = {int(k): v for k, v in zip(rows.key, rows.cols[0])}
+    assert got == {1: 5, 2: 7, 3: "x", 4: "y"}
+    assert type(got[1]) in (int, np.int64)
+
+
+def test_consolidate_last_positive_value_wins():
+    # +v1, +v2, -retract: count 1, value stays v2 (dict parity)
+    jks = np.zeros(3, np.uint64)
+    keys = np.zeros(3, np.uint64)
+    diffs = np.array([1, 1, -1], np.int64)
+    vals = np.array(["v1", "v2", "v2"], dtype=object)
+    rows = consolidate_entries(
+        jks, keys, diffs, np.arange(3, dtype=np.int64), [vals]
+    )
+    assert len(rows) == 1
+    assert rows.count[0] == 1 and rows.cols[0][0] == "v2"
+
+
+def test_match_keys_fallback_matches_native():
+    rng = np.random.default_rng(2)
+    left = rng.integers(0, 50, size=200).astype(np.uint64)
+    right = rng.integers(0, 50, size=150).astype(np.uint64)
+    li, ri = match_keys(left, right)
+    # brute-force reference, in (left order, right order)
+    want = [
+        (i, j)
+        for i in range(len(left))
+        for j in range(len(right))
+        if left[i] == right[j]
+    ]
+    assert list(zip(li.tolist(), ri.tolist())) == want
+
+
+# --- the _materialize() cliff fix ------------------------------------------
+
+
+def _counter_value(counter, *labels):
+    child = counter.labels(*labels) if labels else counter._unlabeled()
+    return child.value
+
+
+def test_delta_tick_after_bulk_backfill_stays_columnar():
+    """Regression for the PR-5 cliff: the first incremental delta after a
+    100k-row bulk backfill must NOT convert the operator state into
+    Python dicts — the arrangement stays columnar and the tick is served
+    by the delta path (bulk-hits counter, zero new fallbacks)."""
+    n = 100_000
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+    out_rows = {"n": 0}
+    out = OutputNode(join, lambda t, b: out_rows.__setitem__(
+        "n", out_rows["n"] + int(b.diffs.sum())
+    ))
+    rt = Runtime([out], worker_threads=False)
+    ex = rt.execs[join.id]
+    hits0 = _counter_value(ex._m_hits)
+    fb0 = sum(
+        child.value for child in ex._m_fallbacks._children.values()
+    )
+    rk = np.arange(n, dtype=np.int64)
+    bulk = DiffBatch(
+        np.arange(n, dtype=np.uint64) + 1,
+        np.ones(n, np.int64),
+        {"k": rk, "b": rk},
+    )
+    rt.tick(0, {inp_r.id: [bulk]})
+    # incremental delta tick probing the arranged side
+    lk = np.array([5, 17, 99_999], dtype=np.int64)
+    delta = DiffBatch(
+        np.array([900_001, 900_002, 900_003], np.uint64),
+        np.ones(3, np.int64),
+        {"k": lk, "a": lk * 10},
+    )
+    rt.tick(2, {inp_l.id: [delta]})
+    assert out_rows["n"] == 3
+    assert ex._rowwise is False
+    assert ex.left is None and ex.right is None  # dicts never built
+    assert len(ex.arr_r) == n  # state stayed in the arrangement
+    assert _counter_value(ex._m_hits) == hits0 + 2  # both ticks columnar
+    fb1 = sum(
+        child.value for child in ex._m_fallbacks._children.values()
+    )
+    assert fb1 == fb0  # no fallback fired
+
+
+def test_env_forced_rowwise_counts_fallback(monkeypatch):
+    monkeypatch.setenv("PATHWAY_JOIN_ROWWISE", "1")
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+    out = OutputNode(join, lambda t, b: None)
+    rt = Runtime([out], worker_threads=False)
+    ex = rt.execs[join.id]
+    assert ex._rowwise and ex._fallback_reason == "env"
+    env0 = _counter_value(ex._m_fallbacks, "env")
+    rt.tick(
+        0,
+        {
+            inp_l.id: [
+                DiffBatch.from_rows([(1, 1, (7, 1))], L_COLS)
+            ],
+            inp_r.id: [
+                DiffBatch.from_rows([(2, 1, (7, 2))], R_COLS)
+            ],
+        },
+    )
+    assert _counter_value(ex._m_fallbacks, "env") == env0 + 1
+
+
+def test_exception_fallback_materializes_and_survives(monkeypatch):
+    """If the columnar path blows up mid-tick, the exec logs, converts
+    the (pre-tick) arrangements to dict state, finishes the tick rowwise,
+    and keeps producing correct outputs."""
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+    emitted = []
+
+    def on_batch(t, b):
+        for k, d, vals in b.iter_rows():
+            emitted.append((d, vals[0], vals[2]))
+
+    out = OutputNode(join, on_batch)
+    rt = Runtime([out], worker_threads=False)
+    ex = rt.execs[join.id]
+    rt.tick(0, {inp_r.id: [DiffBatch.from_rows([(2, 1, (7, 2))], R_COLS)]})
+    monkeypatch.setattr(
+        ex, "_delta_tick",
+        lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    rt.tick(2, {inp_l.id: [DiffBatch.from_rows([(1, 1, (7, 1))], L_COLS)]})
+    assert ex._rowwise and ex._fallback_reason == "exception"
+    assert ex.left is not None and len(ex.right.by_jk) == 1
+    assert sorted(emitted) == [(1, 7, 7)]  # the join still happened
+
+
+def test_join_exec_state_dict_roundtrips():
+    """Operator snapshots: arrangements pickle (registry handles are
+    excluded) and a restored exec keeps answering deltas."""
+    import pickle
+
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+    out = OutputNode(join, lambda t, b: None)
+    rt = Runtime([out], worker_threads=False)
+    ex = rt.execs[join.id]
+    rt.tick(0, {
+        inp_r.id: [DiffBatch.from_rows([(2, 1, (7, 2))], R_COLS)],
+    })
+    blob = pickle.dumps(ex.state_dict())
+    ex2 = join.make_exec()
+    ex2.load_state(pickle.loads(blob))
+    out2 = ex2.process(
+        2,
+        [[DiffBatch.from_rows([(1, 1, (7, 1))], L_COLS)], []],
+    )
+    assert sum(len(b) for b in out2) == 1
+
+
+# --- vectorized null-key private hashing -----------------------------------
+
+
+def test_batch_jks_null_rows_byte_identical():
+    """The batched null-key path must produce the same private keys as
+    the per-row ref_scalar loop it replaced."""
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    join = JoinNode(inp_l, inp_r, ["k"], ["k"], "inner", None)
+    ex = join.make_exec()
+    rows = [(10, 1, (None, 1)), (11, 1, (3, 2)), (12, 1, (None, 3))]
+    b = DiffBatch.from_rows(rows, L_COLS)
+    jks = ex._batch_jks(b, ex.l_on_idx, "l")
+    for i, (k, _d, vals) in enumerate(rows):
+        if vals[0] is None:
+            want = int(ref_scalar("__pw_null", "l", Pointer(k)))
+            assert int(jks[i]) == want & 0xFFFFFFFFFFFFFFFF
+        else:
+            assert int(jks[i]) == int(ref_scalar(3))
+
+
+def test_sharded_join_null_key_routing_matches_single_shard():
+    """ShardedJoinExec routes by the inner exec's _batch_jks contract
+    (null on-columns get per-row private keys): output must equal the
+    single-shard exec, including outer padding for null-keyed rows."""
+    import jax
+    from jax.sharding import Mesh
+
+    from pathway_tpu.engine.sharded import ShardedJoinExec
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("data",))
+    inp_l = InputNode(StaticSource(L_COLS), L_COLS)
+    inp_r = InputNode(StaticSource(R_COLS), R_COLS)
+    jn = JoinNode(inp_l, inp_r, ["k"], ["k"], "outer", None)
+    sharded = ShardedJoinExec(jn, mesh, "data")
+    single = JoinNode(inp_l, inp_r, ["k"], ["k"], "outer", None).make_exec()
+    l_rows = [(1, 1, (7, 10)), (2, 1, (None, 11)), (3, 1, (None, 12)),
+              (4, 1, (8, 13))]
+    r_rows = [(5, 1, (7, 20)), (6, 1, (None, 21)), (7, 1, (8, 22))]
+    lb = [DiffBatch.from_rows(l_rows, L_COLS)]
+    rb = [DiffBatch.from_rows(r_rows, R_COLS)]
+
+    def canon(batches):
+        return sorted(
+            (k, d, _value_bytes(vals))
+            for b in batches
+            for k, d, vals in b.iter_rows()
+        )
+
+    assert canon(sharded.process(0, [lb, rb])) == canon(
+        single.process(0, [lb, rb])
+    )
+
+
+def test_mix_keys_no_false_negatives():
+    jks = np.array([1, 2, 3], np.uint64)
+    keys = np.array([7, 8, 9], np.uint64)
+    assert (mix_keys(jks, keys) == mix_keys(jks, keys)).all()
+    assert len(set(mix_keys(jks, keys).tolist())) == 3

@@ -280,6 +280,43 @@ def test_shard_reducer_tuple_vs_sum():
 # --- rule: graph-stats -----------------------------------------------------
 
 
+def test_join_vectorization_env_forced(monkeypatch):
+    monkeypatch.setenv("PATHWAY_JOIN_ROWWISE", "1")
+    t = _static()
+    u = _static()
+    j = t.join(u, t.k == u.k).select(t.v)
+    pw.io.null.write(j)
+    found = run_doctor().by_rule("join-vectorization")
+    assert found and found[0].severity == Severity.WARNING
+    assert "PATHWAY_JOIN_ROWWISE" in found[0].message
+
+
+def test_join_vectorization_negative(monkeypatch):
+    monkeypatch.delenv("PATHWAY_JOIN_ROWWISE", raising=False)
+    t = _static()
+    u = _static()
+    pw.io.null.write(t.join(u, t.k == u.k).select(t.v))
+    assert not run_doctor().by_rule("join-vectorization")
+
+
+def test_join_vectorization_temporal_joins_info(monkeypatch):
+    monkeypatch.delenv("PATHWAY_JOIN_ROWWISE", raising=False)
+
+    class TS(pw.Schema):
+        t: int
+        v: int
+
+    a = pw.debug.table_from_rows(TS, [(1, 1), (5, 2)])
+    b = pw.debug.table_from_rows(TS, [(2, 3), (6, 4)])
+    j = a.interval_join_inner(
+        b, a.t, b.t, pw.temporal.interval(-2, 2)
+    ).select(a.v)
+    pw.io.null.write(j)
+    found = run_doctor().by_rule("join-vectorization")
+    assert found and all(d.severity == Severity.INFO for d in found)
+    assert "rowwise" in found[0].message
+
+
 def test_graph_stats_report():
     t = _static()
     r = t.groupby(pw.this.k).reduce(pw.this.k, s=pw.reducers.sum(pw.this.v))

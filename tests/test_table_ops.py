@@ -709,10 +709,10 @@ def test_bulk_groupby_matches_per_row():
 
 
 def test_bulk_join_matches_per_row():
-    """The columnar hash-join fast path (>=1024-row insert-only inner-join
-    batches, engine/nodes.py JoinExec._try_bulk) must produce the same
-    output as the per-row path, and the state it writes must support later
-    incremental ticks (retraction of a bulk-loaded row)."""
+    """The columnar delta-join path (engine/nodes.py JoinExec._delta_tick
+    over the arrangement state) must produce the same output as the
+    rowwise oracle on a bulk load, and the state it writes must support
+    later incremental ticks (retraction of a bulk-loaded row)."""
     import numpy as np
 
     rng = np.random.default_rng(11)
