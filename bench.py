@@ -682,6 +682,179 @@ def _bench_groupby(np):
     return float(n_rows / dt)
 
 
+_DCN_BENCH_WORKER = """
+import os, json, time
+import numpy as np
+from pathway_tpu.parallel.host_exchange import HostMesh, process_env
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.observability import REGISTRY
+
+n_procs, pid, port, host = process_env()
+mesh = HostMesh(n_procs, pid, port, host)
+peer = 1 - pid
+rng = np.random.default_rng(1234 + pid)
+
+def narrow(n):
+    # key-heavy diff batch: sorted strided keys, unit diffs, one count col
+    keys = np.arange(n, dtype=np.uint64) * np.uint64(7) + np.uint64(pid)
+    return DiffBatch(
+        keys, np.ones(n, np.int64),
+        {"count": (np.arange(n) % 100).astype(np.int64)},
+    )
+
+def wide(n):
+    keys = np.sort(rng.integers(0, 2**63, n, dtype=np.uint64))
+    cols = {}
+    for j in range(5):
+        cols[f"i{j}"] = rng.integers(-50, 50, n).astype(np.int64)
+    for j in range(5):
+        cols[f"f{j}"] = rng.normal(size=n)
+    cols["flag"] = rng.integers(0, 2, n).astype(bool)
+    cols["s"] = np.array([f"tag{i % 13}" for i in range(n)], dtype=object)
+    return DiffBatch(keys, rng.choice([1, -1], n).astype(np.int64), cols)
+
+def embedding(n, dim=384):
+    emb = np.empty(n, dtype=object)
+    for i in range(n):
+        emb[i] = rng.normal(size=dim).astype(np.float32)
+    return DiffBatch(
+        np.arange(n, dtype=np.uint64), np.ones(n, np.int64),
+        {"doc_id": np.arange(n, dtype=np.int64), "emb": emb},
+    )
+
+shapes = {
+    "narrow": narrow(20_000),
+    "wide": wide(5_000),
+    "embedding": embedding(2_000),
+}
+T = int(os.environ.get("PW_BENCH_DCN_TICKS", "60"))
+W = 5  # warmup ticks: thread spin-up + numpy dispatch caches
+sent = REGISTRY.get("pathway_host_exchange_sent_bytes_total")
+res, tick = {}, 0
+for name, b in shapes.items():
+    for _ in range(W):
+        mesh.send(peer, "bench-" + name, tick, [b])
+        mesh.gather("bench-" + name, tick)
+        tick += 1
+    mesh.barrier(("start", name))
+    before = sent.labels(str(peer)).value
+    t0 = time.perf_counter()
+    for _ in range(T):
+        mesh.send(peer, "bench-" + name, tick, [b])
+        mesh.gather("bench-" + name, tick)
+        tick += 1
+    mesh.barrier(("end", name))  # both sides fully drained
+    res[name] = {
+        "rows_per_tick": len(b),
+        "ticks": T,
+        "wall_s": time.perf_counter() - t0,
+        "sent_bytes": sent.labels(str(peer)).value - before,
+    }
+print("DCNBENCH " + json.dumps(res), flush=True)
+mesh.close()
+"""
+
+
+def _bench_dcn_exchange(np):
+    """2-process loopback DCN exchange sweep (ISSUE 6 acceptance): the
+    same send+gather tick loop over narrow (key-heavy), wide
+    (many-column), and embedding (384-d float32 payload) diff batches
+    under PATHWAY_DCN_WIRE=codec vs =pickle (plus the opt-in bf16 tier),
+    reporting bytes/row, compression ratio, and exchange wall-time."""
+    import socket
+    import tempfile
+
+    def free_port_pair():
+        for base in range(21000, 40000, 17):
+            ok = True
+            for off in range(2):
+                s = socket.socket()
+                try:
+                    s.bind(("127.0.0.1", base + off))
+                except OSError:
+                    ok = False
+                finally:
+                    s.close()
+                if not ok:
+                    break
+            if ok:
+                return base
+        raise RuntimeError("no free port pair")
+
+    def run_pair(env_extra):
+        with tempfile.TemporaryDirectory() as td:
+            script = os.path.join(td, "dcn_worker.py")
+            with open(script, "w") as f:
+                f.write(_DCN_BENCH_WORKER)
+            port = free_port_pair()
+            procs = []
+            for pid in range(2):
+                env = dict(os.environ)
+                env.update(
+                    PATHWAY_PROCESSES="2",
+                    PATHWAY_PROCESS_ID=str(pid),
+                    PATHWAY_DCN_PORT=str(port),
+                    PATHWAY_DCN_SECRET=f"bench-dcn-{port}",
+                    JAX_PLATFORMS="cpu",
+                    PYTHONPATH=os.path.dirname(os.path.abspath(__file__)),
+                )
+                env.pop("PATHWAY_DCN_WIRE", None)
+                env.pop("PATHWAY_DCN_QUANT", None)
+                env.update(env_extra)
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, script],
+                        env=env,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT,
+                        text=True,
+                    )
+                )
+            outs = []
+            try:
+                outs = [p.communicate(timeout=300)[0] for p in procs]
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            for p, out in zip(procs, outs):
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"dcn bench worker failed:\n{out[-2000:]}"
+                    )
+            for line in outs[0].splitlines():
+                if line.startswith("DCNBENCH "):
+                    return json.loads(line[len("DCNBENCH "):])
+            raise RuntimeError("dcn bench worker produced no result")
+
+    runs = {
+        "codec": run_pair({"PATHWAY_DCN_WIRE": "codec"}),
+        "pickle": run_pair({"PATHWAY_DCN_WIRE": "pickle"}),
+        "codec_bf16": run_pair(
+            {"PATHWAY_DCN_WIRE": "codec", "PATHWAY_DCN_QUANT": "bf16"}
+        ),
+    }
+    out = {}
+    for shape, c in runs["codec"].items():
+        p = runs["pickle"][shape]
+        q = runs["codec_bf16"][shape]
+        rows = c["rows_per_tick"] * c["ticks"]
+        out[shape] = {
+            "rows_per_tick": c["rows_per_tick"],
+            "ticks": c["ticks"],
+            "codec_bytes_per_row": round(c["sent_bytes"] / rows, 2),
+            "pickle_bytes_per_row": round(p["sent_bytes"] / rows, 2),
+            "bf16_bytes_per_row": round(q["sent_bytes"] / rows, 2),
+            "compression_ratio": round(
+                p["sent_bytes"] / max(c["sent_bytes"], 1), 2
+            ),
+            "codec_wall_s": round(c["wall_s"], 3),
+            "pickle_wall_s": round(p["wall_s"], 3),
+            "wall_speedup": round(p["wall_s"] / c["wall_s"], 2),
+        }
+    return out
+
+
 def _bench_wordcount_stream(np):
     """5M-row ticked wordcount with 2% retractions through the engine —
     the reference's 5M-line wordcount CI proxy
@@ -1368,6 +1541,14 @@ def main() -> None:
         errors.append(f"wordcount:{type(e).__name__}:{e}")
 
     try:
+        # cross-host wire tier: codec vs pickle bytes/row + wall-time on
+        # a 2-process loopback exchange (platform-independent: the DCN
+        # rung is host TCP either way)
+        extra["dcn_exchange"] = _bench_dcn_exchange(np)
+    except Exception as e:
+        errors.append(f"dcn-exchange:{type(e).__name__}:{e}")
+
+    try:
         extra["rag_e2e_qps"] = round(_bench_rag_qps(np, on_accel), 1)
     except Exception as e:
         errors.append(f"rag:{type(e).__name__}:{e}")
@@ -1508,4 +1689,11 @@ def _reference_engine_denominator():
 
 
 if __name__ == "__main__":
-    main()
+    if sys.argv[1:] == ["dcn_exchange"]:
+        # standalone tier run (records MULTICHIP_rNN.json material
+        # without the multi-minute full sweep)
+        import numpy as _np
+
+        print(json.dumps(_bench_dcn_exchange(_np), indent=2))
+    else:
+        main()

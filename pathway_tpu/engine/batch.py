@@ -205,6 +205,33 @@ class DiffBatch:
         return out
 
 
+def uniform_element_spec(
+    col: np.ndarray,
+) -> tuple[np.dtype, tuple[int, ...]] | None:
+    """Column introspection for the wire codec: if every element of an
+    object column is an ndarray of one dtype and shape (embedding rows,
+    tuple-packed vectors), return ``(dtype, shape)`` so the codec can
+    ship them as a single stacked raw block instead of a pickle.
+    ``None`` means the column is not uniform (mixed types, ragged
+    arrays, or empty — an empty column has no element to describe)."""
+    n = len(col)
+    if n == 0:
+        return None
+    first = col[0]
+    if not isinstance(first, np.ndarray) or first.dtype == object:
+        return None
+    dtype, shape = first.dtype, first.shape
+    for i in range(1, n):
+        el = col[i]
+        if (
+            not isinstance(el, np.ndarray)
+            or el.dtype != dtype
+            or el.shape != shape
+        ):
+            return None
+    return dtype, shape
+
+
 def concat_columns(parts: Sequence[np.ndarray]) -> np.ndarray:
     """Dtype-preserving column concat: same-dtype parts concatenate
     directly; mixed dtypes go through object arrays so values are never

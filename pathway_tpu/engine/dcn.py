@@ -42,15 +42,30 @@ class _DcnRouter:
     def partition(
         self, batches: Sequence[DiffBatch], dests_fn
     ) -> list[list[DiffBatch]]:
+        """Split each batch by destination process with ONE stable
+        argsort + segment-bound search per batch, instead of n_procs
+        boolean mask + ``b.mask(m)`` passes. The stable sort keeps the
+        original row order inside every partition, so receivers apply
+        rows in the same order the old masking produced."""
         parts: list[list[DiffBatch]] = [[] for _ in range(self.n)]
         for b in batches:
             if not len(b):
                 continue
-            dest = dests_fn(b)
+            dest = np.asarray(dests_fn(b))
+            first = int(dest[0])
+            if bool((dest == first).all()):
+                # the overwhelmingly common case once upstream data is
+                # already sharded: the whole batch has one owner
+                parts[first].append(b)
+                continue
+            order = np.argsort(dest, kind="stable")
+            bounds = np.searchsorted(
+                dest[order], np.arange(self.n + 1)
+            )
             for p in range(self.n):
-                m = dest == p
-                if m.any():
-                    parts[p].append(b if m.all() else b.mask(m))
+                lo, hi = bounds[p], bounds[p + 1]
+                if hi > lo:
+                    parts[p].append(b.take(order[lo:hi]))
         return parts
 
     def _all_to_all(self, span_name: str, t: int, payload_for) -> dict:
@@ -315,16 +330,50 @@ class _OriginTracker:
         self.entries: dict[int, list] = {}  # key -> [origin_pid, count]
 
     def observe(self, src: int, batches: list[DiffBatch]) -> None:
+        """numpy batch update keyed on ``np.unique`` of the batch keys
+        (the per-row Python dict loop ran on every tick). Semantics
+        match the old row-wise scan exactly: a key is re-homed to
+        ``src`` iff some positive diff lands while the running count is
+        <= 0 — for keys this batch creates, the first row already names
+        ``src``, so only their total matters; for existing keys the
+        revive test needs the within-key running sum, computed from one
+        stable sort + cumsum."""
         entries = self.entries
         for b in batches:
-            for k, d in zip(b.keys.tolist(), b.diffs.tolist()):
-                e = entries.get(k)
+            n = len(b)
+            if n == 0:
+                continue
+            diffs = np.ascontiguousarray(b.diffs, dtype=np.int64)
+            uniq, inv = np.unique(b.keys, return_inverse=True)
+            totals = np.zeros(len(uniq), dtype=np.int64)
+            np.add.at(totals, inv, diffs)
+            c0 = np.empty(len(uniq), dtype=np.int64)
+            ukeys = uniq.tolist()
+            known = [entries.get(k) for k in ukeys]
+            needs_scan = False
+            for j, e in enumerate(known):
+                c0[j] = e[1] if e is not None else 0
+                needs_scan = needs_scan or e is not None
+            if needs_scan:
+                # within-key inclusive running sums in original row order
+                order = np.argsort(inv, kind="stable")
+                sd = diffs[order]
+                si = inv[order]
+                csum = np.cumsum(sd)
+                starts = np.searchsorted(si, np.arange(len(uniq)))
+                base = np.zeros(len(uniq), dtype=np.int64)
+                base[1:] = csum[starts[1:] - 1]
+                prefix_before = (csum - base[si]) - sd
+                row_revive = (sd > 0) & ((c0[si] + prefix_before) <= 0)
+                revived = np.zeros(len(uniq), dtype=bool)
+                np.logical_or.at(revived, si[row_revive], True)
+            for j, (k, e) in enumerate(zip(ukeys, known)):
                 if e is None:
-                    entries[k] = [src, d]
+                    entries[k] = [src, int(totals[j])]
                 else:
-                    if e[1] <= 0 and d > 0:
+                    if revived[j]:
                         e[0] = src
-                    e[1] += d
+                    e[1] += int(totals[j])
 
     def flush_dead(self) -> None:
         dead = [k for k, e in self.entries.items() if e[1] <= 0]
@@ -332,15 +381,18 @@ class _OriginTracker:
             del self.entries[k]
 
     def dests(self, b: DiffBatch, default: int) -> np.ndarray:
+        """Per-unique-key dict lookups fanned back out through the
+        ``np.unique`` inverse (was a per-row generator)."""
+        n = len(b)
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
         entries = self.entries
-        return np.fromiter(
-            (
-                e[0] if (e := entries.get(k)) is not None else default
-                for k in b.keys.tolist()
-            ),
-            dtype=np.int32,
-            count=len(b),
-        )
+        uniq, inv = np.unique(b.keys, return_inverse=True)
+        owners = np.empty(len(uniq), dtype=np.int32)
+        for j, k in enumerate(uniq.tolist()):
+            e = entries.get(k)
+            owners[j] = e[0] if e is not None else default
+        return owners[inv]
 
     def state_dict(self) -> dict:
         return {k: list(v) for k, v in self.entries.items()}

@@ -7,9 +7,22 @@ framed socket per process pair, handshake magic + peer id;
 src/engine/dataflow/config.rs:88-121 — PATHWAY_PROCESSES/PROCESS_ID/
 FIRST_PORT env contract). The TPU-native split keeps *device* data on XLA
 collectives (ICI) and gives *host* keyed rows this mesh: every process
-pair holds a framed TCP connection, DiffBatch partitions travel pickled,
-and a value-exchange barrier doubles as the lockstep tick scheduler (the
-frontier consensus of timely's progress tracking).
+pair holds a framed TCP connection, DiffBatch partitions travel as
+typed columnar frames (parallel/wire.py — delta-varint keys, packed
+diffs, raw/optionally-quantized value columns; PATHWAY_DCN_WIRE=pickle
+restores the PWHX5 whole-frame pickle), and a value-exchange barrier
+doubles as the lockstep tick scheduler (the frontier consensus of
+timely's progress tracking).
+
+Overlap: ``send`` enqueues the frame onto a bounded per-peer outbox
+drained by one sender thread per peer, which does the encode + MAC +
+``sendall`` off the caller's thread — so encoding and TCP of one
+channel's partitions overlap the next channel's partition/compute, and
+the old serialize-under-lock critical section is gone. Per-peer frame
+order (and therefore the MAC sequence) is the enqueue order; a full
+outbox back-pressures the producer instead of buffering unboundedly.
+A send failure fail-stops exactly like a dead reader: the peer is
+marked dead and the next gather/barrier raises HostMeshError.
 
 Fail-stop: a dead peer surfaces as HostMeshError at the next gather or
 barrier; the job exits nonzero and the supervisor restarts the whole
@@ -37,7 +50,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
-import pickle
+import queue
 import socket
 import struct
 import threading
@@ -49,10 +62,14 @@ from pathway_tpu.observability.tracing import (
     pending_traceparent,
     propagation_traceparent,
 )
+from pathway_tpu.parallel import wire
 
-_HELLO_MAGIC = b"PWHX5"  # protocol version tag (networking.rs handshake
-# analog); v5 appends a W3C traceparent slot to every data/bar frame so
-# traces stitch across processes (Trace Weaver, observability/tracing.py)
+_HELLO_MAGIC = b"PWHX6"  # protocol version tag (networking.rs handshake
+# analog); v6 switches frame bodies to the tagged columnar wire codec
+# (parallel/wire.py — a leading 'C'/'P' byte self-describes each frame,
+# so codec and pickle frames interoperate inside one connection); v5
+# appended the W3C traceparent slot that stitches traces across
+# processes (Trace Weaver, observability/tracing.py)
 _MAC_LEN = 32  # HMAC-SHA256
 _NONCE_LEN = 32
 _OK_TAG = b"PWOK"  # acceptor's authenticated handshake acknowledgment
@@ -62,6 +79,18 @@ _OK_TAG = b"PWOK"  # acceptor's authenticated handshake acknowledgment
 # forged reject is at worst a startup DoS an on-path attacker could
 # already cause with a TCP reset.
 _REJECT = b"PWNO" + b"\x00" * (_MAC_LEN - 4)
+# version-mismatch sentinel: a peer that shares the PWHX prefix but
+# speaks another protocol version gets told so explicitly — the dialer
+# fails fast with a clear diagnosis instead of retrying a silent close
+# until the connect deadline. Carries the acceptor's magic so the error
+# can name both versions. Same threat model note as _REJECT: forging it
+# is at worst a startup DoS an on-path attacker already has via RST.
+_VREJECT_TAG = b"PWVN"
+
+
+def _version_reject() -> bytes:
+    out = _VREJECT_TAG + _HELLO_MAGIC
+    return out + b"\x00" * (_MAC_LEN - len(out))
 
 
 def _frame_mac(key: bytes, src: int, dst: int, seq: int, body: bytes) -> bytes:
@@ -102,10 +131,17 @@ class HostMesh:
     Each process listens on base_port+pid and dials every peer; the dialing
     side sends a hello frame carrying its process id, so each ordered pair
     (src -> dst) has exactly one connection used for src's sends. Frames
-    are length-prefixed pickles:
+    are length-prefixed tagged bodies (parallel/wire.py — columnar codec
+    for DiffBatch payloads, pickle for everything else) logically shaped:
 
       ("data", src, channel, tick, payload, tp)  — DiffBatch partitions
       ("bar",  src, round, value, tp)            — barrier value exchange
+
+    Sends are asynchronous: ``send``/``barrier`` enqueue onto the
+    destination's bounded outbox and the per-peer sender thread performs
+    encode + MAC + sendall, so wire work overlaps the caller's compute.
+    The payload's ownership transfers to the mesh at enqueue — callers
+    must not mutate a sent batch.
 
     `tp` is the sender's W3C traceparent (or None): cross-host context
     propagation for the Trace Weaver. ``barrier()`` records the group's
@@ -128,6 +164,23 @@ class HostMesh:
         self.base_port = base_port
         self.host = host
         self._key = _job_key()
+        # wire-format knobs, resolved once per mesh: PATHWAY_DCN_WIRE
+        # picks the data-frame encoding (codec|pickle), PATHWAY_DCN_QUANT
+        # opts value columns into the lossy tier (bf16|int8; keys, diffs
+        # and non-float columns stay lossless regardless — wire.py never
+        # quantizes them)
+        self.wire_format = os.environ.get("PATHWAY_DCN_WIRE", "codec")
+        if self.wire_format not in ("codec", "pickle"):
+            raise HostMeshError(
+                f"PATHWAY_DCN_WIRE={self.wire_format!r}: expected "
+                "'codec' or 'pickle'"
+            )
+        self.wire_quant = os.environ.get("PATHWAY_DCN_QUANT", "") or None
+        if self.wire_quant not in (None, "bf16", "int8"):
+            raise HostMeshError(
+                f"PATHWAY_DCN_QUANT={self.wire_quant!r}: expected "
+                "'bf16', 'int8', or unset (lossless)"
+            )
         # Flight Recorder: DCN traffic accounting. Peer cardinality is the
         # process-group size (small); every process also exposes its own
         # id via the `process` label on pathway_build_info-adjacent scrape
@@ -163,6 +216,30 @@ class HostMesh:
             "pathway_host_exchange_gather_seconds",
             "wait for one payload from every peer on a data channel",
         )
+        self._m_encode_seconds = REGISTRY.histogram(
+            "pathway_host_exchange_encode_seconds",
+            "wire-encode time per frame, by the format actually used "
+            "(barrier/scalar frames ride the pickle path even under "
+            "PATHWAY_DCN_WIRE=codec)",
+            labelnames=("wire",),
+        )
+        self._m_decode_seconds = REGISTRY.histogram(
+            "pathway_host_exchange_decode_seconds",
+            "wire-decode time per received frame, by format",
+            labelnames=("wire",),
+        )
+        self._m_ratio = REGISTRY.gauge(
+            "pathway_host_exchange_compression_ratio",
+            "dense in-memory bytes / wire bytes of the most recent codec "
+            "data frame, by exchange channel",
+            labelnames=("channel",),
+        )
+        self._m_outbox_depth = REGISTRY.gauge(
+            "pathway_host_exchange_outbox_depth",
+            "frames waiting on the per-peer sender outbox (bounded by "
+            "PATHWAY_DCN_OUTBOX; a full outbox back-pressures the tick)",
+            labelnames=("peer",),
+        )
         self._cv = threading.Condition()
         # (channel, tick) -> {src: payload}
         self._data: dict[tuple[str, int], dict[int, Any]] = {}
@@ -180,10 +257,21 @@ class HostMesh:
         self.last_barrier_tps: dict[int, str | None] = {}
         self._round = 0
         self._dead: set[int] = set()
-        self._send_locks: dict[int, threading.Lock] = {}
+        # peer pid -> its PWHX magic, recorded when a peer running a
+        # DIFFERENT protocol version dials us with a valid job-secret
+        # MAC (a genuinely old build cannot understand our PWVN reject,
+        # but its authenticated hello proves the skew — our own dial
+        # loop for that peer aborts with the version diagnosis instead
+        # of retrying into the connect deadline)
+        self._version_skew: dict[int, bytes] = {}
         self._out: dict[int, socket.socket] = {}
-        self._send_seq: dict[int, int] = {}  # per-destination frame counter
         self._closed = False
+        # per-peer overlapped delivery: bounded outbox + one sender
+        # thread per peer (owns that connection's MAC sequence counter)
+        depth = int(os.environ.get("PATHWAY_DCN_OUTBOX", "32") or 32)
+        self._outbox: dict[int, queue.Queue] = {}
+        self._senders: dict[int, threading.Thread] = {}
+        self._send_failed: dict[int, BaseException] = {}
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -196,14 +284,33 @@ class HostMesh:
             if peer == pid:
                 continue
             self._out[peer] = self._dial(peer, deadline)
-            self._send_locks[peer] = threading.Lock()
-            self._send_seq[peer] = 0
+            q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+            self._outbox[peer] = q
+            self._m_outbox_depth.labels(str(peer)).set_function(q.qsize)
+            th = threading.Thread(
+                target=self._sender_loop,
+                args=(peer,),
+                daemon=True,
+                name=f"pw-dcn-send-{pid}to{peer}",
+            )
+            self._senders[peer] = th
+            th.start()
 
     # --- wiring -----------------------------------------------------------
 
     def _dial(self, peer: int, deadline: float) -> socket.socket:
         last_err: Exception | None = None
         while time.time() < deadline:
+            skew = self._version_skew.get(peer)
+            if skew is not None:
+                raise HostMeshError(
+                    f"process {self.pid}: protocol version mismatch — "
+                    f"peer {peer} speaks "
+                    f"{skew.decode('ascii', 'replace')}, this process "
+                    f"speaks {_HELLO_MAGIC.decode('ascii')} (detected "
+                    "from the peer's authenticated hello); run every "
+                    "process of the job from the same build"
+                )
             s: socket.socket | None = None
             try:
                 s = socket.create_connection(
@@ -239,6 +346,19 @@ class HostMesh:
                         f"process {self.pid}: peer {peer} rejected the "
                         "handshake — authentication failed (is "
                         "PATHWAY_DCN_SECRET identical on every process?)"
+                    )
+                if ok[: len(_VREJECT_TAG)] == _VREJECT_TAG:
+                    peer_magic = (
+                        ok[len(_VREJECT_TAG) : len(_VREJECT_TAG) + 5]
+                        .rstrip(b"\x00")
+                        .decode("ascii", "replace")
+                    )
+                    s.close()
+                    raise HostMeshError(
+                        f"process {self.pid}: protocol version mismatch "
+                        f"— peer {peer} speaks {peer_magic}, this process "
+                        f"speaks {_HELLO_MAGIC.decode('ascii')}; run every "
+                        "process of the job from the same build"
                     )
                 expected = hmac.new(
                     self._key, _OK_TAG + nonce + hello, "sha256"
@@ -284,12 +404,49 @@ class HostMesh:
 
     def _reader(self, conn: socket.socket) -> None:
         src = -1
+        dec_codec = self._m_decode_seconds.labels("codec")
+        dec_pickle = self._m_decode_seconds.labels("pickle")
         try:
             nonce = os.urandom(_NONCE_LEN)
             conn.settimeout(30.0)  # handshake must complete promptly
             conn.sendall(nonce)
             hello = self._read_exact(conn, len(_HELLO_MAGIC) + 8 + _MAC_LEN)
-            if hello is None or hello[: len(_HELLO_MAGIC)] != _HELLO_MAGIC:
+            if hello is None:
+                conn.close()
+                return
+            magic = hello[: len(_HELLO_MAGIC)]
+            if magic != _HELLO_MAGIC:
+                if magic[: len(_VREJECT_TAG)] == _HELLO_MAGIC[
+                    : len(_VREJECT_TAG)
+                ]:
+                    # a PWHX peer speaking another protocol version:
+                    # tell it explicitly so a PWVN-aware build fails
+                    # fast with a version diagnosis instead of retrying
+                    # until its deadline
+                    try:
+                        conn.sendall(_version_reject())
+                    except OSError:
+                        pass
+                    # a genuinely OLD build cannot parse PWVN — but if
+                    # its hello authenticates under the job secret (the
+                    # MAC scheme predates the version split), that
+                    # PROVES a same-job peer on another version: record
+                    # the skew so our own dial loop for that peer
+                    # aborts with the clear diagnosis. Unauthenticated
+                    # probers must not be able to plant skew (that
+                    # would let any off-path connect kill the job).
+                    claimed, mac = hello[:-_MAC_LEN], hello[-_MAC_LEN:]
+                    if hmac.compare_digest(
+                        mac,
+                        hmac.new(
+                            self._key, claimed + nonce, "sha256"
+                        ).digest(),
+                    ):
+                        skew_src, skew_dst = struct.unpack(
+                            "<ii", claimed[len(_HELLO_MAGIC) :]
+                        )
+                        if skew_dst == self.pid and 0 <= skew_src < self.n:
+                            self._version_skew[skew_src] = magic
                 conn.close()
                 return
             claimed, mac = hello[:-_MAC_LEN], hello[-_MAC_LEN:]
@@ -335,7 +492,13 @@ class HostMesh:
                 recv_seq += 1
                 self._m_recv_bytes.labels(str(src)).inc(len(head) + len(body))
                 self._m_recv_msgs.labels(str(src)).inc()
-                frame = pickle.loads(body)
+                t0 = time.perf_counter()
+                frame = wire.decode_frame(body)
+                (
+                    dec_codec
+                    if body[:1] == wire.FRAME_CODEC
+                    else dec_pickle
+                ).observe(time.perf_counter() - t0)
                 kind = frame[0]
                 with self._cv:
                     if kind == "data":
@@ -353,7 +516,12 @@ class HostMesh:
                         if tp is not None:
                             self._bar_tps.setdefault(rnd, {})[fsrc] = tp
                     self._cv.notify_all()
-        except OSError:
+        except Exception:
+            # transport faults AND decode failures (wire.WireError, a
+            # struct/pickle error from a codec bug or a version skew
+            # the handshake missed) take the same clean fail-stop path:
+            # drop the link, mark the peer dead below — never kill the
+            # reader thread with an unhandled-exception traceback
             pass
         finally:
             conn.close()
@@ -364,28 +532,92 @@ class HostMesh:
 
     # --- send/recv --------------------------------------------------------
 
-    def _send_frame(self, dst: int, frame: tuple) -> None:
-        body = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
-        try:
-            with self._send_locks[dst]:
-                mac = _frame_mac(
-                    self._key, self.pid, dst, self._send_seq[dst], body
+    _STOP = object()  # outbox sentinel: sender thread exits
+
+    def _enqueue_frame(self, dst: int, frame: tuple) -> None:
+        """Hand a frame to dst's sender thread. Bounded: a full outbox
+        blocks (back-pressure against a slow peer) but keeps polling the
+        failure flags so a dead peer cannot wedge the producer."""
+        q = self._outbox[dst]
+        while True:
+            if self._closed:
+                raise HostMeshError(
+                    f"process {self.pid}: mesh is closed"
                 )
-                self._send_seq[dst] += 1
+            err = self._send_failed.get(dst)
+            if err is not None:
+                raise HostMeshError(
+                    f"process {self.pid}: send to peer {dst} failed "
+                    f"({err})"
+                )
+            try:
+                q.put(frame, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _sender_loop(self, dst: int) -> None:
+        """Drain dst's outbox: encode + MAC + sendall, off the caller's
+        thread, so wire work overlaps the next channel's partitioning
+        and compute. Owns the connection's MAC sequence counter (frames
+        leave in enqueue order, so the receiver's recv_seq matches)."""
+        q = self._outbox[dst]
+        sock = self._out[dst]
+        seq = 0
+        # bind label children once: the per-frame path pays attribute
+        # loads, not registry lock + dict lookups
+        enc_codec = self._m_encode_seconds.labels("codec")
+        enc_pickle = self._m_encode_seconds.labels("pickle")
+        sent_bytes = self._m_sent_bytes.labels(str(dst))
+        sent_msgs = self._m_sent_msgs.labels(str(dst))
+        while True:
+            frame = q.get()
+            if frame is self._STOP:
+                return
+            try:
+                t0 = time.perf_counter()
+                body, stats = wire.encode_frame(
+                    frame, self.wire_format, self.wire_quant
+                )
+                (enc_codec if stats is not None else enc_pickle).observe(
+                    time.perf_counter() - t0
+                )
+                if stats is not None and stats["raw_bytes"]:
+                    self._m_ratio.labels(frame[2]).set(
+                        stats["raw_bytes"] / max(len(body) - 1, 1)
+                    )
+                mac = _frame_mac(self._key, self.pid, dst, seq, body)
+                seq += 1
                 msg = struct.pack("<I", len(body)) + mac + body
-                self._out[dst].sendall(msg)
-            self._m_sent_bytes.labels(str(dst)).inc(len(msg))
-            self._m_sent_msgs.labels(str(dst)).inc()
-        except OSError as e:
-            raise HostMeshError(
-                f"process {self.pid}: send to peer {dst} failed ({e})"
-            ) from e
+                sock.sendall(msg)
+                sent_bytes.inc(len(msg))
+                sent_msgs.inc()
+            except Exception as e:  # OSError or an encode bug: fail-stop
+                self._send_failed[dst] = e
+                with self._cv:
+                    self._dead.add(dst)
+                    self._cv.notify_all()
+                # unblock producers stuck on the (now doomed) outbox
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                return
+
+    def _dead_detail(self, pids) -> str:
+        notes = [
+            f"peer {p} send failed: {self._send_failed[p]}"
+            for p in sorted(pids)
+            if p in self._send_failed
+        ]
+        return (" [" + "; ".join(notes) + "]") if notes else ""
 
     def send(self, dst: int, channel: str, tick: int, payload: Any) -> None:
         # disabled tracing must not cost a contextvar read + pending-lock
         # acquisition per frame on the mesh hot path
         tp = propagation_traceparent() if get_tracer().enabled else None
-        self._send_frame(
+        self._enqueue_frame(
             dst, ("data", self.pid, channel, tick, payload, tp)
         )
 
@@ -421,6 +653,7 @@ class HostMesh:
                             f"process {self.pid}: peer(s) "
                             f"{sorted(missing & self._dead)} died before "
                             f"delivering {channel}@{tick}"
+                            + self._dead_detail(missing & self._dead)
                         )
                 left = deadline - time.time()
                 if left <= 0:
@@ -450,7 +683,7 @@ class HostMesh:
         own_tp = pending_traceparent() if get_tracer().enabled else None
         for peer in range(self.n):
             if peer != self.pid:
-                self._send_frame(
+                self._enqueue_frame(
                     peer, ("bar", self.pid, rnd, value, own_tp)
                 )
         want = self.n - 1
@@ -475,6 +708,7 @@ class HostMesh:
                             f"process {self.pid}: peer(s) "
                             f"{sorted(missing & self._dead)} died at "
                             f"barrier {rnd}"
+                            + self._dead_detail(missing & self._dead)
                         )
                 left = deadline - time.time()
                 if left <= 0:
@@ -503,6 +737,27 @@ class HostMesh:
 
     def close(self) -> None:
         self._closed = True
+        # FLUSH-then-stop each outbox: the sentinel queues BEHIND any
+        # pending frames so the sender delivers them first — a barrier
+        # frame still in flight must reach the peer or its next barrier
+        # sees a spurious dead-peer EOF. Producers blocked on a full
+        # outbox unblock via the closed flag (their next 0.2 s poll
+        # raises), freeing a slot; a sender that already fail-stopped
+        # has undeliverable frames, so skip the sentinel and just join
+        # (the thread is gone). Bounded retries keep close() from
+        # wedging on a hung peer; the socket close below aborts any
+        # still-blocked sendall.
+        for dst, q in self._outbox.items():
+            for _ in range(50):
+                if self._send_failed.get(dst) is not None:
+                    break
+                try:
+                    q.put(self._STOP, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+        for th in self._senders.values():
+            th.join(timeout=2.0)
         try:
             self._listener.close()
         except OSError:

@@ -442,6 +442,37 @@ def test_two_process_join_dcn(tmp_path):
     assert merged == expected
 
 
+@pytest.mark.parametrize("wire_fmt", ["codec", "pickle"])
+def test_two_process_wordcount_wire_formats(tmp_path, wire_fmt):
+    """The PWHX6 columnar codec and the pickle escape hatch produce
+    IDENTICAL results end-to-end: same per-process ownership contract,
+    same merged totals (acceptance: differential 2-process run with
+    PATHWAY_DCN_WIRE=codec vs =pickle)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_DCN_WORDCOUNT)
+    procs, outs = _spawn_group(
+        script,
+        2,
+        _free_dcn_port(),
+        extra_env=lambda pid: {"PATHWAY_DCN_WIRE": wire_fmt},
+    )
+    results = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pid={pid} failed:\n{out[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results.append(json.loads(line[len("RESULT "):]))
+    assert len(results) == 2
+    assert not (set(results[0]) & set(results[1]))
+    merged: dict[str, int] = {}
+    for r in results:
+        merged.update(r)
+    expected = {
+        f"w{j}": len([i for i in range(100) if i % 7 == j]) for j in range(7)
+    }
+    assert merged == expected
+
+
 def test_host_mesh_rejects_unauthenticated_frames(monkeypatch):
     """A client without the per-job PATHWAY_DCN_SECRET must not get its
     bytes anywhere near pickle.loads (ADVICE r4: pickle over TCP is RCE

@@ -1,0 +1,575 @@
+"""DCN wire codec (parallel/wire.py) + PWHX6 mesh behaviors: bit-exact
+columnar roundtrips vs the pickle path, opt-in quantization, the
+version-mismatch fast-fail handshake, and the overlapped per-peer
+outbox."""
+
+from __future__ import annotations
+
+import hmac
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.batch import DiffBatch, uniform_element_spec
+from pathway_tpu.parallel import wire
+
+
+def _roundtrip(batches, quant=None):
+    frame = ("data", 3, "chan7", 12, list(batches), None)
+    body, stats = wire.encode_frame(frame, "codec", quant)
+    assert body[:1] == wire.FRAME_CODEC
+    out = wire.decode_frame(body)
+    assert out[:4] == frame[:4] and out[5] is None
+    return out[4], body, stats
+
+
+def _rand_batch(rng, n, sorted_keys=True, with_obj=True):
+    keys = rng.integers(0, 2**64, n, dtype=np.uint64)
+    if sorted_keys:
+        keys = np.sort(keys)
+    cols = {
+        "i": rng.integers(-3, 3, n).astype(np.int64),
+        "f": rng.normal(size=n),
+        "f32": rng.normal(size=n).astype(np.float32),
+        "b": rng.integers(0, 2, n).astype(bool),
+    }
+    if with_obj:
+        cols["s"] = np.array(
+            [None if i % 11 == 0 else f"s{i % 5}" for i in range(n)],
+            dtype=object,
+        )
+        tup = np.empty(n, dtype=object)
+        for i in range(n):
+            tup[i] = (i, "x", None)
+        cols["t"] = tup
+    return DiffBatch(
+        keys, rng.choice([1, -1], n).astype(np.int64), cols
+    )
+
+
+# --- varint / primitives ---------------------------------------------------
+
+
+def test_uvarint_roundtrip_edges():
+    edges = [0, 1, 127, 128, 16383, 16384, 2**32, 2**63 - 1, 2**63, 2**64 - 1]
+    vals = np.array(edges, dtype=np.uint64)
+    enc = wire.uvarint_encode(vals)
+    dec = wire.uvarint_decode(np.frombuffer(enc, np.uint8), len(vals))
+    assert np.array_equal(dec, vals)
+    assert wire.uvarint_encode(np.empty(0, np.uint64)) == b""
+
+
+def test_uvarint_roundtrip_random():
+    rng = np.random.default_rng(0)
+    for n in (1, 7, 1000):
+        vals = rng.integers(0, 2**64, n, dtype=np.uint64)
+        enc = wire.uvarint_encode(vals)
+        dec = wire.uvarint_decode(np.frombuffer(enc, np.uint8), n)
+        assert np.array_equal(dec, vals)
+
+
+def test_uvarint_rejects_wrong_count():
+    enc = wire.uvarint_encode(np.array([5, 6], dtype=np.uint64))
+    with pytest.raises(wire.WireError):
+        wire.uvarint_decode(np.frombuffer(enc, np.uint8), 3)
+
+
+def test_zigzag_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.integers(-(2**62), 2**62, 500).astype(np.int64)
+    x[:4] = (-(2**63), 2**63 - 1, 0, -1)
+    assert np.array_equal(wire.unzigzag(wire.zigzag(x)), x)
+
+
+# --- codec roundtrips ------------------------------------------------------
+
+
+def test_roundtrip_mixed_batch_bit_exact_vs_pickle():
+    rng = np.random.default_rng(2)
+    b = _rand_batch(rng, 500)
+    frame = ("data", 0, "ch", 3, [b], "00-aa-bb-01")
+    codec_body, stats = wire.encode_frame(frame, "codec", None)
+    pickle_body, pstats = wire.encode_frame(frame, "pickle", None)
+    assert pstats is None and pickle_body[:1] == wire.FRAME_PICKLE
+    got_c = wire.decode_frame(codec_body)
+    got_p = wire.decode_frame(pickle_body)
+    assert got_c[:4] == got_p[:4] == frame[:4]
+    assert got_c[5] == got_p[5] == "00-aa-bb-01"
+    assert wire.batches_equal(got_c[4], [b])
+    assert wire.batches_equal(got_p[4], [b])
+    # dtype preservation, column order, writability
+    out = got_c[4][0]
+    assert out.column_names == b.column_names
+    for name in b.column_names:
+        assert out.columns[name].dtype == b.columns[name].dtype
+    out.diffs[0] = 5  # decoded arrays must be writable
+    assert stats["rows"] == 500 and stats["raw_bytes"] > 0
+
+
+def test_roundtrip_empty_and_no_columns():
+    batches, _body, _ = _roundtrip(
+        [DiffBatch.empty(["a", "b"]), DiffBatch.empty([])]
+    )
+    assert wire.batches_equal(
+        batches, [DiffBatch.empty(["a", "b"]), DiffBatch.empty([])]
+    )
+    batches, _body, _ = _roundtrip([])
+    assert batches == []
+    # no-column batch with rows (pure key/diff traffic)
+    b = DiffBatch(
+        np.array([7, 7, 9], np.uint64), np.array([1, -1, 1], np.int64), {}
+    )
+    batches, _body, _ = _roundtrip([b])
+    assert wire.batches_equal(batches, [b])
+
+
+def test_roundtrip_unsorted_and_extreme_keys():
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 2**64, 300, dtype=np.uint64)  # adversarial
+    keys[:3] = (0, 2**64 - 1, 1)
+    b = DiffBatch(
+        keys,
+        rng.integers(-5, 6, 300).astype(np.int64),
+        {"v": rng.integers(0, 2**63, 300, dtype=np.uint64)},
+    )
+    batches, _body, _ = _roundtrip([b])
+    assert wire.batches_equal(batches, [b])
+
+
+def test_roundtrip_embedding_column_stacked_not_pickled():
+    rng = np.random.default_rng(4)
+    n, dim = 64, 16
+    emb = np.empty(n, dtype=object)
+    for i in range(n):
+        emb[i] = rng.normal(size=dim).astype(np.float32)
+    assert uniform_element_spec(emb) == (np.dtype(np.float32), (dim,))
+    b = DiffBatch(
+        np.arange(n, dtype=np.uint64), np.ones(n, np.int64), {"emb": emb}
+    )
+    batches, body, _ = _roundtrip([b])
+    assert wire.batches_equal(batches, [b])
+    # stacked raw block beats a pickle of 64 tiny ndarrays
+    assert len(body) < len(pickle.dumps([b]))
+
+
+def test_ragged_object_column_falls_back_to_pickle():
+    col = np.empty(3, dtype=object)
+    col[0] = np.zeros(2, np.float32)
+    col[1] = np.zeros(3, np.float32)  # ragged
+    col[2] = np.zeros(2, np.float32)
+    assert uniform_element_spec(col) is None
+    b = DiffBatch(
+        np.arange(3, dtype=np.uint64), np.ones(3, np.int64), {"r": col}
+    )
+    batches, _body, _ = _roundtrip([b])
+    assert wire.batches_equal(batches, [b])
+
+
+def test_roundtrip_property_random_batches():
+    rng = np.random.default_rng(5)
+    for trial in range(25):
+        bs = [
+            _rand_batch(
+                rng,
+                int(rng.integers(0, 80)),
+                sorted_keys=bool(rng.integers(0, 2)),
+                with_obj=bool(rng.integers(0, 2)),
+            )
+            for _ in range(int(rng.integers(1, 4)))
+        ]
+        batches, _body, _ = _roundtrip(bs)
+        assert wire.batches_equal(batches, bs), f"trial {trial}"
+
+
+def test_varint_int_columns_and_general_diffs():
+    # small ints varint-pack; huge-magnitude ints fall back to raw
+    b = DiffBatch(
+        np.arange(1000, dtype=np.uint64),
+        np.array([3] * 999 + [-7], np.int64),  # non-±1, non-const diffs
+        {
+            "small": np.arange(-500, 500, dtype=np.int64),
+            "huge": np.full(1000, -(2**62), dtype=np.int64),
+            "u16": np.arange(1000, dtype=np.uint16),
+        },
+    )
+    batches, body, _ = _roundtrip([b])
+    assert wire.batches_equal(batches, [b])
+    # key-heavy lossless tier: ≥3× fewer bytes than pickle
+    narrow = DiffBatch(
+        np.arange(10_000, dtype=np.uint64) * np.uint64(7),
+        np.ones(10_000, np.int64),
+        {"count": np.arange(10_000, dtype=np.int64) % 100},
+    )
+    body, _ = wire.encode_frame(
+        ("data", 0, "c", 0, [narrow], None), "codec", None
+    )
+    praw = len(pickle.dumps(("data", 0, "c", 0, [narrow], None)))
+    assert praw / len(body) >= 3.0, (praw, len(body))
+
+
+# --- quantization (opt-in lossy tier) --------------------------------------
+
+
+def test_quant_off_by_default_floats_bit_exact():
+    rng = np.random.default_rng(6)
+    vals = rng.normal(size=200)
+    vals[:3] = (np.inf, -np.inf, np.nan)
+    b = DiffBatch(
+        np.arange(200, dtype=np.uint64),
+        np.ones(200, np.int64),
+        {"f": vals, "f32": vals.astype(np.float32)},
+    )
+    batches, _body, _ = _roundtrip([b])  # quant=None
+    assert wire.batches_equal(batches, [b])
+
+
+def test_quant_bf16_lossy_floats_lossless_everything_else():
+    rng = np.random.default_rng(7)
+    n = 256
+    b = DiffBatch(
+        rng.integers(0, 2**64, n, dtype=np.uint64),
+        rng.choice([1, -1], n).astype(np.int64),
+        {
+            "f": rng.normal(size=n),
+            "i": rng.integers(-(2**40), 2**40, n).astype(np.int64),
+        },
+    )
+    batches, _body, _ = _roundtrip([b], quant="bf16")
+    out = batches[0]
+    assert np.array_equal(out.keys, b.keys)  # keys NEVER quantized
+    assert np.array_equal(out.diffs, b.diffs)  # diffs NEVER quantized
+    assert np.array_equal(out.columns["i"], b.columns["i"])  # ints lossless
+    f = out.columns["f"]
+    assert f.dtype == np.float64  # dtype restored
+    assert not np.array_equal(f, b.columns["f"])  # actually lossy
+    assert np.allclose(f, b.columns["f"], rtol=1e-2)  # bf16 tolerance
+
+
+def test_quant_bf16_specials_survive():
+    vals = np.array([np.inf, -np.inf, np.nan, 0.0, -0.0, 1.0], np.float32)
+    b = DiffBatch(
+        np.arange(6, dtype=np.uint64), np.ones(6, np.int64), {"f": vals}
+    )
+    out = _roundtrip([b], quant="bf16")[0][0].columns["f"]
+    assert np.isinf(out[0]) and out[0] > 0
+    assert np.isinf(out[1]) and out[1] < 0
+    assert np.isnan(out[2])
+    assert out[3] == 0.0 and out[5] == 1.0
+
+
+def test_quant_int8_blockwise_and_nonfinite_fallback():
+    rng = np.random.default_rng(8)
+    n = 3000  # spans multiple 1024 blocks with uneven tail
+    vals = rng.normal(size=n).astype(np.float32) * 10
+    b = DiffBatch(
+        np.arange(n, dtype=np.uint64), np.ones(n, np.int64), {"f": vals}
+    )
+    out = _roundtrip([b], quant="int8")[0][0].columns["f"]
+    assert out.dtype == np.float32
+    scale = np.abs(vals).max() / 127
+    assert np.abs(out - vals).max() <= scale * 1.01
+    # non-finite data refuses the absmax scale: lossless fallback
+    vals2 = vals.copy()
+    vals2[7] = np.nan
+    b2 = DiffBatch(
+        np.arange(n, dtype=np.uint64), np.ones(n, np.int64), {"f": vals2}
+    )
+    out2 = _roundtrip([b2], quant="int8")[0][0].columns["f"]
+    assert np.array_equal(out2, vals2, equal_nan=True)
+
+
+def test_quant_embedding_column_bf16():
+    rng = np.random.default_rng(9)
+    n, dim = 32, 24
+    emb = np.empty(n, dtype=object)
+    for i in range(n):
+        emb[i] = rng.normal(size=dim).astype(np.float32)
+    b = DiffBatch(
+        np.arange(n, dtype=np.uint64), np.ones(n, np.int64), {"emb": emb}
+    )
+    lossless_body, _ = wire.encode_frame(
+        ("data", 0, "c", 0, [b], None), "codec", None
+    )
+    body, _ = wire.encode_frame(
+        ("data", 0, "c", 0, [b], None), "codec", "bf16"
+    )
+    assert len(body) < len(lossless_body)
+    out = wire.decode_frame(body)[4][0].columns["emb"]
+    for i in range(n):
+        assert out[i].dtype == np.float32 and out[i].shape == (dim,)
+        assert np.allclose(out[i], emb[i], rtol=1e-2)
+
+
+# --- frame-level behaviors -------------------------------------------------
+
+
+def test_non_batch_payloads_stay_pickled():
+    for frame in [
+        ("bar", 1, 4, ("tick", 9), None),
+        ("data", 0, "sc", 2, {"scalar": 1}, None),
+        ("data", 0, "sc", 2, [1, 2, 3], None),  # list, but not batches
+    ]:
+        body, stats = wire.encode_frame(frame, "codec", None)
+        assert stats is None and body[:1] == wire.FRAME_PICKLE
+        assert wire.decode_frame(body) == frame
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(wire.WireError):
+        wire.decode_frame(b"Xjunk")
+    with pytest.raises(Exception):
+        wire.decode_frame(wire.FRAME_CODEC + b"\x99short")
+
+
+# --- mesh integration: PWHX6 handshake + overlapped outbox -----------------
+
+
+def _free_port_pair() -> int:
+    import random
+
+    for _ in range(50):
+        base = random.randint(20000, 40000)
+        ok = True
+        for off in range(2):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                s.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port pair")
+
+
+def test_dialer_fails_fast_on_version_reject(monkeypatch):
+    """A PWHX peer speaking another version answers the hello with the
+    explicit version-reject — the dialer must raise a clear
+    HostMeshError immediately, not retry until the connect deadline."""
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-vtest")
+    base = _free_port_pair()
+    # fake OLD acceptor on peer 1's port: nonce, read hello, send the
+    # version-reject naming PWHX5
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", base + 1))
+    lst.listen(1)
+
+    def fake_acceptor():
+        conn, _ = lst.accept()
+        conn.sendall(b"\x01" * hx._NONCE_LEN)
+        hello = b""
+        while len(hello) < len(hx._HELLO_MAGIC) + 8 + hx._MAC_LEN:
+            chunk = conn.recv(64)
+            if not chunk:
+                break
+            hello += chunk
+        reject = hx._VREJECT_TAG + b"PWHX5"
+        conn.sendall(reject + b"\x00" * (hx._MAC_LEN - len(reject)))
+        time.sleep(0.5)
+        conn.close()
+
+    th = threading.Thread(target=fake_acceptor, daemon=True)
+    th.start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(hx.HostMeshError, match="version mismatch"):
+            hx.HostMesh(2, 0, base, connect_timeout=30.0)
+    finally:
+        lst.close()
+    # fast fail: nowhere near the 30 s connect deadline
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_acceptor_detects_old_dialer_and_aborts_own_dial(monkeypatch):
+    """An AUTHENTICATED hello with an older PWHX magic gets the explicit
+    version-reject blob naming OUR version, and — because a genuinely
+    old build cannot parse that blob — the skew is recorded so our own
+    dial loop toward that peer aborts fast with the version diagnosis
+    instead of retrying into the connect deadline."""
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-vtest2")
+    base = _free_port_pair()
+    err_holder: list = []
+
+    def build():
+        try:
+            hx.HostMesh(2, 0, base, connect_timeout=30.0)
+        except Exception as e:
+            err_holder.append(e)
+
+    th = threading.Thread(target=build, daemon=True)
+    th.start()
+    time.sleep(0.3)  # listener is up before the constructor's dial wait
+    t0 = time.monotonic()
+    dialer = socket.create_connection(("127.0.0.1", base), timeout=5)
+    dialer.settimeout(5)
+    nonce = b""
+    while len(nonce) < hx._NONCE_LEN:
+        nonce += dialer.recv(hx._NONCE_LEN - len(nonce))
+    hello = b"PWHX5" + struct.pack("<ii", 1, 0)
+    key = hx._job_key()
+    dialer.sendall(
+        hello + hmac.new(key, hello + nonce, "sha256").digest()
+    )
+    resp = b""
+    while len(resp) < hx._MAC_LEN:
+        chunk = dialer.recv(hx._MAC_LEN - len(resp))
+        if not chunk:
+            break
+        resp += chunk
+    dialer.close()
+    assert resp[: len(hx._VREJECT_TAG)] == hx._VREJECT_TAG
+    assert b"PWHX6" in resp
+    th.join(20)
+    assert err_holder, "constructor should have aborted on version skew"
+    assert isinstance(err_holder[0], hx.HostMeshError)
+    assert "version mismatch" in str(err_holder[0])
+    assert time.monotonic() - t0 < 15.0  # nowhere near the 30 s deadline
+
+
+def test_unauthenticated_old_hello_cannot_plant_version_skew(monkeypatch):
+    """A prober without the job secret sending an old-version hello must
+    NOT be able to abort the mesh construction (that would be an
+    off-path job-kill primitive); it gets the PWVN blob and nothing
+    else happens."""
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-vtest3")
+    base = _free_port_pair()
+    holder: list = []
+
+    def build():
+        try:
+            holder.append(hx.HostMesh(2, 0, base, connect_timeout=6.0))
+        except Exception as e:
+            holder.append(e)
+
+    th = threading.Thread(target=build, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    rogue = socket.create_connection(("127.0.0.1", base), timeout=5)
+    rogue.settimeout(5)
+    nonce = b""
+    while len(nonce) < hx._NONCE_LEN:
+        nonce += rogue.recv(hx._NONCE_LEN - len(nonce))
+    hello = b"PWHX5" + struct.pack("<ii", 1, 0)
+    rogue.sendall(hello + b"\x00" * hx._MAC_LEN)  # garbage MAC
+    rogue.close()
+    th.join(20)
+    # the construction failed on the (absent) peer-1 connect timeout,
+    # NOT on a forged version skew
+    assert holder and isinstance(holder[0], hx.HostMeshError)
+    assert "version mismatch" not in str(holder[0])
+
+
+def _mesh_pair(base):
+    from pathway_tpu.parallel import host_exchange as hx
+
+    meshes = [None, None]
+
+    def build(pid):
+        meshes[pid] = hx.HostMesh(2, pid, base, connect_timeout=30.0)
+
+    ts = [threading.Thread(target=build, args=(p,)) for p in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert meshes[0] is not None and meshes[1] is not None
+    return meshes
+
+
+def test_outbox_overlapped_sends_preserve_order(monkeypatch):
+    """Many enqueued frames arrive complete and in order through the
+    sender threads (MAC seq numbers would kill the link otherwise)."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-outbox")
+    monkeypatch.setenv("PATHWAY_DCN_OUTBOX", "4")  # force backpressure
+    m0, m1 = _mesh_pair(_free_port_pair())
+    try:
+        rng = np.random.default_rng(10)
+        sent = []
+        for t in range(40):
+            b = _rand_batch(rng, 50, with_obj=False)
+            sent.append(b)
+            m0.send(1, "ch", t, [b])
+        for t in range(40):
+            got = m1.gather("ch", t, timeout=30)
+            assert wire.batches_equal(got[0], [sent[t]])
+        # codec actually used: the per-channel ratio gauge exists
+        from pathway_tpu.observability import REGISTRY
+
+        g = REGISTRY.get("pathway_host_exchange_compression_ratio")
+        assert g is not None
+        assert g.labels("ch").current() > 1.0
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_close_flushes_queued_frames(monkeypatch):
+    """close() must deliver frames still sitting in the outbox (the
+    stop sentinel queues BEHIND them) — dropping a queued barrier/data
+    frame would make the peer see a spurious dead-peer EOF."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-flush")
+    monkeypatch.setenv("PATHWAY_DCN_OUTBOX", "1")
+    m0, m1 = _mesh_pair(_free_port_pair())
+    try:
+        b = _rand_batch(np.random.default_rng(12), 10, with_obj=False)
+        m0.send(1, "last", 0, [b])
+        m0.close()  # frame may still be queued; close must flush it
+        got = m1.gather("last", 0, timeout=20)
+        assert wire.batches_equal(got[0], [b])
+    finally:
+        m1.close()
+
+
+def test_dead_peer_fails_stop_via_barrier(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-dead")
+    m0, m1 = _mesh_pair(_free_port_pair())
+    from pathway_tpu.parallel import host_exchange as hx
+
+    m1.close()
+    with pytest.raises(hx.HostMeshError):
+        m0.barrier("x", timeout=20.0)
+    m0.close()
+
+
+def test_pickle_wire_knob(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-pkl")
+    monkeypatch.setenv("PATHWAY_DCN_WIRE", "pickle")
+    m0, m1 = _mesh_pair(_free_port_pair())
+    try:
+        assert m0.wire_format == "pickle"
+        b = _rand_batch(np.random.default_rng(11), 20)
+        m0.send(1, "ch", 0, [b])
+        got = m1.gather("ch", 0, timeout=30)
+        assert wire.batches_equal(got[0], [b])
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_bad_wire_knob_rejected(monkeypatch):
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-bad")
+    monkeypatch.setenv("PATHWAY_DCN_WIRE", "zstd")
+    with pytest.raises(hx.HostMeshError, match="PATHWAY_DCN_WIRE"):
+        hx.HostMesh(2, 0, _free_port_pair())
+    monkeypatch.delenv("PATHWAY_DCN_WIRE")
+    monkeypatch.setenv("PATHWAY_DCN_QUANT", "fp4")
+    with pytest.raises(hx.HostMeshError, match="PATHWAY_DCN_QUANT"):
+        hx.HostMesh(2, 0, _free_port_pair())
