@@ -1095,6 +1095,241 @@ def _bench_join_incremental(np):
     }
 
 
+def _bench_checkpoint_recovery(np):
+    """Checkpoint/recovery tier (State Ledger): build ~1M rows of
+    groupby+dedupe+join operator state, then measure (a) steady-state
+    snapshot bytes and wall at 1% churn on the incremental segment path
+    vs the monolithic pickler (PATHWAY_PERSIST_MONOLITH=1), (b)
+    restart-to-fresh seconds via mmap segment recovery (zero log
+    replay), and (c) dedupe bulk throughput, arrangement path vs the
+    rowwise oracle (PATHWAY_STATE_ROWWISE=1)."""
+    import gc
+    import os
+    import shutil
+    import tempfile
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.nodes import (
+        DeduplicateNode,
+        GroupByNode,
+        InputNode,
+        JoinNode,
+        OutputNode,
+    )
+    from pathway_tpu.engine.reducers import ReducerSpec
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+    from pathway_tpu.persistence._runtime_glue import attach_persistence
+
+    n_state = 1_000_000  # rows of operator state across the three execs
+    churn = n_state // 100  # 1% churn per steady-state tick
+    n_keys = n_state // 4
+
+    class _CountingStore:
+        """Counts OPERATOR-SNAPSHOT bytes (segment files + per-generation
+        state blobs); input-log chunks and metadata are the event log's
+        cost, not the checkpoint's."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.bytes = 0
+            self.puts = 0
+
+        def put(self, key, data):
+            if key.startswith(("segments/", "states/")):
+                self.bytes += len(data)
+                self.puts += 1
+            self.inner.put(key, data)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    def cfg(root):
+        class Cfg:
+            backend = pw.persistence.Backend.filesystem(str(root))
+            # interval commits off: the measured drv.commit(snapshot=True)
+            # calls are the only snapshot points
+            snapshot_interval_ms = 10**9
+            snapshot_every = 1
+
+        return Cfg()
+
+    L = ["k", "v"]
+    R = ["k", "w"]
+
+    def build():
+        il = InputNode(StaticSource(L), L)
+        ir = InputNode(StaticSource(R), R)
+        ded = DeduplicateNode(il, ["k"], None, "v")
+        gby = GroupByNode(
+            il,
+            ["k"],
+            {
+                "cnt": ReducerSpec(kind="count", arg_cols=()),
+                "s": ReducerSpec(kind="sum", arg_cols=("v",)),
+            },
+        )
+        join = JoinNode(il, ir, ["k"], ["k"], "inner", None)
+        sink = {"rows": 0}
+
+        def on_batch(t, b):
+            sink["rows"] += len(b)
+
+        outs = [
+            OutputNode(ded, on_batch),
+            OutputNode(gby, on_batch),
+            OutputNode(join, on_batch),
+        ]
+        rt = Runtime(outs, worker_threads=False)
+        join._live_cols = {"l.v", "r.w"}
+        return rt, il, ir
+
+    def bulk_batches():
+        # ~1M rows of state: 500k left (dedupe+groupby+join-left),
+        # 500k right (join-right)
+        half = n_state // 2
+        ks = np.arange(half, dtype=np.int64) % n_keys
+        lb = DiffBatch(
+            np.arange(half, dtype=np.uint64) + 1,
+            np.ones(half, np.int64),
+            {"k": ks, "v": np.arange(half, dtype=np.int64)},
+        )
+        rb = DiffBatch(
+            np.arange(half, dtype=np.uint64) + 50_000_000,
+            np.ones(half, np.int64),
+            {"k": ks, "w": np.arange(half, dtype=np.int64)},
+        )
+        return lb, rb
+
+    def churn_batches(i):
+        m = churn // 2
+        ks = (np.arange(m, dtype=np.int64) + i * m) % n_keys
+        lb = DiffBatch(
+            np.arange(m, dtype=np.uint64) + 100_000_000 + i * m,
+            np.ones(m, np.int64),
+            {"k": ks, "v": ks + i},
+        )
+        rb = DiffBatch(
+            np.arange(m, dtype=np.uint64) + 200_000_000 + i * m,
+            np.ones(m, np.int64),
+            {"k": ks, "w": ks - i},
+        )
+        return lb, rb
+
+    def run_snapshots(root, monolith, n_ticks):
+        prev = os.environ.pop("PATHWAY_PERSIST_MONOLITH", None)
+        if monolith:
+            os.environ["PATHWAY_PERSIST_MONOLITH"] = "1"
+        try:
+            rt, il, ir = build()
+            drv = attach_persistence(rt, cfg(root))
+            store = _CountingStore(drv.store)
+            drv.store = store
+            lb, rb = bulk_batches()
+            rt.tick(0, {il.id: [lb], ir.id: [rb]})
+            drv.commit(snapshot=True)  # bulk snapshot: untimed baseline
+            bulk_bytes = store.bytes
+            per_tick = []
+            gc.disable()
+            try:
+                for i in range(1, n_ticks + 1):
+                    dl, dr = churn_batches(i)
+                    store.bytes = 0
+                    rt.tick(2 * i, {il.id: [dl], ir.id: [dr]})
+                    t0 = time.perf_counter()
+                    drv.commit(snapshot=True)
+                    per_tick.append(
+                        (store.bytes, time.perf_counter() - t0)
+                    )
+            finally:
+                gc.enable()
+            by = sorted(b for b, _ in per_tick)
+            wall = sorted(w for _, w in per_tick)
+            return {
+                "bulk_bytes": bulk_bytes,
+                "steady_bytes": by[len(by) // 2],
+                "steady_seconds": wall[len(wall) // 2],
+            }
+        finally:
+            os.environ.pop("PATHWAY_PERSIST_MONOLITH", None)
+            if prev is not None:
+                os.environ["PATHWAY_PERSIST_MONOLITH"] = prev
+
+    def dedupe_bulk(rowwise, n):
+        prev = os.environ.pop("PATHWAY_STATE_ROWWISE", None)
+        if rowwise:
+            os.environ["PATHWAY_STATE_ROWWISE"] = "1"
+        try:
+            il = InputNode(StaticSource(L), L)
+            ded = DeduplicateNode(il, ["k"], None, "v")
+            sink = {"rows": 0}
+            out = OutputNode(ded, lambda t, b: sink.__setitem__(
+                "rows", sink["rows"] + len(b)
+            ))
+            rt = Runtime([out], worker_threads=False)
+            ks = np.arange(n, dtype=np.int64) % (n // 2)
+            b = DiffBatch(
+                np.arange(n, dtype=np.uint64) + 1,
+                np.ones(n, np.int64),
+                {"k": ks, "v": np.arange(n, dtype=np.int64)},
+            )
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                rt.tick(0, {il.id: [b]})
+                dt = time.perf_counter() - t0
+            finally:
+                gc.enable()
+            assert sink["rows"] > 0
+            return n / dt
+        finally:
+            os.environ.pop("PATHWAY_STATE_ROWWISE", None)
+            if prev is not None:
+                os.environ["PATHWAY_STATE_ROWWISE"] = prev
+
+    base = tempfile.mkdtemp(prefix="pw-ckpt-bench-")
+    try:
+        inc = run_snapshots(os.path.join(base, "inc"), False, 5)
+        mono = run_snapshots(os.path.join(base, "mono"), True, 2)
+
+        # restart-to-fresh: rebuild the graph, recover from the
+        # incremental store (mmap segments, no log replay)
+        rt2, _il2, _ir2 = build()
+        t0 = time.perf_counter()
+        drv2 = attach_persistence(rt2, cfg(os.path.join(base, "inc")))
+        recovery_s = time.perf_counter() - t0
+        assert drv2.restored_from_snapshot, "recovery fell back to replay"
+        assert drv2.replayed_events == 0, drv2.replayed_events
+
+        ded_fast = dedupe_bulk(False, 1_000_000)
+        ded_slow = dedupe_bulk(True, 200_000)
+
+        return {
+            "snapshot_bytes_per_1k_churn": round(
+                inc["steady_bytes"] * 1000.0 / churn, 1
+            ),
+            "recovery_seconds_1m_rows": round(recovery_s, 3),
+            "snapshot_bytes_steady": inc["steady_bytes"],
+            "snapshot_seconds_steady": round(inc["steady_seconds"], 4),
+            "snapshot_bytes_monolith": mono["steady_bytes"],
+            "snapshot_seconds_monolith": round(
+                mono["steady_seconds"], 4
+            ),
+            "vs_monolith_bytes": round(
+                mono["steady_bytes"] / max(inc["steady_bytes"], 1), 2
+            ),
+            "vs_monolith_wall": round(
+                mono["steady_seconds"] / max(inc["steady_seconds"], 1e-9),
+                2,
+            ),
+            "snapshot_bulk_bytes": inc["bulk_bytes"],
+            "dedupe_bulk_rows_per_sec": round(ded_fast, 1),
+            "dedupe_bulk_vs_rowwise": round(ded_fast / ded_slow, 2),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _bench_rag_qps(np, on_accel):
     """RAG end-to-end QPS: tokenize-free query embed + KNN retrieve
     (the VectorStoreServer hot path, BASELINE.md metric 3)."""
@@ -1541,6 +1776,13 @@ def main() -> None:
         errors.append(f"wordcount:{type(e).__name__}:{e}")
 
     try:
+        # checkpoint/recovery tier: incremental segment snapshots vs the
+        # monolithic pickler + restart-to-fresh seconds (State Ledger)
+        extra["checkpoint_recovery"] = _bench_checkpoint_recovery(np)
+    except Exception as e:
+        errors.append(f"checkpoint-recovery:{type(e).__name__}:{e}")
+
+    try:
         # cross-host wire tier: codec vs pickle bytes/row + wall-time on
         # a 2-process loopback exchange (platform-independent: the DCN
         # rung is host TCP either way)
@@ -1695,5 +1937,9 @@ if __name__ == "__main__":
         import numpy as _np
 
         print(json.dumps(_bench_dcn_exchange(_np), indent=2))
+    elif sys.argv[1:] == ["checkpoint_recovery"]:
+        import numpy as _np
+
+        print(json.dumps(_bench_checkpoint_recovery(_np), indent=2))
     else:
         main()

@@ -310,9 +310,22 @@ class _Segment:
     """Immutable run sorted by jk (stable — equal-jk entries keep
     insertion order) with per-entry global ages, a sorted (jk, key)
     fingerprint for overlap/duplicate checks, and a ``clean`` flag
-    (insert-only weights, no duplicate (jk, key) pairs)."""
+    (insert-only weights, no duplicate (jk, key) pairs).
 
-    def __init__(self, jks, keys, diffs, ages, cols, mix_sorted, clean):
+    ``seg_id`` is a per-arrangement monotone identity assigned at creation
+    (sealing, merging and compaction all mint fresh ids): a given id names
+    one immutable byte-content forever, which is what lets the persistence
+    layer content-address segment files and write only ids it has never
+    seen (persistence/segments.py)."""
+
+    __slots__ = (
+        "jks", "keys", "diffs", "ages", "cols", "mix_sorted", "clean",
+        "seg_id",
+    )
+
+    def __init__(
+        self, jks, keys, diffs, ages, cols, mix_sorted, clean, seg_id=-1
+    ):
         self.jks = jks
         self.keys = keys
         self.diffs = diffs
@@ -320,9 +333,18 @@ class _Segment:
         self.cols = cols
         self.mix_sorted = mix_sorted
         self.clean = clean
+        self.seg_id = seg_id
 
     def __len__(self) -> int:
         return len(self.jks)
+
+    def __getstate__(self):  # __slots__ classes need explicit pickling
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __setstate__(self, state):
+        self.seg_id = -1  # pre-seg_id pickles
+        for k, v in state.items():
+            setattr(self, k, v)
 
 
 class Arrangement:
@@ -343,6 +365,14 @@ class Arrangement:
         self._neg_entries = 0  # retraction entries since last compaction
         self.compactions = 0
         self.merges = 0
+        # persistence identity: epoch distinguishes this arrangement's
+        # segment-id space from any earlier incarnation whose files may
+        # still sit in a store (a fresh run after a structural-mismatch
+        # restart would otherwise mint seg_id 0 again and collide with
+        # the stale file of the same name); ids are monotone within one
+        # epoch, including across save/restore
+        self.epoch = os.urandom(6).hex()
+        self._next_seg_id = 0
         self.max_segments = (
             max_segments
             if max_segments is not None
@@ -356,6 +386,22 @@ class Arrangement:
 
     def __len__(self) -> int:
         return self._entries
+
+    def __setstate__(self, state: dict) -> None:
+        # monolith snapshots written before arrangements carried a
+        # persistence identity unpickle without epoch/seg-id state; mint a
+        # fresh epoch (stale same-name files cannot exist for it) and
+        # re-id any legacy segments so manifest_of works after restore
+        self.__dict__.update(state)
+        if "epoch" not in state:
+            self.epoch = os.urandom(6).hex()
+        if "_next_seg_id" not in state:
+            self._next_seg_id = 0
+        for seg in self.segments:
+            if getattr(seg, "seg_id", -1) < 0:
+                seg.seg_id = self._alloc_seg_id()
+            elif seg.seg_id >= self._next_seg_id:
+                self._next_seg_id = seg.seg_id + 1
 
     def stage(
         self,
@@ -420,6 +466,45 @@ class Arrangement:
         overlay a pending delta on probed state with consistent ordering."""
         return self._next_age + sum(len(s[0]) for s in self._staged)
 
+    def _alloc_seg_id(self) -> int:
+        sid = self._next_seg_id
+        self._next_seg_id += 1
+        return sid
+
+    def seal(self) -> None:
+        """Fold staged deltas into immutable segments now (probes do this
+        lazily) — the persistence layer calls it so a snapshot manifest
+        names only sealed, serializable segments."""
+        self._seal()
+
+    @classmethod
+    def restore(
+        cls,
+        n_cols: int,
+        segments: list[_Segment],
+        *,
+        epoch: str,
+        next_age: int,
+        next_seg_id: int,
+        neg_entries: int = 0,
+        max_segments: int | None = None,
+        compact_ratio: float | None = None,
+    ) -> "Arrangement":
+        """Rebuild an arrangement from previously sealed segments (the
+        mmap recovery path, persistence/segments.py). The epoch and the
+        seg-id counter continue from the snapshot so future segment files
+        never reuse a persisted name."""
+        arr = cls(
+            n_cols, max_segments=max_segments, compact_ratio=compact_ratio
+        )
+        arr.segments = list(segments)
+        arr.epoch = epoch
+        arr._next_age = int(next_age)
+        arr._next_seg_id = int(next_seg_id)
+        arr._entries = int(sum(len(s) for s in segments))
+        arr._neg_entries = int(neg_entries)
+        return arr
+
     def _seal(self) -> None:
         if self._staged:
             # pop as we go: if sealing batch k raises (allocation failure
@@ -453,6 +538,7 @@ class Arrangement:
                         [np.asarray(c)[order] for c in cols],
                         mix_sorted,
                         clean,
+                        self._alloc_seg_id(),
                     )
                 )
                 # geometric merge schedule: fold the newest segment into
@@ -498,6 +584,7 @@ class Arrangement:
             ],
             mix_sorted,
             clean,
+            self._alloc_seg_id(),
         )
         self.segments[-2:] = [merged]
         self.merges += 1
@@ -517,6 +604,7 @@ class Arrangement:
             rows.cols,
             mix_sorted,
             bool((rows.count > 0).all()),
+            self._alloc_seg_id(),
         )
         self.segments = [seg] if m else []
         self._next_age = m

@@ -142,12 +142,41 @@ class NodeExec:
 
     def state_dict(self) -> dict | None:
         """Picklable snapshot of this exec's incremental state, or None
-        when the exec is stateless."""
-        state = {k: v for k, v in self.__dict__.items() if k != "node"}
+        when the exec is stateless.  "_m_"-prefixed attributes are
+        metrics-registry handles (hold locks, process-global) and are
+        never part of operator state."""
+        state = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k != "node" and not k.startswith("_m_")
+        }
         return state or None
 
     def load_state(self, state: dict) -> None:
         self.__dict__.update(state)
+
+    # --- incremental (arrangement-backed) snapshots ---------------------
+    # Execs whose state lives in Arrangements (engine/arrangement.py)
+    # expose it so the persistence glue can write sealed segments
+    # incrementally (content-addressed by segment id, bytes ∝ churn) and
+    # recover by mmap-loading them instead of unpickling a monolith.
+
+    def arranged_state(self) -> tuple[dict, dict[str, Any]] | None:
+        """(residual_state, {name: Arrangement}) when this exec's state
+        should snapshot incrementally, or None to snapshot monolithically
+        via state_dict().  The residual must be small (indices, flags) —
+        everything that grows with state belongs in the arrangements."""
+        return None
+
+    def load_arranged_state(
+        self, residual: dict, arrangements: dict[str, Any]
+    ) -> None:
+        """Default restore: residual attrs + each arrangement under its
+        part name (parts named after plain attributes).  Execs that nest
+        arrangements inside helper objects override this."""
+        self.load_state(residual)
+        for name, arr in arrangements.items():
+            setattr(self, name, arr)
 
 
 def _concat_inputs(batches: list[DiffBatch], names: Sequence[str]) -> DiffBatch:
@@ -434,6 +463,101 @@ class GroupByExec(NodeExec):
         self.arg_idx = [
             tuple(in_cols.index(c) for c in spec.arg_cols) for spec in self.specs
         ]
+        # persistence ledger: a side arrangement mirroring per-group state
+        # as immutable pickled blobs, appended only for groups a tick
+        # touches — so operator snapshots write O(churn) segment bytes
+        # instead of re-pickling the whole groups dict. The COMPUTE path
+        # is untouched (groupby stays on the dict accumulators); the
+        # glue enables this only when persistence is attached.
+        self.ledger = Arrangement(1)
+        self._ledgered: set[int] = set()
+        self._ledger_enabled = False
+
+    def enable_state_ledger(self) -> None:
+        self._ledger_enabled = True
+
+    def _ledger_append(self, touched) -> None:
+        if not self._ledger_enabled or not touched:
+            return
+        try:
+            import pickle as _pickle
+
+            jks: list[int] = []
+            diffs: list[int] = []
+            blobs: list = []
+            for gk in touched:
+                gs = self.groups.get(gk)
+                if gk in self._ledgered:
+                    jks.append(gk)
+                    diffs.append(-1)
+                    blobs.append(None)  # cancels by (jk, key); value unused
+                    if gs is None:
+                        self._ledgered.discard(gk)
+                if gs is not None:
+                    jks.append(gk)
+                    diffs.append(1)
+                    blobs.append(
+                        _pickle.dumps(gs, protocol=_pickle.HIGHEST_PROTOCOL)
+                    )
+                    self._ledgered.add(gk)
+            if jks:
+                jka = np.asarray(jks, dtype=np.uint64)
+                col = np.empty(len(blobs), dtype=object)
+                col[:] = blobs
+                self.ledger.append(
+                    jka, jka, np.asarray(diffs, dtype=np.int64), [col]
+                )
+        except Exception:
+            # unpicklable accumulator (e.g. a closure-bound stateful
+            # reducer): drop to the monolithic snapshot path permanently —
+            # same degraded contract the whole-state pickler already has
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "groupby state ledger disabled (unpicklable group state) "
+                "for node %s; snapshots fall back to the monolithic path",
+                self.node,
+                exc_info=True,
+            )
+            self._ledger_enabled = False
+            self.ledger = Arrangement(1)
+            self._ledgered = set()
+
+    def arranged_state(self):
+        if not self._ledger_enabled:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "groups", "ledger", "_ledgered")
+            and not k.startswith("_m_")
+        }
+        return residual, {"ledger": self.ledger}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        import pickle as _pickle
+
+        self.__dict__.update(residual)
+        self.ledger = arrangements["ledger"]
+        rows = self.ledger.entries()
+        self.groups = {
+            int(jk): _pickle.loads(blob)
+            for jk, blob in zip(rows.jk.tolist(), rows.cols[0].tolist())
+        }
+        self._ledgered = set(self.groups)
+
+    def load_state(self, state: dict) -> None:
+        enabled = self._ledger_enabled  # set by the persistence glue
+        super().load_state(state)
+        if enabled and not self._ledger_enabled:
+            # the snapshot was taken by a run without the ledger (legacy
+            # or PATHWAY_PERSIST_MONOLITH): re-enable for THIS run
+            self._ledger_enabled = True
+        if self._ledger_enabled and self.groups and not self._ledgered:
+            # seed the ledger with every restored group — otherwise the
+            # next incremental snapshot would persist only groups touched
+            # since the restore and silently drop the rest
+            self._ledger_append(list(self.groups))
 
     def _group_key(self, vals: tuple) -> int:
         gvals = tuple(vals[i] for i in self.g_idx)
@@ -708,6 +832,7 @@ class GroupByExec(NodeExec):
             gs.emitted = new
             if new is None and gs.count == 0:
                 del self.groups[gk]
+        self._ledger_append(touched)
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]
@@ -803,6 +928,57 @@ def _none_col(n: int) -> np.ndarray:
     return np.full(n, None, dtype=object)
 
 
+def _eq_scalar(x, y) -> bool:
+    """Python `==` with the engine's value conventions (ndarray values
+    compare elementwise, None equals only None, un-comparable objects
+    fall back to identity) — the scalar twin of batch._values_eq."""
+    if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+        return (
+            isinstance(x, np.ndarray)
+            and isinstance(y, np.ndarray)
+            and x.shape == y.shape
+            and bool(np.all(x == y))
+        )
+    try:
+        return bool(x == y) or (x is None and y is None)
+    except (ValueError, TypeError):
+        return x is y
+
+
+_eq_elem = np.frompyfunc(_eq_scalar, 2, 1)
+
+
+def _column_eq(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Elementwise value equality of two aligned columns (bool array);
+    typed columns compare at C speed, object columns row by row with
+    _eq_scalar semantics."""
+    if a.dtype != object and b.dtype != object:
+        try:
+            return np.asarray(a == b, dtype=bool)
+        except (TypeError, ValueError):
+            pass
+    return _eq_elem(a, b).astype(bool)
+
+
+def _state_rowwise_env() -> bool:
+    """The shared rowwise-oracle knob for every arrangement-backed
+    stateful exec (dedupe / temporal joins / session assignment)."""
+    return os.environ.get("PATHWAY_STATE_ROWWISE", "") not in ("", "0")
+
+
+def _fallback_counter():
+    """One counter for every arrangement-backed exec's degradation to the
+    rowwise path — a single definition so the metric cannot fork."""
+    from pathway_tpu.observability import REGISTRY
+
+    return REGISTRY.counter(
+        "pathway_engine_state_fallbacks_total",
+        "arrangement-backed stateful execs degraded to the rowwise "
+        "path, by node class and reason",
+        ("node", "reason"),
+    )
+
+
 # vectorized Pointer boxing for the _left_id/_right_id output columns
 _box_pointers = np.frompyfunc(Pointer, 1, 1)
 
@@ -882,15 +1058,28 @@ class JoinExec(NodeExec):
         if os.environ.get("PATHWAY_JOIN_ROWWISE", "") not in ("", "0"):
             self._to_rowwise("env")
 
-    # --- operator snapshots: skip registry handles ----------------------
+    # --- operator snapshots ---------------------------------------------
+    # state_dict (base) already skips registry handles; arranged_state
+    # additionally routes the two side arrangements through the
+    # incremental segment-snapshot path when the columnar path is live.
 
-    def state_dict(self) -> dict | None:
-        state = {
+    def arranged_state(self):
+        if self._rowwise or self.left is not None:
+            return None  # dict fallback state: monolith snapshot
+        residual = {
             k: v
             for k, v in self.__dict__.items()
-            if k != "node" and not k.startswith("_m_")
+            if k not in ("node", "arr_l", "arr_r")
+            and not k.startswith("_m_")
         }
-        return state or None
+        return residual, {"arr_l": self.arr_l, "arr_r": self.arr_r}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        super().load_arranged_state(residual, arrangements)
+        # the env oracle knob outlives the snapshot that was taken on the
+        # columnar path — re-apply it so a restart honors the escape hatch
+        if os.environ.get("PATHWAY_JOIN_ROWWISE", "") not in ("", "0"):
+            self._to_rowwise("env")
 
     # --- fallback management --------------------------------------------
 
@@ -2129,6 +2318,19 @@ class DeduplicateNode(Node):
 
 
 class DeduplicateExec(NodeExec):
+    """Deduplicate over columnar arranged state.
+
+    The accepted row per instance lives in an Arrangement (one net entry
+    per instance hash, engine/arrangement.py): a tick derives instance
+    keys with the C batch hasher, probes the touched instances with one
+    searchsorted pass, decides acceptance vectorized (acceptor-None
+    collapses to a compare-against-predecessor scan; a user acceptor
+    folds per touched group), emits the NET per-instance change, and
+    appends the retract/insert delta back into the arrangement — so
+    bulk loads are columnar and snapshots are incremental segments.
+    The per-row dict path survives as the differential-testing oracle
+    (PATHWAY_STATE_ROWWISE=1) and as the exception escape hatch."""
+
     # persisted under its own identity even when inputs re-feed every run
     # (reference: deduplicate keeps state via its persistent id,
     # operators/stateful_reduce.rs non-retractable accumulators)
@@ -2141,18 +2343,99 @@ class DeduplicateExec(NodeExec):
         self.val_idx = (
             in_cols.index(node.value_col) if node.value_col else None
         )
-        # instance key -> (accepted value, emitted row vals, out key)
+        self.n_cols = len(in_cols)
+        # instance key -> (accepted value, emitted row vals, out key) —
+        # the rowwise oracle/fallback representation only
         self.state: dict[int, tuple] = {}
+        self.arr = Arrangement(self.n_cols)
+        self._rowwise = False
+        self._fallback_reason: str | None = None
+        self._m_fallbacks = _fallback_counter()
+        if _state_rowwise_env():
+            self._to_rowwise("env")
+
+    # --- fallback / oracle management -----------------------------------
+
+    def _to_rowwise(self, reason: str) -> None:
+        """Materialize dict state from the arrangement and stay rowwise
+        from here on (degraded-but-running contract)."""
+        self._rowwise = True
+        self._fallback_reason = reason
+        self._m_fallbacks.labels(type(self).__name__, reason).inc()
+        rows = self.arr.entries()
+        if len(rows):
+            cols = [c.tolist() for c in rows.cols]
+            vals_it: Any = zip(*cols) if cols else iter([()] * len(rows))
+            for jk, vals in zip(rows.jk.tolist(), vals_it):
+                vals = tuple(vals)
+                value = (
+                    vals[self.val_idx] if self.val_idx is not None else vals
+                )
+                self.state[int(jk)] = (value, vals, int(jk))
+        self.arr = Arrangement(self.n_cols)
+
+    # --- operator snapshots ---------------------------------------------
+
+    def arranged_state(self):
+        if self._rowwise:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "arr", "state", "_restore_emit")
+            and not k.startswith("_m_")
+        }
+        return residual, {"arr": self.arr}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.arr = arrangements["arr"]
+        self.state = {}
+        if _state_rowwise_env():
+            self._rowwise = False  # residual was snapshotted columnar
+            self._to_rowwise("env")
+        self._set_restore_emit()
 
     def load_state(self, state: dict) -> None:
         super().load_state(state)
+        if not self._rowwise and "arr" not in state and self.state:
+            # legacy monolith snapshot (pre-arrangement dict state): seed
+            # the arrangement so the columnar path continues with the
+            # restored accepted rows instead of re-accepting duplicates
+            entries = list(self.state.values())
+            jks = np.asarray(
+                [ik for (_v, _vals, ik) in entries], dtype=np.uint64
+            )
+            cols = []
+            for ci in range(self.n_cols):
+                col = np.empty(len(entries), dtype=object)
+                col[:] = [vals[ci] for (_v, vals, _ik) in entries]
+                cols.append(col)
+            self.arr = Arrangement(self.n_cols)
+            self.arr.append(
+                jks, jks, np.ones(len(entries), dtype=np.int64), cols
+            )
+            self.state = {}
+        self._set_restore_emit()
+
+    def _set_restore_emit(self) -> None:
         # restored accumulator output re-emits on the first tick of the new
         # run so downstream consumers rebuild (reference: a restored
         # arrangement feeds its consolidated contents to consumers at the
-        # initial time)
-        self._restore_emit = [
-            (ik, 1, vals) for (_value, vals, ik) in self.state.values()
-        ]
+        # initial time). The persistence glue clears this when the graph
+        # restored downstream state too (inputs do not re-feed).
+        if self.state:
+            self._restore_emit = [
+                (ik, 1, vals) for (_value, vals, ik) in self.state.values()
+            ]
+        else:
+            rows = self.arr.entries()
+            cols = [c.tolist() for c in rows.cols]
+            vals_it: Any = zip(*cols) if cols else iter([()] * len(rows))
+            self._restore_emit = [
+                (int(jk), 1, tuple(vals))
+                for jk, vals in zip(rows.jk.tolist(), vals_it)
+            ]
 
     def state_dict(self) -> dict | None:
         state = super().state_dict()
@@ -2160,12 +2443,162 @@ class DeduplicateExec(NodeExec):
             state.pop("_restore_emit", None)
         return state
 
-    def process(self, t, inputs):
+    # --- columnar path ---------------------------------------------------
+
+    def _accept_vectorized(self, cols, order, starts, prev, has_prev, prev_pos):
+        """Acceptor-None acceptance: a row is accepted iff its value
+        differs from its predecessor in the instance's sequence (the
+        stored value for group firsts; no stored value accepts
+        unconditionally).  Returns (selected original row per group,
+        changed mask) — the last accepted row is the net new state."""
+        n = len(order)
+        cmp_idx = (
+            [self.val_idx] if self.val_idx is not None else range(self.n_cols)
+        )
+        eq = np.ones(n, dtype=bool)
+        for ci in cmp_idx:
+            sc = cols[ci][order]
+            e = np.empty(n, dtype=bool)
+            e[0] = False
+            if n > 1:
+                e[1:] = _column_eq(sc[1:], sc[:-1])
+            eq &= e
+        first_eq = np.zeros(len(starts), dtype=bool)
+        if len(prev) and has_prev.any():
+            pi = prev_pos[has_prev]
+            fe = np.ones(int(has_prev.sum()), dtype=bool)
+            first_rows = order[starts[has_prev]]
+            for ci in cmp_idx:
+                fe &= _column_eq(cols[ci][first_rows], prev.cols[ci][pi])
+            first_eq[has_prev] = fe
+        eq[starts] = first_eq
+        accept = ~eq
+        posm = np.where(accept, np.arange(n, dtype=np.int64), np.int64(-1))
+        last = np.maximum.reduceat(posm, starts)
+        changed = last >= 0
+        sel = order[np.where(changed, last, 0)]
+        return sel, changed
+
+    def _accept_acceptor(self, cols, order, starts, prev, has_prev, prev_pos):
+        """User-acceptor acceptance: fold each touched instance's rows in
+        arrival order.  An acceptor exception poisons ONLY that row —
+        recorded, nothing emitted, stored state untouched — and the fold
+        continues with the unchanged accepted value."""
+        node = self.node
+        n = len(order)
+        g = len(starts)
+        sel = np.zeros(g, dtype=np.int64)
+        changed = np.zeros(g, dtype=bool)
+        py_cols = [c.tolist() for c in cols]
+        prev_py = [c.tolist() for c in prev.cols]
+        val_idx = self.val_idx
+        ends = np.empty(g, dtype=np.int64)
+        ends[:-1] = starts[1:]
+        ends[-1] = n
+        for gi in range(g):
+            have = bool(has_prev[gi])
+            cur_value = None
+            if have:
+                pv = tuple(pc[prev_pos[gi]] for pc in prev_py)
+                cur_value = pv[val_idx] if val_idx is not None else pv
+            sel_i = -1
+            for p in range(int(starts[gi]), int(ends[gi])):
+                ri = int(order[p])
+                vals = tuple(pc[ri] for pc in py_cols)
+                value = vals[val_idx] if val_idx is not None else vals
+                if have:
+                    # the first value per instance is accepted without
+                    # consulting the acceptor (reference: stateful_reduce
+                    # passes None state only to the combine_fn, and the
+                    # deduplicate acceptor never sees old_value=None)
+                    try:
+                        if not bool(node.acceptor(value, cur_value)):
+                            continue
+                    except Exception as exc:
+                        record_error(exc, str(node))
+                        continue
+                have = True
+                cur_value = value
+                sel_i = ri
+            if sel_i >= 0:
+                changed[gi] = True
+                sel[gi] = sel_i
+        return sel, changed
+
+    def _process_arranged(self, b: DiffBatch) -> list[DiffBatch]:
+        if bool((b.diffs < 0).any()):
+            b = b.mask(b.diffs >= 0)  # append-only semantics
+            if not len(b):
+                return []
+        n = len(b)
+        cols = list(b.columns.values())
+        iks = ref_scalars_columns([cols[i] for i in self.inst_idx], n)
+        order = np.argsort(iks, kind="stable")
+        iks_s = iks[order]
+        boundary = np.empty(n, dtype=bool)
+        boundary[0] = True
+        boundary[1:] = iks_s[1:] != iks_s[:-1]
+        starts = np.nonzero(boundary)[0]
+        touched = iks_s[starts]  # sorted unique instance keys
+        g = len(starts)
+        prev = self.arr.probe(touched)  # one net entry per stored instance
+        has_prev = np.zeros(g, dtype=bool)
+        prev_pos = np.zeros(g, dtype=np.int64)
+        if len(prev):
+            pos = np.searchsorted(touched, prev.jk)
+            has_prev[pos] = True
+            prev_pos[pos] = np.arange(len(prev), dtype=np.int64)
+        if self.node.acceptor is None:
+            sel, changed = self._accept_vectorized(
+                cols, order, starts, prev, has_prev, prev_pos
+            )
+        else:
+            sel, changed = self._accept_acceptor(
+                cols, order, starts, prev, has_prev, prev_pos
+            )
+        if not changed.any():
+            return []
+        ch = np.nonzero(changed)[0]
+        sel_rows = sel[changed]
+        out_ik = touched[ch]
+        ret_mask = has_prev[ch]
+        nr = int(ret_mask.sum())
+        ppos = prev_pos[ch][ret_mask]
+        new_cols = [c[sel_rows] for c in cols]
+        keys_parts = [out_ik[ret_mask], out_ik] if nr else [out_ik]
+        diffs_parts = (
+            [np.full(nr, -1, dtype=np.int64), np.ones(len(ch), np.int64)]
+            if nr
+            else [np.ones(len(ch), np.int64)]
+        )
+        col_parts = [
+            ([prev.cols[i][ppos], new_cols[i]] if nr else [new_cols[i]])
+            for i in range(self.n_cols)
+        ]
+        out = DiffBatch(
+            np.concatenate(keys_parts),
+            np.concatenate(diffs_parts),
+            {
+                name: concat_columns(col_parts[i])
+                for i, name in enumerate(self.node.column_names)
+            },
+        )
+        # commit the delta into arranged state LAST (pure computation
+        # above may raise; the fallback must see pre-tick state): retract
+        # entries first so consolidation picks the insert as the value
+        d_jks = np.concatenate(keys_parts)
+        self.arr.append(
+            d_jks,
+            d_jks,  # rowkey == jk: exactly one live entry per instance
+            np.concatenate(diffs_parts),
+            [concat_columns(col_parts[i]) for i in range(self.n_cols)],
+        )
+        return [out]
+
+    # --- rowwise oracle / fallback ---------------------------------------
+
+    def _process_rowwise(self, inputs) -> list[DiffBatch]:
         out_rows = []
-        pending = getattr(self, "_restore_emit", None)
-        if pending:
-            out_rows.extend(pending)
-            self._restore_emit = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 if d < 0:
@@ -2177,10 +2610,6 @@ class DeduplicateExec(NodeExec):
                 prev_value = prev[0] if prev else None
                 accept = True
                 if self.node.acceptor is not None and prev is not None:
-                    # the first value per instance is accepted without
-                    # consulting the acceptor (reference: stateful_reduce
-                    # passes None state only to the combine_fn, and the
-                    # deduplicate acceptor never sees old_value=None)
                     try:
                         accept = bool(self.node.acceptor(value, prev_value))
                     except Exception as exc:
@@ -2197,6 +2626,29 @@ class DeduplicateExec(NodeExec):
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+
+    def process(self, t, inputs):
+        pre: list[DiffBatch] = []
+        pending = getattr(self, "_restore_emit", None)
+        if pending:
+            pre = [DiffBatch.from_rows(pending, self.node.column_names)]
+            self._restore_emit = None
+        if self._rowwise:
+            return pre + self._process_rowwise(inputs)
+        b = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
+        if not len(b):
+            return pre
+        try:
+            return pre + self._process_arranged(b)
+        except Exception:
+            import logging
+
+            logging.getLogger("pathway_tpu").exception(
+                "deduplicate columnar path failed; falling back to the "
+                "rowwise path for node %s", self.node
+            )
+            self._to_rowwise("exception")
+            return pre + self._process_rowwise(inputs)
 
 
 # ---------------------------------------------------------------------------

@@ -15,9 +15,23 @@ from __future__ import annotations
 import bisect
 from typing import Any, Callable, Sequence
 
+import numpy as np
+
+from pathway_tpu.engine.arrangement import Arrangement, Rows
 from pathway_tpu.engine.batch import DiffBatch
-from pathway_tpu.engine.nodes import Node, NodeExec, _concat_inputs
-from pathway_tpu.internals.api import Pointer, ref_scalar
+from pathway_tpu.engine.nodes import (
+    Node,
+    NodeExec,
+    _concat_inputs,
+    _fallback_counter,
+    _none_col,
+    _state_rowwise_env,
+)
+from pathway_tpu.internals.api import (
+    Pointer,
+    ref_scalar,
+    ref_scalars_columns,
+)
 from pathway_tpu.internals.errors import record_error
 
 
@@ -57,6 +71,13 @@ class SessionAssignNode(Node):
 
 
 class SessionAssignExec(NodeExec):
+    """Per-instance session buffers live in an Arrangement (jk = hashed
+    instance, cols = [time, instance]): a tick derives instance keys with
+    the C batch hasher, appends the delta, probes only the touched
+    instances and restates their groupings.  The dict path survives as
+    the differential-testing oracle (PATHWAY_STATE_ROWWISE=1) and the
+    exception escape hatch."""
+
     def __init__(self, node: SessionAssignNode):
         super().__init__(node)
         in_cols = node.inputs[0].column_names
@@ -64,11 +85,23 @@ class SessionAssignExec(NodeExec):
         self.i_idx = (
             in_cols.index(node.instance_col) if node.instance_col else None
         )
-        self.instances: dict[Any, dict[int, Any]] = {}  # inst -> {rowkey: t}
+        # rowwise oracle/fallback state: inst -> {rowkey: t}
+        self.instances: dict[Any, dict[int, Any]] = {}
+        # keyed by the INSTANCE VALUE on both paths (the arrangement keeps
+        # the instance value as a column, so the fallback can carry this
+        # map over untouched — what was emitted must never be recomputed)
         self.emitted: dict[Any, dict[int, tuple]] = {}
+        self.arr = Arrangement(2)  # cols: [time, instance value]
+        self._rowwise = False
+        self._fallback_reason: str | None = None
+        self._m_fallbacks = _fallback_counter()
+        if _state_rowwise_env():
+            self._to_rowwise("env")
 
-    def _grouped(self, inst) -> dict[int, tuple]:
-        rows = self.instances.get(inst, {})
+    # --- session grouping (shared by both paths) -------------------------
+
+    def _grouped_rows(self, rows: dict[int, Any]) -> dict[int, tuple]:
+        """rows: {rowkey: t} -> {rowkey: (window_start, window_end)}."""
         order = sorted(rows.items(), key=lambda kv: (kv[1], kv[0]))
         out: dict[int, tuple] = {}
         node = self.node
@@ -96,7 +129,163 @@ class SessionAssignExec(NodeExec):
         flush()
         return out
 
-    def process(self, t, inputs):
+    def _grouped(self, inst) -> dict[int, tuple]:
+        return self._grouped_rows(self.instances.get(inst, {}))
+
+    def _emit_diffs(self, touched_keys, new_by_key) -> list[DiffBatch]:
+        # two phases: build every diff first, mutate self.emitted only
+        # after — an exception mid-loop must not record rows as emitted
+        # that the caller then discards (the fallback retry diffs against
+        # self.emitted, so it must exactly mirror what downstream holds)
+        out_rows: list[tuple[int, int, tuple]] = []
+        for tk in touched_keys:
+            new_vals = new_by_key[tk]
+            emitted = self.emitted.get(tk, ())
+            for k in set(emitted) | set(new_vals):
+                old = emitted.get(k) if emitted else None
+                new = new_vals.get(k)
+                if old == new:
+                    continue
+                if old is not None:
+                    out_rows.append((k, -1, old))
+                if new is not None:
+                    out_rows.append((k, 1, new))
+        for tk in touched_keys:
+            self.emitted[tk] = dict(new_by_key[tk])
+        if not out_rows:
+            return []
+        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+
+    # --- fallback / oracle management -----------------------------------
+
+    def _view_by_jk(
+        self, rows: Rows
+    ) -> tuple[dict[int, dict[int, Any]], dict[int, Any]]:
+        """Probed entries -> ({jk: {rowkey: t}}, {jk: instance value})
+        (count>0 only)."""
+        view: dict[int, dict[int, Any]] = {}
+        inst_of: dict[int, Any] = {}
+        if not len(rows):
+            return view, inst_of
+        ts = rows.cols[0].tolist()
+        insts = rows.cols[1].tolist()
+        jks = rows.jk.tolist()
+        keys = rows.key.tolist()
+        counts = rows.count.tolist()
+        for i in range(len(jks)):
+            if counts[i] > 0:
+                view.setdefault(jks[i], {})[keys[i]] = ts[i]
+                inst_of[jks[i]] = insts[i]
+        return view, inst_of
+
+    def _to_rowwise(self, reason: str) -> None:
+        self._rowwise = True
+        self._fallback_reason = reason
+        self._m_fallbacks.labels(type(self).__name__, reason).inc()
+        rows = self.arr.entries()
+        if len(rows):
+            ts = rows.cols[0].tolist()
+            insts = rows.cols[1].tolist()
+            keys = rows.key.tolist()
+            counts = rows.count.tolist()
+            for i in range(len(keys)):
+                if counts[i] > 0:
+                    self.instances.setdefault(insts[i], {})[keys[i]] = ts[i]
+        # self.emitted is inst-keyed on both paths and mirrors exactly
+        # what downstream holds — carry it over UNTOUCHED (recomputing it
+        # from post-delta state would swallow the failed tick's diffs)
+        self.arr = Arrangement(2)
+
+    # --- operator snapshots ---------------------------------------------
+
+    def arranged_state(self):
+        if self._rowwise:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "arr", "instances", "emitted")
+            and not k.startswith("_m_")
+        }
+        return residual, {"arr": self.arr}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.arr = arrangements["arr"]
+        self.instances = {}
+        # emitted is derived state: recompute per stored instance
+        view, inst_of = self._view_by_jk(self.arr.entries())
+        self.emitted = {
+            inst_of[jk]: self._grouped_rows(rows)
+            for jk, rows in view.items()
+        }
+        if _state_rowwise_env():
+            self._rowwise = False  # residual was snapshotted columnar
+            self._to_rowwise("env")
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        if not self._rowwise and "arr" not in state and self.instances:
+            # legacy monolith snapshot (pre-arrangement): seed the
+            # arrangement from the restored dicts; emitted is already
+            # inst-keyed and carries over as-is
+            insts: list = []
+            keys: list = []
+            ts: list = []
+            for inst, rows in self.instances.items():
+                for k, t in rows.items():
+                    insts.append(inst)
+                    keys.append(k)
+                    ts.append(t)
+            inst_col = np.empty(len(insts), dtype=object)
+            inst_col[:] = insts
+            t_col = np.empty(len(ts), dtype=object)
+            t_col[:] = ts
+            self.arr = Arrangement(2)
+            self.arr.append(
+                ref_scalars_columns([inst_col], len(insts)),
+                np.asarray(keys, dtype=np.uint64),
+                np.ones(len(insts), dtype=np.int64),
+                [t_col, inst_col],
+            )
+            self.instances = {}
+
+    # --- columnar path ---------------------------------------------------
+
+    def _process_arranged(self, b: DiffBatch) -> list[DiffBatch]:
+        n = len(b)
+        cols = list(b.columns.values())
+        inst_col = cols[self.i_idx] if self.i_idx is not None else _none_col(n)
+        jks = ref_scalars_columns([inst_col], n)
+        tcol = cols[self.k_idx]
+        order = np.argsort(jks, kind="stable")
+        jks_s = jks[order]
+        boundary = np.empty(n, dtype=bool)
+        boundary[0] = True
+        boundary[1:] = jks_s[1:] != jks_s[:-1]
+        starts = np.nonzero(boundary)[0]
+        touched = jks_s[starts]  # sorted unique
+        # representative instance VALUE per touched jk (emission state is
+        # inst-keyed so the fallback can carry it across paths)
+        touched_inst = inst_col[order[starts]].tolist()
+        # post-delta state is all this node needs (emission diffs against
+        # self.emitted): append first, then probe the touched instances.
+        # Safe under the exception fallback: the dict apply is idempotent
+        # (insert overwrites, retract pops), so the rowwise retry
+        # re-applying this delta over the materialized post-delta state
+        # cannot double-count — and _emit_diffs defers its mutations, so
+        # self.emitted still mirrors what downstream actually received.
+        self.arr.append(jks, b.keys, b.diffs, [tcol, inst_col])
+        view, _inst_of = self._view_by_jk(self.arr.probe(touched))
+        new_by_key = {
+            inst: self._grouped_rows(view.get(int(jk), {}))
+            for jk, inst in zip(touched.tolist(), touched_inst)
+        }
+        return self._emit_diffs(list(new_by_key), new_by_key)
+
+    # --- rowwise oracle / fallback ---------------------------------------
+
+    def _process_rowwise(self, inputs) -> list[DiffBatch]:
         touched: dict[Any, None] = {}
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
@@ -107,24 +296,26 @@ class SessionAssignExec(NodeExec):
                 else:
                     rows.pop(k, None)
                 touched[inst] = None
-        out_rows: list[tuple[int, int, tuple]] = []
-        for inst in touched:
-            new_vals = self._grouped(inst)
-            emitted = self.emitted.setdefault(inst, {})
-            for k in set(emitted) | set(new_vals):
-                old = emitted.get(k)
-                new = new_vals.get(k)
-                if old == new:
-                    continue
-                if old is not None:
-                    out_rows.append((k, -1, old))
-                    del emitted[k]
-                if new is not None:
-                    out_rows.append((k, 1, new))
-                    emitted[k] = new
-        if not out_rows:
+        new_by_key = {inst: self._grouped(inst) for inst in touched}
+        return self._emit_diffs(list(touched), new_by_key)
+
+    def process(self, t, inputs):
+        if self._rowwise:
+            return self._process_rowwise(inputs)
+        b = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
+        if not len(b):
             return []
-        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+        try:
+            return self._process_arranged(b)
+        except Exception:
+            import logging
+
+            logging.getLogger("pathway_tpu").exception(
+                "session-assign columnar path failed; falling back to the "
+                "rowwise path for node %s", self.node
+            )
+            self._to_rowwise("exception")
+            return self._process_rowwise(inputs)
 
 
 # ---------------------------------------------------------------------------
@@ -132,7 +323,12 @@ class SessionAssignExec(NodeExec):
 
 
 class _TimedSide:
-    """Rows of one join side, grouped by equality key, sorted by time."""
+    """Rows of one join side, grouped by equality key, sorted by time —
+    the rowwise dict representation.  In the arranged engine it survives
+    as the differential-testing oracle's state, the exception fallback's
+    state, AND the per-tick *view* the columnar path materializes for
+    touched groups only (probe → view → apply delta → restate), so both
+    paths share one apply/sort semantics."""
 
     __slots__ = ("by_jk",)
 
@@ -167,9 +363,43 @@ class _TimedSide:
         )
 
 
+class _ArrangedSide:
+    """One side's buffered rows in a columnar Arrangement — jk = hashed
+    on-columns, rowkey = row id, cols = the side's value columns."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, n_cols: int, arr: Arrangement | None = None):
+        self.arr = arr if arr is not None else Arrangement(n_cols)
+
+    def view(self, rows: Rows) -> _TimedSide:
+        """Materialize probed entries as a dict view (touched groups
+        only) that _TimedSide.apply/sorted_rows can drive."""
+        side = _TimedSide()
+        if not len(rows):
+            return side
+        cols = [c.tolist() for c in rows.cols]
+        jks = rows.jk.tolist()
+        keys = rows.key.tolist()
+        counts = rows.count.tolist()
+        by_jk = side.by_jk
+        vals_it = zip(*cols) if cols else iter([()] * len(jks))
+        for jk, k, c, vals in zip(jks, keys, counts, vals_it):
+            by_jk.setdefault(jk, {})[k] = [None, tuple(vals), c]
+        return side
+
+
 class _TemporalJoinExecBase(NodeExec):
     """Touched-group restate: like JoinExec (nodes.py) but match rules involve
-    the time columns and unmatched rows are tracked per row, not per group."""
+    the time columns and unmatched rows are tracked per row, not per group.
+
+    State lives in per-side Arrangements: a tick derives both sides' join
+    keys with the C batch hasher, probes only the touched keys (one
+    searchsorted pair per segment), materializes those groups as a dict
+    view, overlays the delta through the SAME apply the rowwise oracle
+    uses, and restates.  The arrangement commit happens last, so the
+    exception fallback (and the PATHWAY_STATE_ROWWISE oracle) always sees
+    consistent pre-tick state."""
 
     def __init__(self, node):
         super().__init__(node)
@@ -181,13 +411,25 @@ class _TemporalJoinExecBase(NodeExec):
         self.rt_idx = rcols.index(node.right_time)
         self.n_l = len(lcols)
         self.n_r = len(rcols)
-        self.left = _TimedSide()
-        self.right = _TimedSide()
+        self._rowwise = False
+        self._fallback_reason: str | None = None
+        self._m_fallbacks = _fallback_counter()
+        if _state_rowwise_env():
+            self._rowwise = True
+            self._fallback_reason = "env"
+            self._m_fallbacks.labels(type(self).__name__, "env").inc()
+            self.left: Any = _TimedSide()
+            self.right: Any = _TimedSide()
+        else:
+            self.left = _ArrangedSide(self.n_l)
+            self.right = _ArrangedSide(self.n_r)
 
     def _jk(self, vals: tuple, idx: list[int]) -> int:
         return int(ref_scalar(*(vals[i] for i in idx)))
 
-    def _outputs_for_jk(self, jk: int) -> dict[int, tuple]:
+    def _outputs_for_jk(self, jk, lrows, rrows) -> dict[int, tuple]:
+        """Current output rows for one join key given both sides' sorted
+        row lists [(time, rowkey, vals), ...]."""
         raise NotImplementedError
 
     def _pad_left(self, lk: int, lvals: tuple) -> tuple[int, tuple]:
@@ -202,11 +444,160 @@ class _TemporalJoinExecBase(NodeExec):
         okey = int(ref_scalar(Pointer(lk), Pointer(rk)))
         return okey, lvals + rvals + (Pointer(lk), Pointer(rk))
 
-    def process(self, t, inputs):
-        lb = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
-        rb = _concat_inputs(inputs[1], self.node.inputs[1].column_names)
-        if not len(lb) and not len(rb):
+    # --- fallback / oracle management -----------------------------------
+
+    def _to_rowwise(self, reason: str) -> None:
+        self._rowwise = True
+        self._fallback_reason = reason
+        self._m_fallbacks.labels(type(self).__name__, reason).inc()
+        for attr, t_idx in (("left", self.lt_idx), ("right", self.rt_idx)):
+            arranged = getattr(self, attr)
+            side = arranged.view(arranged.arr.entries())
+            for rows in side.by_jk.values():
+                for e in rows.values():
+                    e[0] = e[1][t_idx]
+            setattr(self, attr, side)
+
+    # --- operator snapshots ---------------------------------------------
+
+    def arranged_state(self):
+        if self._rowwise:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "left", "right") and not k.startswith("_m_")
+        }
+        return residual, {"left": self.left.arr, "right": self.right.arr}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.left = _ArrangedSide(self.n_l, arrangements["left"])
+        self.right = _ArrangedSide(self.n_r, arrangements["right"])
+        if _state_rowwise_env():
+            self._rowwise = False  # residual was snapshotted columnar
+            self._to_rowwise("env")
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        if not self._rowwise and isinstance(self.left, _TimedSide):
+            # legacy monolith snapshot (pre-arrangement dict sides): seed
+            # per-side arrangements so the columnar path continues with
+            # the restored state instead of silently ignoring it
+            self.left = self._seed_side(self.left, self.n_l)
+            self.right = self._seed_side(self.right, self.n_r)
+
+    @staticmethod
+    def _seed_side(side: _TimedSide, n_cols: int) -> _ArrangedSide:
+        jks: list[int] = []
+        keys: list[int] = []
+        counts: list[int] = []
+        vals_rows: list[tuple] = []
+        for jk, rows in side.by_jk.items():
+            for k, (_t, vals, c) in rows.items():
+                jks.append(jk)
+                keys.append(k)
+                counts.append(c)
+                vals_rows.append(vals)
+        arranged = _ArrangedSide(n_cols)
+        if jks:
+            cols = []
+            for ci in range(n_cols):
+                col = np.empty(len(vals_rows), dtype=object)
+                col[:] = [v[ci] for v in vals_rows]
+                cols.append(col)
+            arranged.arr.append(
+                np.asarray(jks, dtype=np.uint64),
+                np.asarray(keys, dtype=np.uint64),
+                np.asarray(counts, dtype=np.int64),
+                cols,
+            )
+        return arranged
+
+    # --- emission (shared) ------------------------------------------------
+
+    def _emit(self, touched, before, after) -> list[DiffBatch]:
+        from pathway_tpu.engine.batch import _values_eq
+
+        out_rows: list[tuple[int, int, tuple]] = []
+        for jk in touched:
+            aft = after[jk]
+            bef = before[jk]
+            for okey, vals in bef.items():
+                new = aft.get(okey)
+                if new is None or not _values_eq(vals, new):
+                    out_rows.append((okey, -1, vals))
+            for okey, vals in aft.items():
+                old = bef.get(okey)
+                if old is None or not _values_eq(old, vals):
+                    out_rows.append((okey, 1, vals))
+        if not out_rows:
             return []
+        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+
+    # --- columnar path ---------------------------------------------------
+
+    def _batch_jks(self, b: DiffBatch, on_idx: list[int]) -> np.ndarray:
+        cols = list(b.columns.values())
+        return ref_scalars_columns([cols[i] for i in on_idx], len(b))
+
+    def _process_arranged(self, lb, rb) -> list[DiffBatch]:
+        jks_l = (
+            self._batch_jks(lb, self.l_on_idx)
+            if len(lb)
+            else np.empty(0, np.uint64)
+        )
+        jks_r = (
+            self._batch_jks(rb, self.r_on_idx)
+            if len(rb)
+            else np.empty(0, np.uint64)
+        )
+        touched_arr = np.unique(np.concatenate([jks_l, jks_r]))
+        # probe pre-tick state for the touched keys; the dict view carries
+        # times lazily (filled from the stored vals below)
+        view_l = self.left.view(self.left.arr.probe(touched_arr))
+        view_r = self.right.view(self.right.arr.probe(touched_arr))
+        for side, t_idx in ((view_l, self.lt_idx), (view_r, self.rt_idx)):
+            for rows in side.by_jk.values():
+                for e in rows.values():
+                    e[0] = e[1][t_idx]
+        touched = [int(j) for j in touched_arr.tolist()]
+        before = {
+            jk: self._outputs_for_jk(
+                jk, view_l.sorted_rows(jk), view_r.sorted_rows(jk)
+            )
+            for jk in touched
+        }
+        # overlay the delta through the oracle's own apply
+        lrows_py = list(lb.iter_rows()) if len(lb) else []
+        rrows_py = list(rb.iter_rows()) if len(rb) else []
+        for (k, d, vals), jk in zip(lrows_py, jks_l.tolist()):
+            view_l.apply(jk, k, d, vals[self.lt_idx], vals)
+        for (k, d, vals), jk in zip(rrows_py, jks_r.tolist()):
+            view_r.apply(jk, k, d, vals[self.rt_idx], vals)
+        after = {
+            jk: self._outputs_for_jk(
+                jk, view_l.sorted_rows(jk), view_r.sorted_rows(jk)
+            )
+            for jk in touched
+        }
+        out = self._emit(touched, before, after)
+        # commit the delta into arranged state only after the pure
+        # computation succeeded (the exception fallback must see pre-tick
+        # state); stage both sides before committing either
+        staged_l = self.left.arr.stage(
+            jks_l, lb.keys, lb.diffs, list(lb.columns.values())
+        ) if len(lb) else None
+        staged_r = self.right.arr.stage(
+            jks_r, rb.keys, rb.diffs, list(rb.columns.values())
+        ) if len(rb) else None
+        self.left.arr.commit(staged_l)
+        self.right.arr.commit(staged_r)
+        return out
+
+    # --- rowwise oracle / fallback ---------------------------------------
+
+    def _process_rowwise(self, lb, rb) -> list[DiffBatch]:
         touched: dict[int, None] = {}
         l_updates, r_updates = [], []
         for k, d, vals in lb.iter_rows():
@@ -217,28 +608,42 @@ class _TemporalJoinExecBase(NodeExec):
             jk = self._jk(vals, self.r_on_idx)
             touched[jk] = None
             r_updates.append((jk, k, d, vals[self.rt_idx], vals))
-        before = {jk: self._outputs_for_jk(jk) for jk in touched}
+        before = {
+            jk: self._outputs_for_jk(
+                jk, self.left.sorted_rows(jk), self.right.sorted_rows(jk)
+            )
+            for jk in touched
+        }
         for jk, k, d, time, vals in l_updates:
             self.left.apply(jk, k, d, time, vals)
         for jk, k, d, time, vals in r_updates:
             self.right.apply(jk, k, d, time, vals)
-        from pathway_tpu.engine.batch import _values_eq
+        after = {
+            jk: self._outputs_for_jk(
+                jk, self.left.sorted_rows(jk), self.right.sorted_rows(jk)
+            )
+            for jk in touched
+        }
+        return self._emit(touched, before, after)
 
-        out_rows: list[tuple[int, int, tuple]] = []
-        for jk in touched:
-            after = self._outputs_for_jk(jk)
-            bef = before[jk]
-            for okey, vals in bef.items():
-                new = after.get(okey)
-                if new is None or not _values_eq(vals, new):
-                    out_rows.append((okey, -1, vals))
-            for okey, vals in after.items():
-                old = bef.get(okey)
-                if old is None or not _values_eq(old, vals):
-                    out_rows.append((okey, 1, vals))
-        if not out_rows:
+    def process(self, t, inputs):
+        lb = _concat_inputs(inputs[0], self.node.inputs[0].column_names)
+        rb = _concat_inputs(inputs[1], self.node.inputs[1].column_names)
+        if not len(lb) and not len(rb):
             return []
-        return [DiffBatch.from_rows(out_rows, self.node.column_names)]
+        if self._rowwise:
+            return self._process_rowwise(lb, rb)
+        try:
+            return self._process_arranged(lb, rb)
+        except Exception:
+            import logging
+
+            logging.getLogger("pathway_tpu").exception(
+                "temporal-join columnar path failed; falling back to the "
+                "rowwise path for node %s", self.node
+            )
+            self._to_rowwise("exception")
+            return self._process_rowwise(lb, rb)
 
 
 def _join_out_cols(left: Node, right: Node) -> list[str]:
@@ -284,10 +689,8 @@ class IntervalJoinNode(Node):
 
 
 class IntervalJoinExec(_TemporalJoinExecBase):
-    def _outputs_for_jk(self, jk: int) -> dict[int, tuple]:
+    def _outputs_for_jk(self, jk, lrows, rrows) -> dict[int, tuple]:
         node = self.node
-        lrows = self.left.sorted_rows(jk)
-        rrows = self.right.sorted_rows(jk)
         out: dict[int, tuple] = {}
         r_times = [r[0] for r in rrows]
         matched_right: set[int] = set()
@@ -406,11 +809,9 @@ def _asof_pick(
 
 
 class AsofJoinExec(_TemporalJoinExecBase):
-    def _outputs_for_jk(self, jk: int) -> dict[int, tuple]:
+    def _outputs_for_jk(self, jk, lrows, rrows) -> dict[int, tuple]:
         node = self.node
         out: dict[int, tuple] = {}
-        lrows = self.left.sorted_rows(jk)
-        rrows = self.right.sorted_rows(jk)
         l_times = [r[0] for r in lrows]
         r_times = [r[0] for r in rrows]
         # output keys mix the side into the hash — a left row and a right row

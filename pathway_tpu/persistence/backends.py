@@ -21,10 +21,17 @@ class BackendStore:
     def get(self, key: str) -> bytes | None:
         raise NotImplementedError
 
-    def list_keys(self, prefix: str = "") -> list[str]:
-        raise NotImplementedError
+    def get_buffer(self, key: str) -> memoryview | None:
+        """Read-only buffer view of a blob — stores that can, serve it
+        zero-copy (the filesystem store mmaps, so segment recovery is
+        O(page faults), not O(read+copy)); the default materializes."""
+        data = self.get(key)
+        return None if data is None else memoryview(data)
 
     def remove(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> list[str]:
         raise NotImplementedError
 
 
@@ -60,6 +67,22 @@ class FilesystemStore(BackendStore):
                 return f.read()
         except OSError:
             return None
+
+    def get_buffer(self, key: str) -> memoryview | None:
+        """mmap the blob read-only: arrays built over this view fault
+        pages in lazily, which is what makes segment recovery
+        O(mmap + manifest) instead of O(state bytes)."""
+        import mmap as _mmap
+
+        try:
+            with open(self._path(key), "rb") as f:
+                try:
+                    mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+                except ValueError:  # empty file cannot be mapped
+                    return memoryview(f.read())
+        except OSError:
+            return None
+        return memoryview(mm)
 
     def list_keys(self, prefix: str = "") -> list[str]:
         out = []

@@ -389,6 +389,263 @@ def test_two_process_wordcount_kill_restart(tmp_path):
     assert merged == expected
 
 
+_DCN_MATRIX_WORKER = textwrap.dedent(
+    """
+    import os, sys, json, time, pathlib, threading
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pid = int(os.environ["PATHWAY_PROCESS_ID"])
+    base = pathlib.Path(os.environ["PW_TEST_DIR"])
+    in_dir = base / f"in{pid}"
+    pdir = base / f"pstorage{pid}"
+    out_file = base / f"out{pid}_{os.environ['PW_PHASE']}.jsonl"
+    stop_file = base / "STOP"
+    die_after = int(os.environ.get("PW_DIE_AFTER_ROWS", "0"))
+    pipeline = os.environ["PW_PIPELINE"]
+
+    class S(pw.Schema):
+        k: str
+        t: int
+        v: int
+
+    # the kill trigger counts BOTH processes' outputs: row ownership is
+    # hash-routed, so any single process may legitimately own zero rows
+    phase_outs = [
+        base / f"out{p}_{os.environ['PW_PHASE']}.jsonl" for p in range(2)
+    ]
+
+    rows = pw.io.jsonlines.read(str(in_dir), schema=S, mode="streaming")
+    if pipeline == "groupby_sum":
+        r = rows.groupby(rows.k).reduce(
+            rows.k,
+            s=pw.reducers.sum(rows.v),
+            mx=pw.reducers.max(rows.v),
+            cnt=pw.reducers.count(),
+        )
+    elif pipeline == "windowby":
+        r = rows.windowby(
+            rows.t,
+            window=pw.temporal.tumbling(duration=4),
+            instance=rows.k,
+            behavior=pw.temporal.common_behavior(
+                delay=2, cutoff=100, keep_results=True
+            ),
+        ).reduce(
+            k=pw.this._pw_instance,
+            start=pw.this._pw_window_start,
+            cnt=pw.reducers.count(),
+            s=pw.reducers.sum(pw.this.v),
+        )
+    else:
+        raise SystemExit(f"unknown pipeline {pipeline}")
+    pw.io.jsonlines.write(r, str(out_file))
+
+    def watch():
+        while True:
+            time.sleep(0.05)
+            n = 0
+            for p in phase_outs:
+                try:
+                    n += sum(1 for _ in open(p))
+                except OSError:
+                    pass
+            if die_after and n >= die_after:
+                os._exit(17)
+            if stop_file.exists():
+                rt = pw.internals.parse_graph.G.runtime
+                if rt is not None:
+                    rt.stop()
+                return
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir)),
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+    print("CLEAN-EXIT", flush=True)
+    """
+)
+
+
+def _fold_keyed(paths, key_fields):
+    state: dict = {}
+    for p in paths:
+        try:
+            lines = open(p).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            o = json.loads(line)
+            key = tuple(o[f] for f in key_fields)
+            val = tuple(
+                v
+                for f, v in sorted(o.items())
+                if f not in ("diff", "time", "id", *key_fields)
+            )
+            if o["diff"] > 0:
+                state[key] = val
+            elif state.get(key) == val:
+                del state[key]
+    return state
+
+
+def _run_matrix_kill_restart(tmp_path, pipeline, key_fields, expected, live_expected=None):
+    """Shared 2-process kill/restart driver: phase 1 kills pid 1
+    mid-stream (pid 0 fail-stops at the next barrier), phase 2 restarts
+    the whole group from persistence and must converge on the exact
+    merged state of an uninterrupted run."""
+    base = tmp_path / "work"
+    for pid in range(2):
+        (base / f"in{pid}").mkdir(parents=True)
+    script = tmp_path / "worker.py"
+    script.write_text(_DCN_MATRIX_WORKER)
+    port = _free_dcn_port()
+
+    def write_rows(pid, fname, rows):
+        with open(base / f"in{pid}" / fname, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def phase(n, extra):
+        return _spawn_group(
+            script,
+            2,
+            port,
+            extra_env=lambda pid: {
+                "PW_TEST_DIR": str(base),
+                "PW_PIPELINE": pipeline,
+                **extra(pid),
+            },
+            timeout=120,
+        )
+
+    yield write_rows
+
+    procs, outs = phase(
+        1,
+        lambda pid: {
+            "PW_PHASE": "1",
+            **({"PW_DIE_AFTER_ROWS": "2"} if pid == 1 else {}),
+        },
+    )
+    assert procs[1].returncode == 17, outs[1][-2000:]
+    assert procs[0].returncode != 0, outs[0][-2000:]
+
+    yield write_rows
+
+    import threading
+
+    all_outs = [
+        base / f"out{pid}_{ph}.jsonl" for pid in range(2) for ph in (1, 2)
+    ]
+    target = live_expected if live_expected is not None else expected
+
+    def stopper():
+        deadline = time.time() + 70
+        while time.time() < deadline:
+            merged = _fold_keyed(all_outs, key_fields)
+            if live_expected is not None:
+                merged = {
+                    k: v for k, v in merged.items() if k in live_expected
+                }
+            if merged == target:
+                break
+            time.sleep(0.2)
+        (base / "STOP").touch()
+
+    st = threading.Thread(target=stopper, daemon=True)
+    st.start()
+    procs2, outs2 = phase(2, lambda pid: {"PW_PHASE": "2"})
+    st.join(timeout=90)
+    for pid, (p, out) in enumerate(zip(procs2, outs2)):
+        assert p.returncode == 0, f"phase2 pid={pid}:\n{out[-3000:]}"
+        assert "CLEAN-EXIT" in out
+    assert _fold_keyed(all_outs, key_fields) == expected
+
+
+def test_two_process_groupby_sum_kill_restart(tmp_path):
+    """Kill/restart matrix, 2-process groupby with sum/max reducers: a
+    mid-stream kill + full-group restart recovers from the persisted
+    snapshots and the merged totals are exact."""
+    rows1 = {
+        0: [
+            {"k": "x", "t": 0, "v": 3},
+            {"k": "y", "t": 1, "v": 5},
+            {"k": "x", "t": 2, "v": 4},
+        ],
+        1: [
+            {"k": "y", "t": 0, "v": 2},
+            {"k": "z", "t": 1, "v": 7},
+            {"k": "x", "t": 2, "v": 1},
+        ],
+    }
+    rows2 = {
+        0: [{"k": "z", "t": 3, "v": 10}],
+        1: [{"k": "x", "t": 3, "v": 6}],
+    }
+    # (cnt, mx, s) per key over ALL rows
+    expected = {
+        ("x",): (4, 6, 14),
+        ("y",): (2, 5, 7),
+        ("z",): (2, 10, 17),
+    }
+    gen = _run_matrix_kill_restart(
+        tmp_path, "groupby_sum", ["k"], expected
+    )
+    write_rows = next(gen)
+    for pid, rows in rows1.items():
+        write_rows(pid, "f1.jsonl", rows)
+    write_rows = next(gen)
+    for pid, rows in rows2.items():
+        write_rows(pid, "f2.jsonl", rows)
+    for _ in gen:
+        pass
+
+
+def test_two_process_windowby_behavior_kill_restart(tmp_path):
+    """Kill/restart matrix, 2-process windowby + common_behavior: the
+    Buffer/Forget watermark state and window aggregates survive a
+    mid-stream kill + group restart; merged final windows match the full
+    input's window aggregation exactly."""
+    rows1 = {
+        0: [{"k": "a", "t": t, "v": t} for t in (0, 1, 3, 5, 6)],
+        1: [{"k": "b", "t": t, "v": 2 * t} for t in (2, 4, 7)],
+    }
+    # phase 2 ends with high sentinel times on both processes so every
+    # earlier window crosses the delay threshold group-wide
+    rows2 = {
+        0: [{"k": "a", "t": 9, "v": 9}, {"k": "a", "t": 40, "v": 0}],
+        1: [{"k": "b", "t": 11, "v": 22}, {"k": "b", "t": 41, "v": 0}],
+    }
+    expected = {
+        ("a", 0): (3, 4),
+        ("a", 4): (2, 11),
+        ("a", 8): (1, 9),
+        ("a", 40): (1, 0),
+        ("b", 0): (1, 4),
+        ("b", 4): (2, 22),
+        ("b", 8): (1, 22),
+        ("b", 40): (1, 0),
+    }
+    # sentinel windows flush only on clean shutdown; converge on the rest
+    live_expected = {k: v for k, v in expected.items() if k[1] < 40}
+    gen = _run_matrix_kill_restart(
+        tmp_path, "windowby", ["k", "start"], expected, live_expected
+    )
+    write_rows = next(gen)
+    for pid, rows in rows1.items():
+        write_rows(pid, "f1.jsonl", rows)
+    write_rows = next(gen)
+    for pid, rows in rows2.items():
+        write_rows(pid, "f2.jsonl", rows)
+    for _ in gen:
+        pass
+
+
 _DCN_JOIN = textwrap.dedent(
     """
     import os, json
