@@ -1654,6 +1654,297 @@ def _bench_rag_rest_load(np, on_accel):
     return out
 
 
+_CHAOS_WORKER = """
+import os, sys, json, time, pathlib, threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+import pathway_tpu as pw
+
+pid = int(os.environ["PATHWAY_PROCESS_ID"])
+inc = os.environ.get("PATHWAY_MESH_INCARNATION", "0")
+base = pathlib.Path(os.environ["PW_BENCH_DIR"])
+in_dir = base / ("in%d" % pid)
+pdir = base / ("pstorage%d" % pid)
+out_file = base / ("out%d_inc%s.jsonl" % (pid, inc))
+stop_file = base / "STOP"
+
+class S(pw.Schema):
+    k: str
+    v: int
+
+rows = pw.io.jsonlines.read(str(in_dir), schema=S, mode="streaming")
+r = rows.groupby(rows.k).reduce(
+    rows.k, s=pw.reducers.sum(rows.v), cnt=pw.reducers.count()
+)
+pw.io.jsonlines.write(r, str(out_file))
+
+def watch():
+    while True:
+        time.sleep(0.05)
+        if stop_file.exists():
+            rt = pw.internals.parse_graph.G.runtime
+            if rt is not None:
+                rt.stop()
+            return
+
+threading.Thread(target=watch, daemon=True).start()
+cfg = pw.persistence.Config.simple_config(
+    pw.persistence.Backend.filesystem(str(pdir)), snapshot_every=2
+)
+pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+drv = getattr(pw.internals.parse_graph.G.runtime, "persistence_driver", None)
+print("REPLAYED %d" % (drv.replayed_events if drv else -1), flush=True)
+print("CLEAN-EXIT", flush=True)
+"""
+
+
+def _bench_chaos_recovery(np):
+    """Chaos/recovery tier (Phoenix Mesh): a supervised 2-process DCN
+    group with a Fault-Forge-injected mid-run kill. Reports (a)
+    recovery-to-fresh seconds — injected death to the merged output
+    matching the uninterrupted run's exact totals, (b) events replayed
+    on restart, and (c) a Surge-Gate degraded-serving leg: admitted
+    reads during a recovery window answer stale (never error), with
+    fresh/stale/shed/error counts."""
+    import pathlib
+    import secrets
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.testing.chaos import fold_diff_stream, free_dcn_port
+
+    n_files, rows_per_file = 8, 4
+
+    def all_rows(pid):
+        return [
+            {"k": "k%d" % ((i + j + pid) % 5), "v": i * 10 + j}
+            for i in range(n_files)
+            for j in range(rows_per_file)
+        ]
+
+    # fold_diff_stream keys by tuple and values by the remaining fields
+    # sorted by name — for the worker's (k, cnt, s) schema: (cnt, s)
+    expected: dict = {}
+    for pid in range(2):
+        for r in all_rows(pid):
+            cnt, s = expected.get((r["k"],), (0, 0))
+            expected[(r["k"],)] = (cnt + 1, s + r["v"])
+
+    def fold(paths):
+        return fold_diff_stream(paths, ["k"])
+
+    def run_group(faults: str | None):
+        base = pathlib.Path(tempfile.mkdtemp(prefix="pw-chaos-"))
+        try:
+            for pid in range(2):
+                (base / ("in%d" % pid)).mkdir(parents=True)
+            script = base / "worker.py"
+            script.write_text(_CHAOS_WORKER)
+            port = free_dcn_port()
+            env = {
+                "PW_BENCH_DIR": str(base),
+                "PATHWAY_DCN_PORT": str(port),
+                "PATHWAY_DCN_SECRET": secrets.token_hex(16),
+                "PATHWAY_DCN_TIMEOUT": "60",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+            }
+            if faults:
+                env["PATHWAY_FAULTS"] = faults
+
+            def trickle():
+                # first batch lands before boot; the rest wait for the
+                # group's first output (slow worker boot would otherwise
+                # collapse the pile into one tick) and then arrive
+                # spaced out so incarnation 0 sees several data ticks
+                def write_file(i):
+                    for pid in range(2):
+                        rows = all_rows(pid)[
+                            i * rows_per_file : (i + 1) * rows_per_file
+                        ]
+                        with open(
+                            base / ("in%d" % pid) / ("f%d.jsonl" % i), "w"
+                        ) as f:
+                            for r in rows:
+                                f.write(json.dumps(r) + "\n")
+
+                write_file(0)
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline:
+                    if any(
+                        p.stat().st_size > 0
+                        for p in base.glob("out*_inc0.jsonl")
+                    ):
+                        break
+                    time.sleep(0.2)
+                for i in range(1, n_files):
+                    write_file(i)
+                    time.sleep(0.4)
+
+            match_at: list[float] = []
+
+            def stopper():
+                deadline = time.monotonic() + 180
+                while time.monotonic() < deadline:
+                    if (
+                        fold(sorted(base.glob("out*_inc*.jsonl")))
+                        == expected
+                    ):
+                        match_at.append(time.monotonic())
+                        break
+                    time.sleep(0.1)
+                (base / "STOP").touch()
+
+            sup = GroupSupervisor(
+                [sys.executable, str(script)],
+                2,
+                env=env,
+                max_restarts=2,
+                backoff_s=0.1,
+                log_dir=str(base / "logs"),
+            )
+            tr = threading.Thread(target=trickle, daemon=True)
+            st = threading.Thread(target=stopper, daemon=True)
+            t0 = time.monotonic()
+            tr.start()
+            st.start()
+            rc = sup.run()
+            st.join(timeout=200)
+            tr.join(timeout=10)
+            wall = time.monotonic() - t0
+            replayed = 0
+            for p in (base / "logs").glob("*-inc1.log"):
+                for line in p.read_text().splitlines():
+                    if line.startswith("REPLAYED "):
+                        replayed += max(0, int(line.split()[1]))
+            died_at = next(
+                (ts for ts, kind, _d in sup.events if kind == "rank-died"),
+                None,
+            )
+            restarted_at = next(
+                (
+                    ts
+                    for ts, kind, _d in sup.events
+                    if kind == "group-start" and "incarnation 1" in _d
+                ),
+                None,
+            )
+            return {
+                "rc": rc,
+                "wall_s": round(wall, 2),
+                "converged": bool(match_at),
+                "restarts": sup.restarts_used,
+                "replayed_events": replayed,
+                "recovery_to_fresh_s": (
+                    round(match_at[0] - died_at, 2)
+                    if match_at and died_at is not None
+                    else None
+                ),
+                "detect_to_respawn_s": (
+                    round(restarted_at - died_at, 2)
+                    if restarted_at is not None and died_at is not None
+                    else None
+                ),
+            }
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+
+    out: dict = {}
+    baseline = run_group(None)
+    out["baseline"] = {
+        k: baseline[k] for k in ("rc", "wall_s", "converged")
+    }
+    chaos = run_group("kill=tick:4,pid:1,at:tail")
+    out["chaos"] = chaos
+
+    # --- degraded-serving leg (single process, in-process) ---------------
+    import requests
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.http import rest_connector
+    from pathway_tpu.serving import QoSConfig, degrade, drain_all
+
+    degrade.reset()
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    queries, writer = rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=QuerySchema,
+        route="/read",
+        qos=QoSConfig(max_batch_size=8, max_wait_ms=5),
+    )
+    writer(queries.select(query_id=queries.id, result=queries.text))
+    run_t = threading.Thread(target=pw.run, daemon=True)
+    run_t.start()
+    url = "http://127.0.0.1:%d/read" % port
+    counts = {"fresh": 0, "stale_served": 0, "shed": 0, "error_served": 0}
+    stale_window_s = 0.8
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if requests.post(
+                    url, json={"text": "up"}, timeout=5
+                ).status_code == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        degrade.register_stale_responder(
+            "/read", lambda vals: {"stale": vals.get("text")}
+        )
+        n_reqs, flip_at = 60, 20
+
+        for i in range(n_reqs):
+            if i == flip_at:
+                degrade.enter_recovery("chaos bench window")
+                degrade.mark_fresh()
+                flipped = time.monotonic()
+            if (
+                degrade.recovering() is not None
+                and time.monotonic() - flipped > stale_window_s
+            ):
+                degrade.exit_recovery("chaos bench window")
+            try:
+                r = requests.post(url, json={"text": "q%d" % i}, timeout=15)
+            except Exception:
+                counts["error_served"] += 1
+                continue
+            if r.status_code == 200:
+                if r.headers.get("x-pathway-stale") == "true":
+                    counts["stale_served"] += 1
+                else:
+                    counts["fresh"] += 1
+            elif r.status_code in (429, 503):
+                counts["shed"] += 1
+            else:
+                counts["error_served"] += 1
+            time.sleep(0.03)
+    finally:
+        degrade.reset()
+        drain_all()
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+        run_t.join(timeout=30)
+    out["serving"] = {
+        "requests": 60,
+        "stale_window_s": stale_window_s,
+        **counts,
+    }
+    return out
+
+
 def main() -> None:
     import numpy as np
 
@@ -1789,6 +2080,14 @@ def main() -> None:
         extra["dcn_exchange"] = _bench_dcn_exchange(np)
     except Exception as e:
         errors.append(f"dcn-exchange:{type(e).__name__}:{e}")
+
+    try:
+        # chaos/recovery tier: supervised 2-process group + injected
+        # mid-run kill (Phoenix Mesh) — recovery-to-fresh seconds,
+        # replayed events, degraded-serving stale/error counts
+        extra["chaos_recovery"] = _bench_chaos_recovery(np)
+    except Exception as e:
+        errors.append(f"chaos-recovery:{type(e).__name__}:{e}")
 
     try:
         extra["rag_e2e_qps"] = round(_bench_rag_qps(np, on_accel), 1)
@@ -1941,5 +2240,18 @@ if __name__ == "__main__":
         import numpy as _np
 
         print(json.dumps(_bench_checkpoint_recovery(_np), indent=2))
+    elif sys.argv[1:] == ["chaos_recovery"]:
+        # standalone tier run; also records the CHAOS_rNN.json artifact
+        import numpy as _np
+
+        _chaos = _bench_chaos_recovery(_np)
+        _doc = {"tier": "chaos_recovery", **_chaos}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "CHAOS_r08.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
     else:
         main()

@@ -440,6 +440,51 @@ def serving_admission(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# 5b. recoverability (Phoenix Mesh)
+
+
+@rule("unrecoverable-state")
+def unrecoverable_state(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Stateful nodes whose snapshots cannot participate in group
+    recovery: a node fed (transitively) by BOTH a transient fixture and
+    a persisted connector disables operator snapshots for the whole
+    graph (persistence/_runtime_glue.py mixed-dependency guard), so a
+    kill/restart must replay the FULL input log — recovery time grows
+    with history instead of churn, and the Phoenix Mesh supervisor's
+    restart budget buys much less."""
+    tainted: set[int] = set()
+    logged: set[int] = set()
+    for node in facts.order:
+        if isinstance(node, InputNode):
+            if getattr(node.source, "transient", False):
+                tainted.add(node.id)
+            else:
+                logged.add(node.id)
+            continue
+        if any(inp.id in tainted for inp in node.inputs):
+            tainted.add(node.id)
+        if any(inp.id in logged for inp in node.inputs):
+            logged.add(node.id)
+    for node in facts.order:
+        if not getattr(node, "is_stateful", False):
+            continue
+        if node.id in tainted and node.id in logged:
+            yield Diagnostic(
+                "unrecoverable-state",
+                Severity.INFO,
+                "this stateful node mixes transient fixture input with a "
+                "persisted connector: operator snapshots are disabled for "
+                "the whole graph, so group recovery (Phoenix Mesh "
+                "supervisor restart) replays the full input log instead "
+                "of restoring the latest committed snapshot generation",
+                node,
+                fix_hint="feed the node from persisted connectors only, "
+                "or give the fixture a persistent source (pw.io.*) so "
+                "snapshots stay enabled",
+            )
+
+
+# ---------------------------------------------------------------------------
 # 6. join vectorization
 
 _ROWWISE_JOINS = (IntervalJoinNode, AsofJoinNode, AsofNowJoinNode)

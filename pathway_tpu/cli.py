@@ -51,6 +51,29 @@ def _spawn(args, extra: list[str]) -> int:
         return 2
     if extra and extra[0] == "--":
         extra = extra[1:]
+    if getattr(args, "supervise", False):
+        # Phoenix Mesh: run the group under the restart supervisor — a
+        # dead rank tears the group down and the whole group respawns
+        # from the latest group-committed snapshot generation, up to
+        # PATHWAY_MESH_MAX_RESTARTS times (parallel/supervisor.py)
+        from pathway_tpu.parallel.supervisor import GroupSupervisor
+
+        env_base.setdefault(
+            "JAX_COORDINATOR_ADDRESS", f"127.0.0.1:{args.first_port}"
+        )
+        env_base.setdefault("JAX_NUM_PROCESSES", str(n))
+
+        def rank_env(pid: int) -> dict:
+            return {"JAX_PROCESS_ID": str(pid)}
+
+        sup = GroupSupervisor(
+            extra,
+            n,
+            env=env_base,
+            rank_env=rank_env,
+            max_restarts=args.max_restarts,
+        )
+        return sup.run()
     procs = []
     for pid in range(n):
         env = dict(env_base)
@@ -80,6 +103,13 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--processes", "-n", type=int, default=1)
     sp.add_argument("--threads", "-t", type=int, default=1)
     sp.add_argument("--first-port", type=int, default=10000)
+    sp.add_argument(
+        "--supervise",
+        action="store_true",
+        help="restart the whole group on rank failure (Phoenix Mesh), "
+        "up to --max-restarts times",
+    )
+    sp.add_argument("--max-restarts", type=int, default=None)
     sp.add_argument("--record", action="store_true")
     sp.add_argument("--record-path", default="./record")
     sp.add_argument(

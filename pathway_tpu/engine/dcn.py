@@ -116,7 +116,59 @@ class _DcnRouter:
         return [got[p] for p in sorted(got)]
 
 
-class DcnGroupByExec(NodeExec):
+class _InnerArrangedMixin:
+    """Delegates the incremental-snapshot protocol (PR-7 State Ledger)
+    to the wrapped inner exec, so DCN-wrapped operators get
+    arrangement-backed segment snapshots instead of pickling their inner
+    state monolithically through the wrapper's ``state_dict``.  The
+    wrapper's own cross-process bookkeeping (e.g. the origin tracker)
+    rides in the residual under reserved keys; the arrangements pass
+    through untouched, keeping segment identity (and so bytes ∝ churn)
+    stable across the wrapper boundary."""
+
+    def _wrapper_residual(self) -> dict:
+        return {}
+
+    def _load_wrapper_residual(self, extra: dict) -> None:
+        pass
+
+    def enable_state_ledger(self) -> None:
+        """The persistence driver arms ledger-keeping execs before any
+        tick runs; forward the arming through the wrapper so a
+        DCN-wrapped GroupBy keeps its ledger too."""
+        hook = getattr(self.inner, "enable_state_ledger", None)
+        if hook is not None:
+            hook()
+
+    def arranged_state(self):
+        inner_fn = getattr(self.inner, "arranged_state", None)
+        arranged = inner_fn() if inner_fn is not None else None
+        if arranged is None:
+            return None  # inner snapshots monolithically (state_dict)
+        residual, arrs = arranged
+        return (
+            {
+                "__dcn_inner__": residual,
+                "__dcn_extra__": self._wrapper_residual(),
+            },
+            arrs,
+        )
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        if "__dcn_inner__" in residual:
+            self._load_wrapper_residual(residual.get("__dcn_extra__", {}))
+            self.inner.load_arranged_state(
+                residual["__dcn_inner__"], arrangements
+            )
+        else:
+            # a snapshot written single-process then restored under DCN
+            # cannot occur (the group restores its own per-process
+            # stores), but a bare-residual blob still belongs to the
+            # inner exec — never to the wrapper
+            self.inner.load_arranged_state(residual, arrangements)
+
+
+class DcnGroupByExec(_InnerArrangedMixin, NodeExec):
     """groupby-reduce whose keyed state spans processes: rows go to the
     process owning their group key; the local exec (possibly device-mesh
     sharded) reduces its disjoint range (reference: group_by_table after
@@ -181,7 +233,7 @@ class DcnGroupByExec(NodeExec):
             self.inner.load_state(state["inner"])
 
 
-class DcnJoinExec(NodeExec):
+class DcnJoinExec(_InnerArrangedMixin, NodeExec):
     """Equijoin whose build/probe state spans processes: both sides route
     by join-key hash so matches co-locate (reference: join_tables
     arrange+join_core after Exchange, src/engine/dataflow.rs:2740)."""
@@ -261,7 +313,7 @@ class DcnJoinExec(NodeExec):
 _U64 = 0xFFFFFFFFFFFFFFFF
 
 
-class _DcnStatefulExec(NodeExec):
+class _DcnStatefulExec(_InnerArrangedMixin, NodeExec):
     """Shared plumbing: build the node's local exec, route each input per
     its spec, feed the merged partitions through. Output rows are emitted
     on the process owning their key — per-process outputs union to the
@@ -426,7 +478,7 @@ class DcnDeduplicateExec(_DcnStatefulExec):
         return shard_of(ks, self.n)
 
 
-class _DcnReturnHomeExec(NodeExec):
+class _DcnReturnHomeExec(_InnerArrangedMixin, NodeExec):
     """Base for ops whose OUTPUT universe preserves input row keys while
     their state needs exchanged inputs: inputs route per `dest_for`, every
     arrival records its feeding process in an _OriginTracker, and output
@@ -476,6 +528,15 @@ class _DcnReturnHomeExec(NodeExec):
         # runs after the lockstep cadence ends — no exchange possible; the
         # wrapped ops emit nothing new on flush
         return self.inner.on_end()
+
+    # the wrapper's origin tracker is keyed state too: it rides in the
+    # arranged residual (small — one entry per live row key fed from a
+    # FOREIGN process, which upstream sharding keeps rare)
+    def _wrapper_residual(self) -> dict:
+        return {"origin": self.origins.state_dict()}
+
+    def _load_wrapper_residual(self, extra: dict) -> None:
+        self.origins.load_state(extra.get("origin", {}))
 
     def state_dict(self):
         return {
@@ -584,7 +645,7 @@ class DcnIterateExec(_DcnReturnHomeExec):
         return np.zeros(len(b), dtype=np.int32)
 
 
-class DcnWatermarkExec(NodeExec):
+class DcnWatermarkExec(_InnerArrangedMixin, NodeExec):
     """Buffer/Forget/Freeze: per-row state needs no co-location (a row and
     its retraction always arrive on the same process), but the release
     watermark — max over the current-time column — is GLOBAL. Every tick

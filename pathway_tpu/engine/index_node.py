@@ -101,6 +101,13 @@ class ExternalIndexExec(NodeExec):
         # live queries (for full `query` mode re-answers) / emitted replies
         self.live_queries: dict[int, tuple] = {}
         self.emitted: dict[int, tuple] = {}
+        # Phoenix degradation: this exec's corpus is the "last hydrated
+        # index snapshot" degraded serving answers from — register it
+        # (weakly) and keep the staleness clock fresh per tick
+        from pathway_tpu.serving import degrade as _degrade
+
+        self._degrade = _degrade
+        _degrade.register_index_reader(self)
 
     def state_dict(self) -> dict:
         # indexes holding device arrays expose their own host-side snapshot;
@@ -163,20 +170,30 @@ class ExternalIndexExec(NodeExec):
     def process(self, t, inputs):
         node = self.node
         data_changed = False
-        for b in inputs[0]:
-            for k, d, vals in b.iter_rows():
-                data_changed = True
-                self._m_updates.inc()
-                if d > 0:
-                    meta = (
-                        vals[self.d_meta] if self.d_meta is not None else None
-                    )
-                    try:
-                        self.index.upsert(k, vals[self.d_data], meta)
-                    except Exception as exc:
-                        record_error(exc, str(node))
-                else:
-                    self.index.remove(k)
+        # corpus mutation races a concurrent degraded-mode stale search
+        # (replay ticks rebuild state while the REST handler reads it):
+        # the shared guard serializes them. Uncontended cost is one
+        # RLock acquire per tick.
+        with self._degrade.index_guard:
+            for b in inputs[0]:
+                for k, d, vals in b.iter_rows():
+                    data_changed = True
+                    self._m_updates.inc()
+                    if d > 0:
+                        meta = (
+                            vals[self.d_meta]
+                            if self.d_meta is not None
+                            else None
+                        )
+                        try:
+                            self.index.upsert(k, vals[self.d_data], meta)
+                        except Exception as exc:
+                            record_error(exc, str(node))
+                    else:
+                        self.index.remove(k)
+        # the engine is ticking this node: whatever the corpus now holds
+        # is as fresh as the stream — restart the staleness clock
+        self._degrade.mark_fresh()
         # Surge Gate deadline propagation: queries whose REST deadline
         # already expired answer empty WITHOUT a device search — the
         # client got its 504, so the top-k would burn a batch slot for a

@@ -2892,7 +2892,35 @@ class BufferNode(Node):
         return self._make_local_exec()
 
 
+def _watermark_ledger_append(arr: Arrangement, ops) -> None:
+    """Append per-row state transitions to a watermark exec's
+    persistence ledger.  ``ops`` are (flag, row_key, diff, vals): the
+    flag is the arrangement's join key (0 = held/live, 1 = released), so
+    the two lifecycle states of one row key consolidate independently;
+    the row's values ride in a single object column."""
+    if not ops:
+        return
+    n = len(ops)
+    jks = np.fromiter((f for f, _k, _d, _v in ops), dtype=np.uint64, count=n)
+    keys = np.fromiter(
+        (k & 0xFFFFFFFFFFFFFFFF for _f, k, _d, _v in ops),
+        dtype=np.uint64,
+        count=n,
+    )
+    diffs = np.fromiter((d for _f, _k, d, _v in ops), dtype=np.int64, count=n)
+    vcol = np.empty(n, dtype=object)
+    vcol[:] = [v for _f, _k, _d, v in ops]
+    arr.append(jks, keys, diffs, [vcol])
+
+
 class BufferExec(NodeExec):
+    """Dict compute state + an arrangement-backed persistence ledger
+    (PR-7 State Ledger protocol): every held/released transition mirrors
+    into ``self.ledger`` as an append-only delta, so snapshots write
+    bytes ∝ churn instead of pickling the whole buffer.
+    ``PATHWAY_STATE_ROWWISE=1`` disables the ledger — the monolithic
+    pickle is the differential oracle."""
+
     def __init__(self, node: BufferNode):
         super().__init__(node)
         in_cols = node.inputs[0].column_names
@@ -2901,10 +2929,66 @@ class BufferExec(NodeExec):
         self.held: dict[int, list] = {}  # key -> [threshold, vals, count]
         self.released: set[int] = set()
         self.max_seen: Any = None
+        self._ledger_on = not _state_rowwise_env()
+        self.ledger = Arrangement(1)  # jk: 0 = held, 1 = released
+
+    # --- persistence ledger ----------------------------------------------
+
+    def arranged_state(self):
+        if not self._ledger_on:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "held", "released", "ledger")
+            and not k.startswith("_m_")
+        }
+        return residual, {"ledger": self.ledger}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.ledger = arrangements["ledger"]
+        self.held = {}
+        self.released = set()
+        rows = self.ledger.entries()
+        if len(rows):
+            vals_l = rows.cols[0].tolist()
+            jks = rows.jk.tolist()
+            keys = rows.key.tolist()
+            counts = rows.count.tolist()
+            for i in range(len(keys)):
+                if counts[i] == 0:
+                    continue
+                if jks[i] == 0:
+                    vals = vals_l[i]
+                    self.held[keys[i]] = [
+                        vals[self.thr_idx], vals, counts[i],
+                    ]
+                else:
+                    self.released.add(keys[i])
+        if _state_rowwise_env():
+            self._ledger_on = False
+            self.ledger = Arrangement(1)
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        # legacy (pre-ledger) monolith snapshot: seed the ledger so the
+        # next incremental snapshot covers the restored state
+        if (
+            self._ledger_on
+            and len(self.ledger) == 0
+            and (self.held or self.released)
+        ):
+            ops = [
+                (0, k, c, vals) for k, (_thr, vals, c) in self.held.items()
+            ]
+            ops += [(1, k, 1, ()) for k in self.released]
+            _watermark_ledger_append(self.ledger, ops)
 
     def process(self, t, inputs):
         out_rows = []
         batch_max = None
+        ops: list = []  # ledger mirror of every held/released transition
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
                 cur = vals[self.cur_idx]
@@ -2914,13 +2998,19 @@ class BufferExec(NodeExec):
                     out_rows.append((k, d, vals))
                     if d < 0:
                         self.released.discard(k)
+                        ops.append((1, k, -1, vals))
                     continue
                 if d > 0:
                     thr = vals[self.thr_idx]
+                    prev = self.held.get(k)
+                    if prev is not None:
+                        ops.append((0, k, -prev[2], prev[1]))
                     self.held[k] = [thr, vals, d]
+                    ops.append((0, k, d, vals))
                 else:
                     if k in self.held:
-                        del self.held[k]
+                        prev = self.held.pop(k)
+                        ops.append((0, k, -prev[2], prev[1]))
                     else:
                         out_rows.append((k, d, vals))
         # release is IMMEDIATE within a tick (a row whose threshold the
@@ -2943,6 +3033,10 @@ class BufferExec(NodeExec):
                 thr, vals, c = self.held.pop(k)
                 out_rows.append((k, c, vals))
                 self.released.add(k)
+                ops.append((0, k, -c, vals))
+                ops.append((1, k, 1, vals))
+        if self._ledger_on:
+            _watermark_ledger_append(self.ledger, ops)
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]
@@ -2951,10 +3045,15 @@ class BufferExec(NodeExec):
         if not self.node.flush_on_end:
             return []
         out_rows = []
+        ops: list = []
         for k, (thr, vals, c) in self.held.items():
             out_rows.append((k, c, vals))
             self.released.add(k)
+            ops.append((0, k, -c, vals))
+            ops.append((1, k, 1, vals))
         self.held.clear()
+        if self._ledger_on:
+            _watermark_ledger_append(self.ledger, ops)
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]
@@ -2996,6 +3095,10 @@ class ForgetNode(Node):
 
 
 class ForgetExec(NodeExec):
+    """Same State-Ledger mirroring as BufferExec: the live-row dict is
+    compute state, ``self.ledger`` is its append-only persistence mirror
+    (single jk 0 — rows have one lifecycle state here)."""
+
     def __init__(self, node: ForgetNode):
         super().__init__(node)
         in_cols = node.inputs[0].column_names
@@ -3004,9 +3107,47 @@ class ForgetExec(NodeExec):
         self.live: dict[int, list] = {}
         self.max_seen: Any = None
         self._scanned_at: Any = None  # watermark value at the last scan
+        self._ledger_on = not _state_rowwise_env()
+        self.ledger = Arrangement(1)
+
+    def arranged_state(self):
+        if not self._ledger_on:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("node", "live", "ledger") and not k.startswith("_m_")
+        }
+        return residual, {"ledger": self.ledger}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.ledger = arrangements["ledger"]
+        self.live = {}
+        rows = self.ledger.entries()
+        if len(rows):
+            vals_l = rows.cols[0].tolist()
+            keys = rows.key.tolist()
+            counts = rows.count.tolist()
+            for i in range(len(keys)):
+                if counts[i] > 0:
+                    vals = vals_l[i]
+                    self.live[keys[i]] = [vals[self.thr_idx], vals]
+        if _state_rowwise_env():
+            self._ledger_on = False
+            self.ledger = Arrangement(1)
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        if self._ledger_on and len(self.ledger) == 0 and self.live:
+            _watermark_ledger_append(
+                self.ledger,
+                [(0, k, 1, vals) for k, (_thr, vals) in self.live.items()],
+            )
 
     def process(self, t, inputs):
         out_rows = []
+        ops: list = []
         # Forgetting is DATA-driven, lagged one tick: rows stale against
         # the watermark of STRICTLY EARLIER ticks retract when new data
         # (or an externally advanced DCN watermark) arrives — never at the
@@ -3033,6 +3174,7 @@ class ForgetExec(NodeExec):
             for k in stale:
                 thr, vals = self.live.pop(k)
                 out_rows.append((k, -1, vals))
+                ops.append((0, k, -1, vals))
         batch_max = None
         for b in inputs[0]:
             for k, d, vals in b.iter_rows():
@@ -3041,14 +3183,22 @@ class ForgetExec(NodeExec):
                     batch_max = cur
                 out_rows.append((k, d, vals))
                 if d > 0:
+                    prev = self.live.get(k)
+                    if prev is not None:
+                        ops.append((0, k, -1, prev[1]))
                     self.live[k] = [vals[self.thr_idx], vals]
+                    ops.append((0, k, 1, vals))
                 else:
-                    self.live.pop(k, None)
+                    prev = self.live.pop(k, None)
+                    if prev is not None:
+                        ops.append((0, k, -1, prev[1]))
         if batch_max is not None and (
             self.max_seen is None or batch_max > self.max_seen
         ):
             self.max_seen = batch_max
         self._scanned_at = self.max_seen
+        if self._ledger_on:
+            _watermark_ledger_append(self.ledger, ops)
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]

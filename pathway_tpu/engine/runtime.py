@@ -327,6 +327,11 @@ class Runtime:
         # group's barrier cadence.
         from pathway_tpu.parallel.host_exchange import dcn_active
 
+        # created BEFORE the failure listener below can fire: the mesh
+        # replays already-detected failures synchronously at
+        # registration, and _on_peer_failure sets this event
+        self._wake = threading.Event()
+        self._stop = threading.Event()
         self.dcn = dcn_active() if distributed is None else (
             distributed and dcn_active()
         )
@@ -335,6 +340,13 @@ class Runtime:
             from pathway_tpu.parallel.host_exchange import get_host_mesh
 
             self.host_mesh = get_host_mesh()
+            # Phoenix Mesh: learn about a dead peer at DETECTION time
+            # (reader EOF, send failure, liveness timeout) instead of
+            # inside the next gather — serving flips to stale reads and
+            # the streaming loop wakes immediately so the pending
+            # barrier surfaces the HostMeshError without waiting out an
+            # autocommit interval
+            self.host_mesh.add_failure_listener(self._on_peer_failure)
             # EVERY stateful operator type has a cross-process exchange
             # wrapper (engine/dcn.py), mirroring the reference's universal
             # Exchange pact — groupby/join partition by key, dedup by
@@ -350,8 +362,6 @@ class Runtime:
         }
         self.autocommit_ms = autocommit_ms
         self.on_tick = on_tick
-        self._wake = threading.Event()
-        self._stop = threading.Event()
         self.current_time = 0
         self._tick_count = 0
         self.stats = RuntimeStats()
@@ -398,6 +408,11 @@ class Runtime:
         self._tracer = get_tracer()
         self._tick_traceparent: str | None = None  # lockstep: set per round
         self.http_server = None  # set by start_http_server when attached
+        # Fault Forge (chaos testing): None unless PATHWAY_FAULTS is set,
+        # so the per-tick cost is one attribute check
+        from pathway_tpu.testing import faults
+
+        self._fault_plan = faults.active()
         # intra-tick worker parallelism (reference: PATHWAY_THREADS timely
         # workers, src/engine/dataflow/config.rs:63-86): independent nodes
         # of one topo level process concurrently on a thread pool. Each
@@ -439,6 +454,30 @@ class Runtime:
                     max_workers=min(n_threads, 16),
                     thread_name_prefix="pathway-worker",
                 )
+
+    def _on_peer_failure(self, peer: int, reason: str) -> None:
+        """FailureListener (called from mesh internal threads): the
+        surviving group drains its in-flight tick — completed ticks are
+        already durably committed per tick — and exits for a supervised
+        whole-group restart from the latest group-committed snapshot
+        generation. While that happens, the Surge Gate serves stale."""
+        if not getattr(self, "_phoenix_active", True):
+            # this run already finished: a peer exiting after a clean
+            # group shutdown is the normal end of the job, not a
+            # failure to recover from
+            return
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "runtime: peer %d failed (%s); draining for supervised "
+            "group restart",
+            peer,
+            reason,
+        )
+        from pathway_tpu.serving import degrade
+
+        degrade.enter_recovery(f"peer {peer} failed: {reason}")
+        self._wake.set()
 
     # --- core tick ------------------------------------------------------------
 
@@ -523,6 +562,8 @@ class Runtime:
         self.current_time = t
         produced: dict[int, list[DiffBatch]] = {}
         final = t >= END_OF_TIME
+        if self._fault_plan is not None and not final:
+            self._fault_plan.on_tick(t, "head")
         stats = self.stats
         tick_start = _time.perf_counter_ns()
         if self._pool is not None and self._levels is not None:
@@ -573,6 +614,11 @@ class Runtime:
         stats.current_time = t if not final else stats.current_time
         stats.last_tick_ns = _time.perf_counter_ns() - tick_start
         self._tick_count += 1
+        if self._fault_plan is not None and not final:
+            # "tail" kills land AFTER this tick's node processing but
+            # BEFORE the persistence driver commits it — the group-
+            # visible mid-tick death the chaos matrix exercises
+            self._fault_plan.on_tick(t, "tail")
         if self.engine_mesh is not None and not final:
             self.global_frontier = self._frontier_consensus(t)
         if self.on_tick is not None:
@@ -818,12 +864,16 @@ class Runtime:
             and isinstance(node.source, StreamingSource)
             for node in self.order
         )
+        self._phoenix_active = True
         try:
             if has_streaming:
                 self.run_streaming()
             else:
                 self.run_static()
         finally:
+            # peers exiting after this point are a clean group shutdown,
+            # not a failure (the mesh singleton outlives the run)
+            self._phoenix_active = False
             if self._pool is not None:
                 self._pool.shutdown(wait=True, cancel_futures=True)
                 self._pool = None

@@ -885,7 +885,18 @@ class AsofNowJoinNode(Node):
         return AsofNowJoinExec(self)
 
 
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
 class AsofNowJoinExec(NodeExec):
+    """Dict compute state + arrangement-backed persistence ledgers (the
+    PR-7 State Ledger protocol): the right side's buffered rows and the
+    per-query emitted rows mirror into two Arrangements as append-only
+    deltas, so snapshots write bytes ∝ churn and recovery mmap-rebuilds
+    instead of unpickling a monolith.  ``PATHWAY_STATE_ROWWISE=1``
+    disables the ledgers — the monolithic ``state_dict`` pickle is the
+    differential oracle for the ledger path."""
+
     def __init__(self, node: AsofNowJoinNode):
         super().__init__(node)
         lcols = node.inputs[0].column_names
@@ -897,10 +908,150 @@ class AsofNowJoinExec(NodeExec):
         self.right: dict[int, dict[int, list]] = {}
         # what each left row key emitted: lk -> list[(okey, vals)]
         self.emitted_by_left: dict[int, list[tuple[int, tuple]]] = {}
+        self._ledger_on = not _state_rowwise_env()
+        # ledger arrangements (persistence only, never probed on the hot
+        # path): right rows keyed (hashed on-cols, row key), emissions
+        # keyed (left row key, output key) with exact ints in the cols
+        self.arr_right = Arrangement(self.n_r)
+        self.arr_emit = Arrangement(3)  # cols: [lk, okey, vals tuple]
+
+    # --- persistence ledger ----------------------------------------------
+
+    def _emit_ledger_ops(
+        self,
+        ops: list[tuple[int, int, int, tuple]],  # (lk, okey, diff, vals)
+    ) -> None:
+        if not ops or not self._ledger_on:
+            return
+        n = len(ops)
+        jks = np.fromiter(
+            (lk & _U64 for lk, _o, _d, _v in ops), dtype=np.uint64, count=n
+        )
+        keys = np.fromiter(
+            (o & _U64 for _lk, o, _d, _v in ops), dtype=np.uint64, count=n
+        )
+        diffs = np.fromiter(
+            (d for _lk, _o, d, _v in ops), dtype=np.int64, count=n
+        )
+        lk_col = np.empty(n, dtype=object)
+        lk_col[:] = [lk for lk, _o, _d, _v in ops]
+        ok_col = np.empty(n, dtype=object)
+        ok_col[:] = [o for _lk, o, _d, _v in ops]
+        val_col = np.empty(n, dtype=object)
+        val_col[:] = [v for _lk, _o, _d, v in ops]
+        self.arr_emit.append(jks, keys, diffs, [lk_col, ok_col, val_col])
+
+    def arranged_state(self):
+        if not self._ledger_on:
+            return None
+        residual = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k
+            not in ("node", "right", "emitted_by_left", "arr_right", "arr_emit")
+            and not k.startswith("_m_")
+        }
+        return residual, {"right": self.arr_right, "emit": self.arr_emit}
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        self.__dict__.update(residual)
+        self.arr_right = arrangements["right"]
+        self.arr_emit = arrangements["emit"]
+        # rebuild the dict compute state; jks recomputed from the stored
+        # values with the compute path's own hash, so signedness of the
+        # arrangement grouping key never leaks into lookups
+        self.right = {}
+        rows = self.arr_right.entries()
+        if len(rows):
+            cols = [c.tolist() for c in rows.cols]
+            keys = rows.key.tolist()
+            counts = rows.count.tolist()
+            for i in range(len(keys)):
+                if counts[i] == 0:
+                    continue
+                vals = tuple(c[i] for c in cols)
+                jk = int(ref_scalar(*(vals[j] for j in self.r_on_idx)))
+                self.right.setdefault(jk, {})[keys[i]] = [vals, counts[i]]
+        self.emitted_by_left = {}
+        rows = self.arr_emit.entries()
+        if len(rows):
+            lks = rows.cols[0].tolist()
+            okeys = rows.cols[1].tolist()
+            vals_l = rows.cols[2].tolist()
+            counts = rows.count.tolist()
+            for i in range(len(lks)):
+                if counts[i] > 0:
+                    self.emitted_by_left.setdefault(int(lks[i]), []).append(
+                        (int(okeys[i]), vals_l[i])
+                    )
+        if _state_rowwise_env():
+            # env oracle: drop the ledgers, snapshot monolithically
+            self._ledger_on = False
+            self.arr_right = Arrangement(self.n_r)
+            self.arr_emit = Arrangement(3)
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        # legacy (pre-ledger) monolith snapshot: seed the ledgers from
+        # the restored dicts so the next incremental snapshot covers the
+        # preexisting state instead of silently dropping it
+        if (
+            self._ledger_on
+            and getattr(self, "arr_right", None) is not None
+            and len(self.arr_right) == 0
+            and (self.right or self.emitted_by_left)
+        ):
+            r_ops: list[tuple[int, int, tuple]] = []
+            for _jk, rows in self.right.items():
+                for k, (vals, c) in rows.items():
+                    r_ops.append((k, c, vals))
+            if r_ops:
+                n = len(r_ops)
+                jks = np.fromiter(
+                    (
+                        int(ref_scalar(*(v[i] for i in self.r_on_idx)))
+                        & _U64
+                        for _k, _c, v in r_ops
+                    ),
+                    dtype=np.uint64,
+                    count=n,
+                )
+                keys = np.fromiter(
+                    (k & _U64 for k, _c, _v in r_ops),
+                    dtype=np.uint64,
+                    count=n,
+                )
+                diffs = np.fromiter(
+                    (c for _k, c, _v in r_ops), dtype=np.int64, count=n
+                )
+                cols = []
+                for ci in range(self.n_r):
+                    col = np.empty(n, dtype=object)
+                    col[:] = [v[ci] for _k, _c, v in r_ops]
+                    cols.append(col)
+                self.arr_right.append(jks, keys, diffs, cols)
+            e_ops = [
+                (lk, okey, 1, vals)
+                for lk, emitted in self.emitted_by_left.items()
+                for okey, vals in emitted
+            ]
+            self._emit_ledger_ops(e_ops)
 
     def process(self, t, inputs):
         # right updates first: queries arriving at tick T see right state of T
         for b in inputs[1]:
+            n = len(b)
+            if n and self._ledger_on:
+                # the right ledger IS the input delta: append verbatim
+                cols = list(b.columns.values())
+                self.arr_right.append(
+                    ref_scalars_columns(
+                        [cols[i] for i in self.r_on_idx], n
+                    ),
+                    b.keys,
+                    b.diffs,
+                    cols,
+                )
             for k, d, vals in b.iter_rows():
                 jk = int(ref_scalar(*(vals[i] for i in self.r_on_idx)))
                 rows = self.right.setdefault(jk, {})
@@ -917,16 +1068,22 @@ class AsofNowJoinExec(NodeExec):
                 if not rows:
                     self.right.pop(jk, None)
         out_rows: list[tuple[int, int, tuple]] = []
+        ledger_ops: list[tuple[int, int, int, tuple]] = []
         for b in inputs[0]:
             for lk, d, lvals in b.iter_rows():
                 if d < 0:
                     for okey, vals in self.emitted_by_left.pop(lk, []):
                         out_rows.append((okey, -1, vals))
+                        ledger_ops.append((lk, okey, -1, vals))
                     continue
                 jk = int(ref_scalar(*(lvals[i] for i in self.l_on_idx)))
                 rrows = self.right.get(jk, {})
                 emitted: list[tuple[int, tuple]] = []
                 use_lk = self.node.id_from == "left"
+                # a re-insert replaces this query's previous emissions in
+                # the dict — mirror the replacement into the ledger
+                for okey, vals in self.emitted_by_left.get(lk, ()):
+                    ledger_ops.append((lk, okey, -1, vals))
                 if use_lk and len(rrows) > 1:
                     # id=left.id promises ONE output row per query row; two
                     # matches would silently collapse under the same key.
@@ -958,7 +1115,9 @@ class AsofNowJoinExec(NodeExec):
                     emitted.append((lk, vals))
                 for okey, vals in emitted:
                     out_rows.append((okey, 1, vals))
+                    ledger_ops.append((lk, okey, 1, vals))
                 self.emitted_by_left[lk] = emitted
+        self._emit_ledger_ops(ledger_ops)
         if not out_rows:
             return []
         return [DiffBatch.from_rows(out_rows, self.node.column_names)]

@@ -375,7 +375,7 @@ class RestServerSubject(ConnectorSubject):
         vals = self._vals(coerced)
         assert self._session is not None
         if self._gate is not None:
-            return await self._handle_gated(request, key, vals)
+            return await self._handle_gated(request, key, vals, coerced)
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         with self._futures_lock:
@@ -416,17 +416,34 @@ class RestServerSubject(ConnectorSubject):
         return _time.monotonic() + budget_ms / 1000.0
 
     async def _handle_gated(
-        self, request: web.Request, key: int, vals: tuple
+        self,
+        request: web.Request,
+        key: int,
+        vals: tuple,
+        values: dict | None = None,
     ) -> web.Response:
         """Surge Gate serving path: admission → EDF queue → micro-batch
-        dispatch → engine tick → response, with explicit shedding."""
+        dispatch → engine tick → response, with explicit shedding.
+
+        Phoenix degradation: while the engine is recovering (peer
+        failure / restore replay), reads are answered from the last
+        hydrated index snapshot via the route's registered stale
+        responder instead of queueing behind a tick loop that is not
+        running — with explicit staleness headers and the
+        ``x-pathway-max-staleness-ms`` bound honored."""
         from pathway_tpu.observability import tracing
         from pathway_tpu.serving import (
             DeadlineExceeded,
             PendingRequest,
             ShedError,
         )
+        from pathway_tpu.serving import degrade
 
+        reason = degrade.recovering()
+        if reason is not None:
+            return await self._handle_stale(
+                request, values if values is not None else {}, reason
+            )
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         dispatched: asyncio.Future = loop.create_future()
@@ -507,6 +524,64 @@ class RestServerSubject(ConnectorSubject):
                     except Exception:
                         pass
         return web.json_response(result)
+
+    async def _handle_stale(
+        self, request: web.Request, values: dict, reason: str
+    ) -> web.Response:
+        """Answer a read from the last hydrated snapshot while the
+        engine recovers. No responder registered → explicit 503 (never
+        hang a request on a tick loop that is not ticking)."""
+        from pathway_tpu.serving import degrade
+
+        staleness = degrade.staleness_seconds()
+        stale_hdrs = {
+            "x-pathway-stale": "true",
+            "x-pathway-staleness-seconds": (
+                f"{staleness:.3f}" if staleness is not None else "unknown"
+            ),
+        }
+        responder = degrade.stale_responder(self._route)
+        if responder is None:
+            degrade.count_degraded_shed(self._route, "no_responder")
+            return web.json_response(
+                {"error": f"engine recovering: {reason}"},
+                status=503,
+                headers={"Retry-After": "1.0", **stale_hdrs},
+            )
+        max_raw = request.headers.get("x-pathway-max-staleness-ms")
+        if max_raw is not None:
+            import math
+
+            try:
+                bound_ms = float(max_raw)
+            except ValueError:
+                bound_ms = None
+            if bound_ms is not None and math.isfinite(bound_ms):
+                if staleness is None or staleness * 1000.0 > bound_ms:
+                    degrade.count_degraded_shed(
+                        self._route, "max_staleness"
+                    )
+                    return web.json_response(
+                        {
+                            "error": "snapshot staler than "
+                            "x-pathway-max-staleness-ms while the "
+                            f"engine recovers: {reason}"
+                        },
+                        status=503,
+                        headers={"Retry-After": "1.0", **stale_hdrs},
+                    )
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(None, responder, values)
+        except Exception:
+            degrade.count_degraded_shed(self._route, "responder_error")
+            return web.json_response(
+                {"error": f"stale read failed while recovering: {reason}"},
+                status=503,
+                headers={"Retry-After": "1.0", **stale_hdrs},
+            )
+        degrade.count_stale_served(self._route)
+        return web.json_response(result, headers=stale_hdrs)
 
     def _deliver(self, key: int, payload: Any) -> None:
         if self._gate is not None:

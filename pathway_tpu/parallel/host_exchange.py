@@ -24,11 +24,22 @@ outbox back-pressures the producer instead of buffering unboundedly.
 A send failure fail-stops exactly like a dead reader: the peer is
 marked dead and the next gather/barrier raises HostMeshError.
 
-Fail-stop: a dead peer surfaces as HostMeshError at the next gather or
-barrier; the job exits nonzero and the supervisor restarts the whole
-process group from persisted state — exactly the reference's recovery
-model (whole-cluster restart from the persisted frontier,
-src/persistence/state.rs:291).
+Failure model (Phoenix Mesh): peer death is DETECTED, not merely
+stumbled into. Every connection carries periodic heartbeat frames
+(PATHWAY_MESH_HEARTBEAT_MS); a liveness monitor marks a peer dead when
+nothing — data, barrier or heartbeat — has arrived within
+PATHWAY_MESH_LIVENESS_TIMEOUT_MS (socket EOF and send failures mark it
+dead immediately). Registered ``FailureListener`` callbacks fire the
+moment a peer is declared dead, so the runtime (and the serving
+degradation controller) learn about the failure instead of discovering
+it inside a gather; any pending gather/barrier then raises
+HostMeshError naming the dead peer and the recorded cause. The process
+exits nonzero, and the group supervisor (parallel/supervisor.py)
+restarts the WHOLE group, which restores the latest group-committed
+snapshot generation (persistence/_runtime_glue.py) — the reference's
+recovery model (whole-cluster restart from the persisted frontier,
+src/persistence/state.rs:291), now with bounded detection latency and a
+bounded restart budget (PATHWAY_MESH_MAX_RESTARTS).
 
 Authentication: frames carry pickled payloads, which execute code on
 load, so the mesh authenticates under a per-job shared secret
@@ -64,12 +75,15 @@ from pathway_tpu.observability.tracing import (
 )
 from pathway_tpu.parallel import wire
 
-_HELLO_MAGIC = b"PWHX6"  # protocol version tag (networking.rs handshake
-# analog); v6 switches frame bodies to the tagged columnar wire codec
-# (parallel/wire.py — a leading 'C'/'P' byte self-describes each frame,
-# so codec and pickle frames interoperate inside one connection); v5
-# appended the W3C traceparent slot that stitches traces across
-# processes (Trace Weaver, observability/tracing.py)
+_HELLO_MAGIC = b"PWHX7"  # protocol version tag (networking.rs handshake
+# analog); v7 adds per-peer heartbeat control frames ("hb") and the
+# failure-listener liveness contract (Phoenix Mesh) — a v6 peer would
+# treat heartbeats as unknown frames, so the version bump fails fast via
+# the established PWVN reject; v6 switched frame bodies to the tagged
+# columnar wire codec (parallel/wire.py — a leading 'C'/'P' byte
+# self-describes each frame, so codec and pickle frames interoperate
+# inside one connection); v5 appended the W3C traceparent slot that
+# stitches traces across processes (Trace Weaver)
 _MAC_LEN = 32  # HMAC-SHA256
 _NONCE_LEN = 32
 _OK_TAG = b"PWOK"  # acceptor's authenticated handshake acknowledgment
@@ -257,6 +271,40 @@ class HostMesh:
         self.last_barrier_tps: dict[int, str | None] = {}
         self._round = 0
         self._dead: set[int] = set()
+        # peer pid -> human-readable cause recorded when the peer was
+        # declared dead (EOF, send failure, liveness timeout) — surfaced
+        # in every subsequent HostMeshError so the supervisor log names
+        # the root cause, not just the gather that tripped over it
+        self._dead_reason: dict[int, str] = {}
+        # Phoenix Mesh: failure listeners fire (peer, reason) the moment
+        # a peer is declared dead — the runtime and the serving
+        # degradation controller subscribe so recovery starts at
+        # detection time, not at the next gather
+        self._failure_listeners: list = []
+        # liveness: last monotonic instant ANY frame (data/bar/hb)
+        # arrived from each peer; heartbeats keep this fresh on idle
+        # connections so the monitor can tell "slow tick" from "dead or
+        # wedged peer"
+        _hb_ms = float(
+            os.environ.get("PATHWAY_MESH_HEARTBEAT_MS", "1000") or 1000
+        )
+        # floor at 50 ms: a zero/tiny interval would busy-spin the
+        # heartbeat thread and flood every outbox (disable monitoring
+        # with PATHWAY_MESH_LIVENESS_TIMEOUT_MS=0, not by zeroing the
+        # send interval)
+        self.heartbeat_s = max(_hb_ms, 50.0) / 1000.0
+        # generous default: a long GIL-holding jit compile on the peer
+        # can starve its heartbeat thread for seconds — the timeout must
+        # catch dead/wedged peers, not slow ones (0 disables monitoring;
+        # socket EOF and send failures still detect clean deaths fast)
+        self.liveness_timeout_s = (
+            float(
+                os.environ.get("PATHWAY_MESH_LIVENESS_TIMEOUT_MS", "30000")
+                or 30000
+            )
+            / 1000.0
+        )
+        self._last_heard: dict[int, float] = {}
         # peer pid -> its PWHX magic, recorded when a peer running a
         # DIFFERENT protocol version dials us with a valid job-secret
         # MAC (a genuinely old build cannot understand our PWVN reject,
@@ -279,7 +327,7 @@ class HostMesh:
         self._listener.listen(n)
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
-        deadline = time.time() + connect_timeout
+        deadline = time.monotonic() + connect_timeout
         for peer in range(n):
             if peer == pid:
                 continue
@@ -295,12 +343,34 @@ class HostMesh:
             )
             self._senders[peer] = th
             th.start()
+        # the liveness clock starts once the full mesh is dialed — a
+        # peer that was reachable at startup but never speaks again is
+        # exactly what the monitor exists to catch
+        now = time.monotonic()
+        for peer in range(n):
+            if peer != pid:
+                self._last_heard[peer] = now
+        # the heartbeat SENDER always runs (peers with monitoring on
+        # must keep hearing us even when our own timeout is 0 =
+        # monitoring disabled); only the timeout CHECK is conditional
+        threading.Thread(
+            target=self._heartbeat_loop,
+            daemon=True,
+            name=f"pw-dcn-heartbeat-{pid}",
+        ).start()
 
     # --- wiring -----------------------------------------------------------
 
     def _dial(self, peer: int, deadline: float) -> socket.socket:
+        """Dial one peer until the MONOTONIC deadline (wall-clock jumps
+        must neither expire nor extend connection budgets) with jittered
+        exponential backoff between attempts — a whole group restarting
+        at once must not hammer a still-booting peer in lockstep."""
+        import random as _random
+
         last_err: Exception | None = None
-        while time.time() < deadline:
+        attempt = 0
+        while time.monotonic() < deadline:
             skew = self._version_skew.get(peer)
             if skew is not None:
                 raise HostMeshError(
@@ -376,7 +446,12 @@ class HostMesh:
                         s.close()
                     except OSError:
                         pass
-                time.sleep(0.1)
+                attempt += 1
+                backoff = min(2.0, 0.05 * (2**min(attempt, 6)))
+                delay = backoff * (0.5 + _random.random())
+                delay = min(delay, max(0.0, deadline - time.monotonic()))
+                if delay > 0:
+                    time.sleep(delay)
         raise HostMeshError(
             f"process {self.pid}: could not reach peer {peer} at "
             f"{self.host}:{self.base_port + peer} ({last_err})"
@@ -490,6 +565,7 @@ class HostMesh:
                 ):
                     break  # forged/reflected/replayed frame: drop the link
                 recv_seq += 1
+                self._last_heard[src] = time.monotonic()
                 self._m_recv_bytes.labels(str(src)).inc(len(head) + len(body))
                 self._m_recv_msgs.labels(str(src)).inc()
                 t0 = time.perf_counter()
@@ -500,6 +576,8 @@ class HostMesh:
                     else dec_pickle
                 ).observe(time.perf_counter() - t0)
                 kind = frame[0]
+                if kind == "hb":
+                    continue  # liveness already refreshed above
                 with self._cv:
                     if kind == "data":
                         _k, fsrc, channel, tick, payload, tp = frame
@@ -526,9 +604,92 @@ class HostMesh:
         finally:
             conn.close()
             if src >= 0:
-                with self._cv:
-                    self._dead.add(src)
-                    self._cv.notify_all()
+                self._mark_dead(
+                    src, "connection closed (peer EOF or corrupt frame)"
+                )
+
+    # --- liveness (Phoenix Mesh) ------------------------------------------
+
+    def add_failure_listener(self, fn) -> None:
+        """Register ``fn(peer: int, reason: str)``, fired once per peer
+        the moment it is declared dead (EOF, send failure, or liveness
+        timeout). Fired from mesh internal threads — listeners must be
+        quick and must not call back into the mesh."""
+        with self._cv:
+            self._failure_listeners.append(fn)
+            already = [
+                (p, self._dead_reason.get(p, "unknown")) for p in self._dead
+            ]
+        # a listener registered after a failure still learns about it
+        for peer, reason in already:
+            try:
+                fn(peer, reason)
+            except Exception:
+                pass
+
+    def _mark_dead(self, peer: int, reason: str) -> None:
+        with self._cv:
+            if peer in self._dead:
+                return
+            self._dead.add(peer)
+            self._dead_reason.setdefault(peer, reason)
+            # a peer going away while WE are tearing down is the normal
+            # end of a clean run, not a failure: keep the dead-set
+            # bookkeeping (stray gathers must still error) but skip the
+            # alarm and the recovery listeners
+            listeners = (
+                [] if self._closed else list(self._failure_listeners)
+            )
+            self._cv.notify_all()
+        if self._closed:
+            return
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "host mesh: process %d declared peer %d dead (%s)",
+            self.pid,
+            peer,
+            reason,
+        )
+        for fn in listeners:
+            try:
+                fn(peer, reason)
+            except Exception:
+                logging.getLogger("pathway_tpu").exception(
+                    "host mesh failure listener raised"
+                )
+
+    def _heartbeat_loop(self) -> None:
+        """Send a heartbeat frame to every live peer each interval and
+        declare peers dead when nothing has arrived within the liveness
+        timeout. Heartbeats ride the normal outbox (so they share the
+        MAC sequence) but never block: a full outbox means data frames
+        are flowing, which is liveness enough."""
+        while not self._closed:
+            time.sleep(self.heartbeat_s)
+            if self._closed:
+                return
+            now = time.monotonic()
+            for peer, q in self._outbox.items():
+                if peer in self._dead:
+                    continue
+                try:
+                    q.put_nowait(("hb", self.pid))
+                except queue.Full:
+                    pass
+                if self.liveness_timeout_s <= 0:
+                    continue  # monitoring disabled; keep sending
+                heard = self._last_heard.get(peer)
+                if (
+                    heard is not None
+                    and now - heard > self.liveness_timeout_s
+                ):
+                    self._mark_dead(
+                        peer,
+                        f"liveness timeout: no frames for "
+                        f"{now - heard:.1f}s "
+                        f"(> {self.liveness_timeout_s:.1f}s)",
+                    )
 
     # --- send/recv --------------------------------------------------------
 
@@ -561,9 +722,12 @@ class HostMesh:
         thread, so wire work overlaps the next channel's partitioning
         and compute. Owns the connection's MAC sequence counter (frames
         leave in enqueue order, so the receiver's recv_seq matches)."""
+        from pathway_tpu.testing import faults
+
         q = self._outbox[dst]
         sock = self._out[dst]
         seq = 0
+        plan = faults.active()
         # bind label children once: the per-frame path pays attribute
         # loads, not registry lock + dict lookups
         enc_codec = self._m_encode_seconds.labels("codec")
@@ -575,6 +739,18 @@ class HostMesh:
             if frame is self._STOP:
                 return
             try:
+                repeats = 1
+                if plan is not None:
+                    kind = frame[0]
+                    channel = frame[2] if kind == "data" else kind
+                    action = plan.on_wire_send(str(channel))
+                    if action is not None:
+                        if action[0] == "drop":
+                            continue
+                        if action[0] == "dup":
+                            repeats = 2
+                        elif action[0] == "delay":
+                            time.sleep(action[1])
                 t0 = time.perf_counter()
                 body, stats = wire.encode_frame(
                     frame, self.wire_format, self.wire_quant
@@ -586,17 +762,16 @@ class HostMesh:
                     self._m_ratio.labels(frame[2]).set(
                         stats["raw_bytes"] / max(len(body) - 1, 1)
                     )
-                mac = _frame_mac(self._key, self.pid, dst, seq, body)
-                seq += 1
-                msg = struct.pack("<I", len(body)) + mac + body
-                sock.sendall(msg)
-                sent_bytes.inc(len(msg))
-                sent_msgs.inc()
+                for _ in range(repeats):
+                    mac = _frame_mac(self._key, self.pid, dst, seq, body)
+                    seq += 1
+                    msg = struct.pack("<I", len(body)) + mac + body
+                    sock.sendall(msg)
+                    sent_bytes.inc(len(msg))
+                    sent_msgs.inc()
             except Exception as e:  # OSError or an encode bug: fail-stop
                 self._send_failed[dst] = e
-                with self._cv:
-                    self._dead.add(dst)
-                    self._cv.notify_all()
+                self._mark_dead(dst, f"send failed: {e}")
                 # unblock producers stuck on the (now doomed) outbox
                 try:
                     while True:
@@ -606,12 +781,22 @@ class HostMesh:
                 return
 
     def _dead_detail(self, pids) -> str:
-        notes = [
-            f"peer {p} send failed: {self._send_failed[p]}"
-            for p in sorted(pids)
-            if p in self._send_failed
-        ]
+        notes = []
+        for p in sorted(pids):
+            if p in self._send_failed:
+                notes.append(f"peer {p} send failed: {self._send_failed[p]}")
+            elif p in self._dead_reason:
+                notes.append(f"peer {p}: {self._dead_reason[p]}")
         return (" [" + "; ".join(notes) + "]") if notes else ""
+
+    @staticmethod
+    def _default_timeout(timeout: float | None) -> float:
+        """gather/barrier wait budget: explicit argument, else
+        PATHWAY_DCN_TIMEOUT seconds (default 300). Chaos tests shrink it
+        so a dropped frame surfaces in seconds, not minutes."""
+        if timeout is not None:
+            return timeout
+        return float(os.environ.get("PATHWAY_DCN_TIMEOUT", "300") or 300)
 
     def send(self, dst: int, channel: str, tick: int, payload: Any) -> None:
         # disabled tracing must not cost a contextvar read + pending-lock
@@ -622,12 +807,12 @@ class HostMesh:
         )
 
     def gather(
-        self, channel: str, tick: int, timeout: float = 300.0
+        self, channel: str, tick: int, timeout: float | None = None
     ) -> dict[int, Any]:
         """Wait for one payload from every other process on (channel, tick)."""
         want = self.n - 1
         t0 = time.perf_counter()
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + self._default_timeout(timeout)
         key = (channel, tick)
         with self._cv:
             while True:
@@ -655,7 +840,7 @@ class HostMesh:
                             f"delivering {channel}@{tick}"
                             + self._dead_detail(missing & self._dead)
                         )
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     raise HostMeshError(
                         f"process {self.pid}: timeout waiting for "
@@ -663,7 +848,9 @@ class HostMesh:
                     )
                 self._cv.wait(timeout=min(left, 1.0))
 
-    def barrier(self, value: Any, timeout: float = 300.0) -> dict[int, Any]:
+    def barrier(
+        self, value: Any, timeout: float | None = None
+    ) -> dict[int, Any]:
         """Exchange `value` with every process; returns {pid: value} for all
         N processes (including self). Must be called in lockstep — the
         internal round counter is the channel. ``last_barrier_tps`` holds
@@ -687,7 +874,7 @@ class HostMesh:
                     peer, ("bar", self.pid, rnd, value, own_tp)
                 )
         want = self.n - 1
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + self._default_timeout(timeout)
         with self._cv:
             while True:
                 got = self._bars.get(rnd, {})
@@ -710,7 +897,7 @@ class HostMesh:
                             f"barrier {rnd}"
                             + self._dead_detail(missing & self._dead)
                         )
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     raise HostMeshError(
                         f"process {self.pid}: timeout at barrier {rnd}"

@@ -1,0 +1,227 @@
+"""Phoenix Mesh group supervisor — whole-group restart on rank failure.
+
+The mesh is fail-stop per incarnation: a dead peer surfaces as
+HostMeshError on every survivor (heartbeat liveness, reader EOF, or a
+send failure — parallel/host_exchange.py), every rank exits nonzero, and
+recovery = restart the WHOLE group from the latest group-committed
+snapshot generation (persistence/_runtime_glue.py), exactly the
+reference's recovery model (whole-cluster restart from the persisted
+frontier, src/persistence/state.rs:291).  This module is the missing
+restart half: it spawns the N ranks, watches them, tears the group down
+when any rank dies, and respawns everything under a bounded restart
+budget with jittered backoff.
+
+Each incarnation gets ``PATHWAY_MESH_INCARNATION=<n>`` in its
+environment: Fault Forge directives (testing/faults.py) default to
+incarnation 0, so an injected death is not re-injected into the
+restarted group — chaos tests assert the SECOND incarnation converges on
+the uninterrupted run's output.
+
+Usage::
+
+    python -m pathway_tpu.parallel.supervisor -n 2 -- python job.py
+    pathway-tpu spawn -n 2 --supervise -- python job.py
+
+or programmatically (tests, bench.py chaos_recovery)::
+
+    sup = GroupSupervisor(["python", "job.py"], n=2, env=extra_env)
+    rc = sup.run()
+    sup.events  # [(monotonic_ts, "rank-died"|"group-restart"|..., detail)]
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Mapping
+
+
+def max_restarts_env() -> int:
+    """Bounded restart budget before giving up with today's fail-stop
+    diagnostics (PATHWAY_MESH_MAX_RESTARTS, default 2)."""
+    return int(os.environ.get("PATHWAY_MESH_MAX_RESTARTS", "2") or 2)
+
+
+class GroupSupervisor:
+    """Spawn-and-respawn an N-rank process group.
+
+    ``argv`` is the per-rank command line; each rank runs it with
+    PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_MESH_INCARNATION
+    set (plus ``env`` overrides, applied to every rank; ``rank_env``
+    may add per-rank variables).  A group where every rank exits 0 is
+    done; any nonzero (or signaled) rank kills the survivors and — if
+    the restart budget allows — respawns the whole group.
+    """
+
+    def __init__(
+        self,
+        argv: list[str],
+        n: int,
+        *,
+        env: Mapping[str, str] | None = None,
+        rank_env: Callable[[int], Mapping[str, str] | None] | None = None,
+        max_restarts: int | None = None,
+        grace_s: float = 5.0,
+        backoff_s: float = 0.25,
+        poll_s: float = 0.05,
+        log_dir: str | None = None,
+    ):
+        self.argv = list(argv)
+        self.n = int(n)
+        self.env = dict(env or {})
+        self.rank_env = rank_env
+        self.max_restarts = (
+            max_restarts_env() if max_restarts is None else int(max_restarts)
+        )
+        self.grace_s = grace_s
+        self.backoff_s = backoff_s
+        self.poll_s = poll_s
+        self.log_dir = log_dir
+        self.restarts_used = 0
+        self.events: list[tuple[float, str, str]] = []
+        self.last_codes: list[int | None] = []
+        self._rng = random.Random(0xF0E1)
+
+    def _event(self, kind: str, detail: str) -> None:
+        self.events.append((time.monotonic(), kind, detail))
+
+    def _spawn_group(self, incarnation: int) -> list[subprocess.Popen]:
+        procs: list[subprocess.Popen] = []
+        for pid in range(self.n):
+            env = dict(os.environ)
+            env.update(self.env)
+            env["PATHWAY_PROCESSES"] = str(self.n)
+            env["PATHWAY_PROCESS_ID"] = str(pid)
+            env["PATHWAY_MESH_INCARNATION"] = str(incarnation)
+            if self.rank_env is not None:
+                env.update(self.rank_env(pid) or {})
+            stdout = None
+            if self.log_dir is not None:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(
+                    os.path.join(
+                        self.log_dir, f"rank{pid}-inc{incarnation}.log"
+                    ),
+                    "ab",
+                )
+            procs.append(
+                subprocess.Popen(
+                    self.argv,
+                    env=env,
+                    stdout=stdout,
+                    stderr=subprocess.STDOUT if stdout is not None else None,
+                )
+            )
+            if stdout is not None:
+                stdout.close()  # the child holds its own fd now
+        self._event("group-start", f"incarnation {incarnation}")
+        return procs
+
+    def _terminate(self, procs: list[subprocess.Popen]) -> None:
+        """SIGTERM the survivors, escalate to SIGKILL after the grace
+        period — a wedged rank must not block the restart."""
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        for p in procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(self.poll_s)
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()
+
+    def run(self) -> int:
+        incarnation = 0
+        while True:
+            procs = self._spawn_group(incarnation)
+            failed: int | None = None
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [
+                    (i, c) for i, c in enumerate(codes) if c not in (None, 0)
+                ]
+                if bad:
+                    failed = bad[0][0]
+                    self._event(
+                        "rank-died",
+                        f"rank {bad[0][0]} exited {bad[0][1]} "
+                        f"(incarnation {incarnation})",
+                    )
+                    break
+                if all(c == 0 for c in codes):
+                    self.last_codes = codes
+                    self._event("group-done", f"incarnation {incarnation}")
+                    return 0
+                time.sleep(self.poll_s)
+            self._terminate(procs)
+            self.last_codes = [p.returncode for p in procs]
+            if self.restarts_used >= self.max_restarts:
+                self._event(
+                    "gave-up",
+                    f"restart budget exhausted "
+                    f"({self.restarts_used}/{self.max_restarts}); rank "
+                    f"{failed} last exit "
+                    f"{self.last_codes[failed] if failed is not None else '?'}",
+                )
+                return next(
+                    (c for c in self.last_codes if c not in (0, None)), 1
+                )
+            self.restarts_used += 1
+            incarnation += 1
+            delay = min(5.0, self.backoff_s * (2 ** (self.restarts_used - 1)))
+            delay *= 0.5 + self._rng.random()
+            self._event(
+                "group-restart",
+                f"restart {self.restarts_used}/{self.max_restarts} in "
+                f"{delay:.2f}s (incarnation {incarnation})",
+            )
+            time.sleep(delay)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import secrets
+
+    parser = argparse.ArgumentParser(
+        prog="python -m pathway_tpu.parallel.supervisor",
+        description="run an N-rank DCN group under the Phoenix Mesh "
+        "restart supervisor",
+    )
+    parser.add_argument("--processes", "-n", type=int, default=2)
+    parser.add_argument("--max-restarts", type=int, default=None)
+    parser.add_argument("--log-dir", default=None)
+    args, extra = parser.parse_known_args(argv)
+    if extra and extra[0] == "--":
+        extra = extra[1:]
+    if not extra:
+        print("nothing to run", file=sys.stderr)
+        return 2
+    env = {}
+    if "PATHWAY_DCN_SECRET" not in os.environ:
+        env["PATHWAY_DCN_SECRET"] = secrets.token_hex(32)
+    sup = GroupSupervisor(
+        extra,
+        args.processes,
+        env=env,
+        max_restarts=args.max_restarts,
+        log_dir=args.log_dir,
+    )
+    rc = sup.run()
+    for ts, kind, detail in sup.events:
+        print(f"[supervisor +{ts - sup.events[0][0]:8.3f}s] {kind}: {detail}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,8 @@ from pathway_tpu.persistence.segments import (
 )
 
 _META_KEY = "metadata.json"
+_GROUP_COMMIT_KEY = "group_commit.json"  # durable audit record of the
+# last gen-commit barrier agreement (Phoenix Mesh phase 2)
 
 _M: dict | None = None
 
@@ -117,6 +119,13 @@ class PersistenceDriver:
     def __init__(self, runtime: Runtime, config: Any):
         self.runtime = runtime
         self.store: BackendStore = store_for_backend(config.backend)
+        # Fault Forge: slow-store injection wraps every put/get; the
+        # torn-snapshot hook fires in commit() right before metadata
+        from pathway_tpu.testing import faults
+
+        self._fault_plan = faults.active()
+        if self._fault_plan is not None:
+            self.store = self._fault_plan.wrap_store(self.store)
         self.snapshot_interval_ms = max(
             int(getattr(config, "snapshot_interval_ms", 0) or 0), 0
         )
@@ -157,6 +166,13 @@ class PersistenceDriver:
         }
         self._chunk_counts: dict[str, int] = {}
         self._live_chunks: dict[str, list[int]] = {}
+        # per-input offsets snapshot taken when that input's rows were
+        # last appended to the log (see on_tick) — the only offsets
+        # commit() is allowed to persist; _offsets_persisted remembers
+        # the object identity last written so unchanged offsets skip
+        # the store round trip on idle per-tick commits
+        self._offsets_at_log: dict[str, Any] = {}
+        self._offsets_persisted: dict[str, Any] = {}
         self._last_commit_wall = 0.0
         self._committed_time = 0
         self._last_real_time = 0
@@ -172,6 +188,12 @@ class PersistenceDriver:
             int(getattr(config, "snapshot_every", 8) or 8), 1
         )
         self._commits_since_snapshot = 0
+        # multi-process: GC deferred past the gen-commit barrier (phase
+        # 2 of the two-phase generation commit) — files are only retired
+        # once the whole group has confirmed a generation every rank can
+        # restore
+        self._pending_gc: tuple[dict, dict] | None = None
+        self._group_commit_time: int | None = None
         # mixed dependency: a node fed by BOTH a transient source and a
         # logged one is excluded from snapshots (its transient rows re-feed)
         # yet needs the logged rows to rebuild — truncating the log would
@@ -215,8 +237,11 @@ class PersistenceDriver:
         ) not in ("", "0")
         self._segments_present: set[str] = set()
         _boot_meta = self._load_meta()
-        for gen_key in ("state", "prev_state"):
-            gen_desc = _boot_meta.get(gen_key)
+        _boot_gens = [_boot_meta.get("state"), _boot_meta.get("prev_state")]
+        _boot_gens += [
+            r.get("state") for r in _boot_meta.get("retained_states", [])
+        ]
+        for gen_desc in _boot_gens:
             if gen_desc:
                 self._segments_present.update(
                     gen_desc.get("segment_keys", ())
@@ -316,6 +341,7 @@ class PersistenceDriver:
                 and self._ticks_seen % self.snapshot_every == 0
             ):
                 self.commit(snapshot=True)
+                self._group_commit(hm)
             self._ticks_seen += 1
         self._orig_tick(t, injected)
         if not self.record:
@@ -352,8 +378,24 @@ class PersistenceDriver:
                 rows = [r for b in batches for r in b.iter_rows()]
                 if rows:
                     self._pending[pid].append((t, rows))
+        # capture offsets AT LOG TIME: commit() persists this pairing,
+        # never the session's live last_offsets. The live value already
+        # covers rows drained for the NEXT tick (the lockstep loop
+        # drains before calling tick), so a commit running at the head
+        # of a tick — the group-safe snapshot point — would otherwise
+        # persist offsets ahead of the durable log and a mid-tick death
+        # would LOSE those rows on resume (Fault Forge chaos matrix
+        # pinned this down).
+        for pid, node in self.inputs.items():
+            session = getattr(node.source, "session", None)
+            if session is not None and getattr(
+                session, "last_offsets", None
+            ) is not None:
+                self._offsets_at_log[pid] = session.last_offsets
         if t >= END_OF_TIME:
             self.commit(final=True)
+            if hm is not None:
+                self._group_commit(hm)
             return
         self._last_real_time = max(self._last_real_time, t)
         import time as _time
@@ -397,19 +439,47 @@ class PersistenceDriver:
         # selective mode: inputs are neither logged nor offset-tracked —
         # writing __static_done__ here would suppress sources on restart
         # with no log to reproduce them
+        #
+        # Offsets are STAGED under sequence-numbered keys and only become
+        # current when the metadata naming them commits below — a crash
+        # between the offsets write and the metadata write must leave the
+        # previous consistent cut intact. (The old in-place
+        # ``offsets/{pid}.pkl`` overwrite could run ahead of the named
+        # log chunks and silently LOSE the torn commit's rows on resume;
+        # Fault Forge's torn-snapshot smoke pinned this down.)
+        offsets_named = dict(meta.get("offsets", {}))
+        oseq = int(meta.get("offsets_seq", 0))
+        retired_offsets: list[str] = []
         for pid, node in () if self.selective else self.inputs.items():
             state = None
             src = node.source
             session = getattr(src, "session", None)
-            if session is not None and getattr(session, "last_offsets", None) is not None:
-                # only offsets whose covered rows have been drained (and so
-                # logged above) — a live src.offset_state() could run ahead
-                # of the log and lose rows on resume
-                state = session.last_offsets
+            if session is not None:
+                # only offsets captured when their covered rows were
+                # appended to the log (on_tick) — the session's LIVE
+                # last_offsets can already cover the next tick's drained
+                # -but-unlogged rows when this commit runs at the head
+                # of a tick (group-safe snapshot point)
+                state = self._offsets_at_log.get(pid)
+                # last_offsets is REASSIGNED per drain, so identity
+                # detects change: idle ticks (DCN commits every tick)
+                # skip the rewrite entirely
+                if state is self._offsets_persisted.get(pid):
+                    state = None
             elif isinstance(src, StaticSource):
                 state = {"__static_done__": True} if final else None
             if state is not None:
-                self.store.put(f"offsets/{pid}.pkl", pickle.dumps(state))
+                oseq += 1
+                key = f"offsets/{pid}-{oseq:08d}.pkl"
+                self.store.put(key, pickle.dumps(state))
+                # the exact key this one supersedes (or the legacy
+                # in-place key on first post-upgrade commit) retires
+                # after the metadata naming the replacement is durable
+                retired_offsets.append(
+                    offsets_named.get(pid, f"offsets/{pid}.pkl")
+                )
+                offsets_named[pid] = key
+                self._offsets_persisted[pid] = state
                 offsets_changed = True
         snap = None
         self._commits_since_snapshot += 1
@@ -429,31 +499,97 @@ class PersistenceDriver:
             meta["chunks"].update(self._chunk_counts)
             meta["live_chunks"] = self._live_chunks
             meta["last_time"] = max(meta.get("last_time", 0), self._last_real_time)
+            if offsets_changed:
+                meta["offsets"] = offsets_named
+                meta["offsets_seq"] = oseq
             if snap:
                 if dcn:
-                    # multi-process: retain the PREVIOUS generation (state
-                    # + the chunks between the two snapshots). Snapshot
-                    # points are lockstep-aligned, so generation skew
-                    # across a crash is at most one; restart restores the
-                    # group-min generation, which is always retained
-                    # (reference: consistent frontier across workers,
+                    # multi-process: RETAIN every superseded generation
+                    # (state + the chunks between snapshots) until the
+                    # gen-commit barrier confirms the whole group holds
+                    # something newer — GC then trims the list back to
+                    # one. Snapshot points are lockstep-aligned, so the
+                    # steady-state list length is one (the old prev_state
+                    # behavior); a degraded rank (unpicklable state)
+                    # grows it, and every healthy rank keeps enough
+                    # history for the group-min restore (reference:
+                    # consistent frontier across workers,
                     # src/persistence/state.rs:291)
                     if meta.get("state"):
+                        retained = list(meta.get("retained_states", ()))
+                        retained.append(
+                            {
+                                "state": meta["state"],
+                                "chunks": {
+                                    pid: list(v)
+                                    for pid, v in self._live_chunks.items()
+                                },
+                            }
+                        )
+                        meta["retained_states"] = retained
+                        # legacy mirrors (older readers + replay fallback)
                         meta["prev_state"] = meta["state"]
-                    meta["prev_chunks"] = {
-                        pid: list(v) for pid, v in self._live_chunks.items()
-                    }
+                        meta["prev_chunks"] = retained[-1]["chunks"]
                 meta["state"] = snap
                 meta["live_chunks"] = self._live_chunks = {
                     pid: [] for pid in self._live_chunks
                 }
             if final:
                 meta["finished"] = True
+            if self._fault_plan is not None:
+                # torn-snapshot injection point: segments + state blobs
+                # are durable, the metadata naming them is not
+                self._fault_plan.before_meta_commit(snap is not None)
             self.store.put(_META_KEY, json.dumps(meta).encode())
             self._committed_time = meta["last_time"]
+            if offsets_changed:
+                # superseded offsets snapshots retire only AFTER the
+                # metadata naming their replacements is durable;
+                # targeted removes, not a per-commit prefix listing
+                for key in retired_offsets:
+                    self.store.remove(key)
             if snap:
                 self._commits_since_snapshot = 0
-                self._gc(meta, snap)
+                if dcn and self.record:
+                    # phase 2 (the gen-commit barrier in on_tick) decides
+                    # whether this generation's GC may run. Selective
+                    # mode never joins that barrier (its interval
+                    # snapshots are wall-clock-driven, not lockstep-
+                    # aligned), so it keeps the immediate GC below.
+                    self._pending_gc = (meta, snap)
+                else:
+                    self._gc(meta, snap)
+
+    def _group_commit(self, hm) -> None:
+        """Two-phase generation commit (Phoenix Mesh). Phase 1 is the
+        local durable snapshot commit (commit(snapshot=True) — atomic on
+        metadata). Phase 2 is this barrier: every rank exchanges the
+        time of its newest durable generation; the group minimum —
+        the newest state the WHOLE group can restore — gates the
+        deferred GC (only once it has caught up to a rank's own
+        generation does that rank retire superseded files, so no rank
+        ever deletes what a lagging/degraded peer's group-min restore
+        still needs) and is recorded under ``group_commit.json`` as the
+        durable audit record of the agreement (restore re-derives the
+        agreement with a live barrier over what is actually restorable;
+        the retained-generation list guarantees that minimum exists on
+        every rank even when a rank dies between the two phases)."""
+        pending, self._pending_gc = self._pending_gc, None
+        meta = pending[0] if pending is not None else self._load_meta()
+        local = (
+            int(meta["state"].get("time", 0)) if meta.get("state") else -1
+        )
+        vals = hm.barrier(("gen-commit", local))
+        group = min(v[1] for v in vals.values())
+        if group >= 0 and group != self._group_commit_time:
+            self._group_commit_time = group
+            self.store.put(
+                _GROUP_COMMIT_KEY, json.dumps({"time": group}).encode()
+            )
+        if pending is not None:
+            _meta, snap = pending
+            if int(snap.get("time", -1)) <= group:
+                self._gc(_meta, snap)
 
     @staticmethod
     def _state_key(gen: int, ident) -> str:
@@ -575,17 +711,32 @@ class PersistenceDriver:
         group-min time."""
         keep_segments = set(snap.get("segment_keys", ()))
         if getattr(self.runtime, "host_mesh", None) is not None:
+            # the group confirmed this generation (gen-commit barrier):
+            # trim the retained list back to ONE superseded generation
+            # (the lockstep skew bound), then retire files nothing kept
+            # references. Metadata is trimmed FIRST — a crash here
+            # orphans files (harmless: _segments_present is primed from
+            # metadata, so re-minted ids overwrite them) instead of
+            # naming deleted ones.
+            retained = list(meta.get("retained_states", ()))
+            kept = retained[-1:]
+            meta["retained_states"] = kept
+            if kept:
+                meta["prev_state"] = kept[-1]["state"]
+                meta["prev_chunks"] = kept[-1]["chunks"]
+            self.store.put(_META_KEY, json.dumps(meta).encode())
             keep_inputs = {
                 f"inputs/{pid}/chunk-{i:08d}.pkl"
-                for pid, ids in meta.get("prev_chunks", {}).items()
+                for entry in kept
+                for pid, ids in entry.get("chunks", {}).items()
                 for i in ids
             }
             for key in self.store.list_keys("inputs/"):
                 if key not in keep_inputs:
                     self.store.remove(key)
             keep = {f"states/gen-{snap['gen']:06d}/"}
-            prev = meta.get("prev_state")
-            if prev:
+            for entry in kept:
+                prev = entry.get("state") or {}
                 keep.add(f"states/gen-{int(prev['gen']):06d}/")
                 keep_segments.update(prev.get("segment_keys", ()))
             for key in self.store.list_keys("states/"):
@@ -650,16 +801,41 @@ class PersistenceDriver:
                 state_time = self._restore_operators(snap)
         else:
             latest = meta.get("state")
-            prev = meta.get("prev_state")
+            # candidates newest-first: latest, then every retained
+            # superseded generation (legacy metadata: prev_state). The
+            # gen-commit barrier's retained list guarantees the group
+            # minimum is locally restorable on every rank.
+            older = [
+                r.get("state")
+                for r in reversed(meta.get("retained_states", []))
+                if r.get("state")
+            ]
+            if not older and meta.get("prev_state"):
+                older = [meta["prev_state"]]
             latest_time = int(latest.get("time", 0)) if latest else -1
             vals = hm.barrier(("replay-gen", latest_time))
             group_time = min(v[1] for v in vals.values())
             chosen = None
             if group_time >= 0:
-                if latest and int(latest.get("time", 0)) <= group_time:
-                    chosen = latest
-                elif prev and int(prev.get("time", 0)) <= group_time:
-                    chosen = prev
+                for cand in [latest] + older:
+                    if cand and int(cand.get("time", 0)) <= group_time:
+                        chosen = cand
+                        break
+            # the phase-2 audit record: what the group had confirmed
+            # restorable before the crash — surfaced so an operator can
+            # compare it with what this recovery actually picked
+            marker_raw = self.store.get(_GROUP_COMMIT_KEY)
+            if marker_raw is not None:
+                import logging
+
+                logging.getLogger("pathway_tpu").info(
+                    "group recovery: restoring generation at time %s "
+                    "(live group agreement %s; last durable gen-commit "
+                    "agreement %s)",
+                    chosen.get("time") if chosen else None,
+                    group_time,
+                    json.loads(marker_raw.decode()).get("time"),
+                )
             if chosen is not None:
                 state_time = self._restore_operators(chosen)
         # receiver-side floor: drop exchanged partitions already covered
@@ -695,14 +871,20 @@ class PersistenceDriver:
             if chunk_ids is None:  # pre-compaction metadata: contiguous
                 chunk_ids = list(range(meta.get("chunks", {}).get(pid, 0)))
             if hm is not None:
-                # previous-generation chunks too: they cover the span
-                # between the retained generations, needed when the group
-                # restores the older one
-                chunk_ids = list(
-                    dict.fromkeys(
-                        list(meta.get("prev_chunks", {}).get(pid, []))
-                        + list(chunk_ids)
+                # retained-generation chunks too: they cover the spans
+                # between the retained generations, needed when the
+                # group restores an older one
+                retained_chunks = [
+                    i
+                    for r in meta.get("retained_states", [])
+                    for i in r.get("chunks", {}).get(pid, [])
+                ]
+                if not retained_chunks:
+                    retained_chunks = list(
+                        meta.get("prev_chunks", {}).get(pid, [])
                     )
+                chunk_ids = list(
+                    dict.fromkeys(retained_chunks + list(chunk_ids))
                 )
             for i in chunk_ids:
                 raw = self.store.get(f"inputs/{pid}/chunk-{i:08d}.pkl")
@@ -738,8 +920,11 @@ class PersistenceDriver:
                     i += 1
                 self._orig_tick(t, injected)
         # restore offsets so live sources continue past what was replayed
+        # (the metadata names the committed snapshot; legacy stores fall
+        # back to the old in-place key)
         for pid, node in () if self.selective else self.inputs.items():
-            raw = self.store.get(f"offsets/{pid}.pkl")
+            okey = meta.get("offsets", {}).get(pid, f"offsets/{pid}.pkl")
+            raw = self.store.get(okey)
             if raw is None:
                 continue
             state = pickle.loads(raw)
@@ -832,7 +1017,18 @@ class PersistenceDriver:
 
 def attach_persistence(runtime: Runtime, config: Any) -> PersistenceDriver:
     driver = PersistenceDriver(runtime, config)
-    driver.replay()
+    # graceful degradation (Phoenix Mesh): while recovery replay runs,
+    # Surge-Gated endpoints answer from the last hydrated index snapshot
+    # instead of queueing behind the replay — operator restore happens
+    # up front (mmap), so the stale corpus is available immediately
+    from pathway_tpu.serving import degrade
+
+    _REPLAY_REASON = "restoring persisted state (recovery replay)"
+    degrade.enter_recovery(_REPLAY_REASON)
+    try:
+        driver.replay()
+    finally:
+        degrade.exit_recovery(_REPLAY_REASON)
     runtime.tick = driver.on_tick  # type: ignore[method-assign]
     runtime.persistence_driver = driver  # type: ignore[attr-defined]
     return driver

@@ -29,6 +29,7 @@ from pathway_tpu.serving.gate import (
     drain_all,
     gates,
 )
+from pathway_tpu.serving import degrade
 
 __all__ = [
     "AdmissionController",
@@ -40,6 +41,7 @@ __all__ = [
     "SurgeGate",
     "TokenBucket",
     "default_bucket_ladder",
+    "degrade",
     "drain_all",
     "gates",
     "serving_enabled_via_env",

@@ -1,0 +1,5 @@
+"""Testing utilities — deterministic fault injection (Fault Forge)."""
+
+from pathway_tpu.testing import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,67 @@
+"""Shared helpers for chaos/recovery harnesses (tests + bench).
+
+The kill/restart matrix in ``tests/test_distributed.py`` and the
+``bench.py chaos_recovery`` tier drive the same shape of experiment: a
+multi-process DCN group writing jsonlines diff streams whose FOLDED
+state must converge on the uninterrupted run's totals.  The folding
+rules (``diff > 0`` installs a key's value, ``diff < 0`` removes it only
+when it matches — a rewound incarnation may re-emit retractions the fold
+must tolerate) and the mesh port probing are shared here so the two
+harnesses cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+
+
+def free_dcn_port(n: int = 2) -> int:
+    """A base port where ``base..base+n-1`` are all currently free (the
+    host mesh binds base_port + pid for every rank)."""
+    for _ in range(50):
+        base = random.randint(20000, 40000)
+        ok = True
+        for off in range(n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                s.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port window")
+
+
+def fold_diff_stream(paths, key_fields) -> dict:
+    """Fold jsonlines diff streams into current state: key = tuple of
+    ``key_fields``, value = tuple of every other field (sorted by name,
+    excluding diff/time/id).  Insertions overwrite; a retraction removes
+    the key only when it matches the current value, so replayed
+    retractions from a restarted incarnation are absorbed."""
+    state: dict = {}
+    for p in paths:
+        try:
+            lines = open(p).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            o = json.loads(line)
+            key = tuple(o[f] for f in key_fields)
+            val = tuple(
+                v
+                for f, v in sorted(o.items())
+                if f not in ("diff", "time", "id", *key_fields)
+            )
+            if o["diff"] > 0:
+                state[key] = val
+            elif state.get(key) == val:
+                del state[key]
+    return state

@@ -187,26 +187,9 @@ def _spawn_group(script_path, n, port, extra_env=None, timeout=150):
 
 
 def _free_dcn_port() -> int:
-    # the mesh binds base_port + pid for every pid; probe a base where
-    # both ports are free
-    import random
+    from pathway_tpu.testing.chaos import free_dcn_port
 
-    for _ in range(50):
-        base = random.randint(20000, 40000)
-        ok = True
-        for off in range(2):
-            s = socket.socket()
-            try:
-                s.bind(("127.0.0.1", base + off))
-            except OSError:
-                ok = False
-            finally:
-                s.close()
-            if not ok:
-                break
-        if ok:
-            return base
-    raise RuntimeError("no free port pair")
+    return free_dcn_port(2)
 
 
 def test_two_process_wordcount_dcn(tmp_path):
@@ -462,6 +445,7 @@ _DCN_MATRIX_WORKER = textwrap.dedent(
     threading.Thread(target=watch, daemon=True).start()
     cfg = pw.persistence.Config.simple_config(
         pw.persistence.Backend.filesystem(str(pdir)),
+        snapshot_every=int(os.environ.get("PW_SNAPSHOT_EVERY", "8")),
     )
     pw.run(persistence_config=cfg, autocommit_duration_ms=20)
     print("CLEAN-EXIT", flush=True)
@@ -470,27 +454,9 @@ _DCN_MATRIX_WORKER = textwrap.dedent(
 
 
 def _fold_keyed(paths, key_fields):
-    state: dict = {}
-    for p in paths:
-        try:
-            lines = open(p).read().splitlines()
-        except OSError:
-            continue
-        for line in lines:
-            if not line.strip():
-                continue
-            o = json.loads(line)
-            key = tuple(o[f] for f in key_fields)
-            val = tuple(
-                v
-                for f, v in sorted(o.items())
-                if f not in ("diff", "time", "id", *key_fields)
-            )
-            if o["diff"] > 0:
-                state[key] = val
-            elif state.get(key) == val:
-                del state[key]
-    return state
+    from pathway_tpu.testing.chaos import fold_diff_stream
+
+    return fold_diff_stream(paths, key_fields)
 
 
 def _run_matrix_kill_restart(tmp_path, pipeline, key_fields, expected, live_expected=None):
@@ -701,7 +667,7 @@ def test_two_process_join_dcn(tmp_path):
 
 @pytest.mark.parametrize("wire_fmt", ["codec", "pickle"])
 def test_two_process_wordcount_wire_formats(tmp_path, wire_fmt):
-    """The PWHX6 columnar codec and the pickle escape hatch produce
+    """The PWHX7 columnar codec and the pickle escape hatch produce
     IDENTICAL results end-to-end: same per-process ownership contract,
     same merged totals (acceptance: differential 2-process run with
     PATHWAY_DCN_WIRE=codec vs =pickle)."""
@@ -778,3 +744,314 @@ def test_host_mesh_rejects_unauthenticated_frames(monkeypatch):
     finally:
         m0.close()
         m1.close()
+
+
+# ---------------------------------------------------------------------------
+# Phoenix Mesh chaos matrix (Fault Forge, PR 8)
+
+_DCN_CHAOS_WORKER = textwrap.dedent(
+    """
+    import os, sys, json, time, pathlib, threading
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pid = int(os.environ["PATHWAY_PROCESS_ID"])
+    inc = os.environ.get("PATHWAY_MESH_INCARNATION", "0")
+    base = pathlib.Path(os.environ["PW_TEST_DIR"])
+    in_dir = base / f"in{pid}"
+    pdir = base / f"pstorage{pid}"
+    out_file = base / f"out{pid}_inc{inc}.jsonl"
+    stop_file = base / "STOP"
+
+    class S(pw.Schema):
+        k: str
+        t: int
+        v: int
+
+    rows = pw.io.jsonlines.read(str(in_dir), schema=S, mode="streaming")
+    r = rows.groupby(rows.k).reduce(
+        rows.k,
+        s=pw.reducers.sum(rows.v),
+        cnt=pw.reducers.count(),
+    )
+    pw.io.jsonlines.write(r, str(out_file))
+
+    def watch():
+        while True:
+            time.sleep(0.05)
+            if stop_file.exists():
+                rt = pw.internals.parse_graph.G.runtime
+                if rt is not None:
+                    rt.stop()
+                return
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir)),
+        snapshot_every=int(os.environ.get("PW_SNAPSHOT_EVERY", "2")),
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+    drv = getattr(pw.internals.parse_graph.G.runtime, "persistence_driver", None)
+    print("REPLAYED %d" % (drv.replayed_events if drv else -1), flush=True)
+    print("CLEAN-EXIT", flush=True)
+    """
+)
+
+
+def test_two_process_kill_mid_tick_supervised_recovery(tmp_path):
+    """ACCEPTANCE (Phoenix Mesh): Fault Forge kills rank 1 at the tail
+    of a data tick (processed but uncommitted — the group-visible
+    mid-tick death); the survivor fail-stops, the GroupSupervisor
+    restarts the WHOLE group, incarnation 1 restores the latest
+    group-committed snapshot generation + log tail and converges on
+    output identical to an uninterrupted run."""
+    import threading
+
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.testing import faults as faults_mod
+
+    base = tmp_path / "work"
+    for pid in range(2):
+        (base / f"in{pid}").mkdir(parents=True)
+    script = tmp_path / "worker.py"
+    script.write_text(_DCN_CHAOS_WORKER)
+    port = _free_dcn_port()
+
+    def write_rows(pid, fname, rows):
+        with open(base / f"in{pid}" / fname, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    # trickle input so incarnation 0 sees several data ticks before the
+    # injected death: the first file is written up front, the rest only
+    # AFTER the group's first output appears (workers boot slowly — a
+    # pre-written pile would collapse into one tick)
+    all_rows = {0: [], 1: []}
+
+    def trickler():
+        def rows_for(i, pid):
+            return [
+                {"k": f"k{(i + j + pid) % 4}", "t": i, "v": i + j}
+                for j in range(3)
+            ]
+
+        for pid in range(2):
+            write_rows(pid, "f0.jsonl", rows_for(0, pid))
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if any(
+                p.stat().st_size > 0
+                for p in base.glob("out*_inc0.jsonl")
+            ):
+                break
+            time.sleep(0.2)
+        for i in range(1, 6):
+            for pid in range(2):
+                write_rows(pid, f"f{i}.jsonl", rows_for(i, pid))
+            time.sleep(0.4)
+
+    # rows are deterministic: precompute them (and the expected fold)
+    # without racing the writer thread
+    for i in range(6):
+        for pid in range(2):
+            all_rows[pid].extend(
+                {"k": f"k{(i + j + pid) % 4}", "t": i, "v": i + j}
+                for j in range(3)
+            )
+    expected: dict = {}
+    for pid in range(2):
+        for r in all_rows[pid]:
+            cnt, s = expected.get((r["k"],), (0, 0))
+            expected[(r["k"],)] = (cnt + 1, s + r["v"])
+    all_rows = {0: [], 1: []}  # reset: the trickler re-derives them
+
+    out_paths = lambda: sorted(base.glob("out*_inc*.jsonl"))  # noqa: E731
+
+    def stopper():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if _fold_keyed(out_paths(), ["k"]) == expected:
+                break
+            time.sleep(0.25)
+        (base / "STOP").touch()
+
+    tr = threading.Thread(target=trickler, daemon=True)
+    st = threading.Thread(target=stopper, daemon=True)
+    sup = GroupSupervisor(
+        [sys.executable, str(script)],
+        2,
+        env={
+            "PW_TEST_DIR": str(base),
+            "PATHWAY_DCN_PORT": str(port),
+            "PATHWAY_DCN_SECRET": f"chaos-secret-{port}",
+            "PATHWAY_DCN_TIMEOUT": "60",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+            "PATHWAY_FAULTS": "kill=tick:3,pid:1,at:tail",
+        },
+        max_restarts=2,
+        backoff_s=0.1,
+        log_dir=str(base / "logs"),
+    )
+    tr.start()
+    st.start()
+    rc = sup.run()
+    st.join(timeout=150)
+    tr.join(timeout=10)
+    logs = "\n".join(
+        f"--- {p.name}\n{p.read_text()[-2000:]}"
+        for p in sorted((base / "logs").glob("*.log"))
+    )
+    assert rc == 0, logs
+    assert sup.restarts_used >= 1, sup.events
+    died = [d for _t, k, d in sup.events if k == "rank-died"]
+    assert any(
+        f"exited {faults_mod.FAULT_EXIT}" in d for d in died
+    ), sup.events
+    assert _fold_keyed(out_paths(), ["k"]) == expected, logs
+
+
+def test_two_process_torn_manifest_recovery(tmp_path):
+    """Fault Forge torn snapshot on rank 0 (death between segment
+    writes and the metadata commit at a group-safe snapshot point): the
+    group fail-stops, a clean restart restores the previous consistent
+    generation on rank 0 / the group-min on rank 1, and the merged
+    totals equal the uninterrupted run."""
+    base = tmp_path / "work"
+    for pid in range(2):
+        (base / f"in{pid}").mkdir(parents=True)
+    script = tmp_path / "worker.py"
+    script.write_text(_DCN_MATRIX_WORKER)
+    port = _free_dcn_port()
+
+    def write_rows(pid, fname, rows):
+        with open(base / f"in{pid}" / fname, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    rows1 = {
+        0: [{"k": "x", "t": i, "v": i} for i in range(5)],
+        1: [{"k": "y", "t": i, "v": 2 * i} for i in range(5)],
+    }
+    for pid, rows in rows1.items():
+        write_rows(pid, "f1.jsonl", rows)
+
+    def phase(extra):
+        return _spawn_group(
+            script,
+            2,
+            port,
+            extra_env=lambda pid: {
+                "PW_TEST_DIR": str(base),
+                "PW_PIPELINE": "groupby_sum",
+                "PW_SNAPSHOT_EVERY": "1",
+                **extra(pid),
+            },
+            timeout=120,
+        )
+
+    from pathway_tpu.testing import faults as faults_mod
+
+    import threading
+
+    # the group-safe snapshot fires at the HEAD of the 2nd data tick
+    # (snapshot_every=1): feed a second batch only once the first tick's
+    # output is visible, so the torn directive deterministically hits
+    # that snapshot's metadata commit on rank 0
+    phase1_outs = [base / f"out{p}_1.jsonl" for p in range(2)]
+
+    def feed_second_tick():
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if _fold_keyed(phase1_outs, ["k"]):
+                break
+            time.sleep(0.2)
+        for pid in range(2):
+            write_rows(
+                pid, "f1b.jsonl", [{"k": "w", "t": 6 + pid, "v": 1}]
+            )
+
+    feeder = threading.Thread(target=feed_second_tick, daemon=True)
+    feeder.start()
+    procs, outs = phase(
+        lambda pid: {
+            "PW_PHASE": "1",
+            **(
+                {"PATHWAY_FAULTS": "torn=nth:1,pid:0"} if pid == 0 else {}
+            ),
+        }
+    )
+    feeder.join(timeout=10)
+    assert procs[0].returncode == faults_mod.FAULT_EXIT, outs[0][-2000:]
+    assert procs[1].returncode != 0, outs[1][-2000:]
+
+    rows2 = {
+        0: [{"k": "x", "t": 9, "v": 100}],
+        1: [{"k": "z", "t": 9, "v": 7}],
+    }
+    for pid, rows in rows2.items():
+        write_rows(pid, "f2.jsonl", rows)
+    # (cnt, mx, s) per key over ALL rows — matrix worker emits cnt/mx/s;
+    # "w" is the second-tick trigger batch (one v=1 row per rank)
+    expected = {
+        ("x",): (6, 100, 110),
+        ("y",): (5, 8, 20),
+        ("w",): (2, 1, 2),
+        ("z",): (1, 7, 7),
+    }
+
+    import threading
+
+    all_outs = [
+        base / f"out{pid}_{ph}.jsonl" for pid in range(2) for ph in (1, 2)
+    ]
+
+    def stopper():
+        deadline = time.time() + 70
+        while time.time() < deadline:
+            if _fold_keyed(all_outs, ["k"]) == expected:
+                break
+            time.sleep(0.2)
+        (base / "STOP").touch()
+
+    st = threading.Thread(target=stopper, daemon=True)
+    st.start()
+    procs2, outs2 = phase(lambda pid: {"PW_PHASE": "2"})
+    st.join(timeout=90)
+    for pid, (p, out) in enumerate(zip(procs2, outs2)):
+        assert p.returncode == 0, f"phase2 pid={pid}:\n{out[-3000:]}"
+        assert "CLEAN-EXIT" in out
+    assert _fold_keyed(all_outs, ["k"]) == expected
+
+
+def test_two_process_duplicated_frame_is_idempotent(tmp_path):
+    """Fault Forge duplicates a groupby exchange frame on each rank:
+    delivery is keyed per (channel, tick, src), so the duplicate is
+    absorbed and the merged wordcount is EXACTLY the uninterrupted
+    result — no double-counted rows."""
+    script = tmp_path / "worker.py"
+    script.write_text(_DCN_WORDCOUNT)
+    procs, outs = _spawn_group(
+        script,
+        2,
+        _free_dcn_port(),
+        extra_env=lambda pid: {
+            "PATHWAY_FAULTS": "dup=ch:gb,nth:1,inc:*"
+        },
+    )
+    results = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pid={pid} failed:\n{out[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results.append(json.loads(line[len("RESULT "):]))
+    assert len(results) == 2
+    assert not (set(results[0]) & set(results[1]))
+    merged: dict[str, int] = {}
+    for r in results:
+        merged.update(r)
+    expected = {
+        f"w{j}": len([i for i in range(100) if i % 7 == j]) for j in range(7)
+    }
+    assert merged == expected

@@ -1,0 +1,529 @@
+"""Fault Forge (pathway_tpu/testing/faults.py) + Phoenix Mesh units:
+deterministic fault-spec parsing, the wire/store/tick hooks, the group
+supervisor's restart budget, heartbeat failure detection, the serving
+degradation controller, and a tier-1-safe single-process chaos smoke
+(torn snapshot -> clean recovery equals the uninterrupted run)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.testing import faults
+
+
+def _plan(spec: str, pid: int = 0, inc: int = 0) -> faults.FaultPlan:
+    return faults.FaultPlan(spec, pid, inc)
+
+
+# --- spec parsing ----------------------------------------------------------
+
+
+def test_spec_parses_all_directives():
+    p = _plan(
+        "seed=7;kill=tick:5,pid:1,at:tail;drop=ch:gb,nth:2;"
+        "dup=ch:jl,nth:1;delay=ch:wm,nth:3,ms:200;torn=nth:2;"
+        "slow_store=ms:10"
+    )
+    assert [d.name for d in p.directives] == [
+        "kill", "drop", "dup", "delay", "torn", "slow_store",
+    ]
+    assert p._slow_store_s == pytest.approx(0.010)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "explode=now",  # unknown directive
+        "kill=pid:1",  # kill without tick
+        "drop=nth:1",  # wire directive without channel
+        "kill=tick:notanint",
+        "kill=tick 5",  # malformed arg
+        "delay=ch:x,nth:1",  # delay without ms
+        "kill=tick:1,at:sideways",
+    ],
+)
+def test_spec_rejects_garbage(bad):
+    with pytest.raises(faults.FaultSpecError):
+        _plan(bad)
+
+
+def test_active_caches_and_resets(monkeypatch):
+    faults.reset()
+    monkeypatch.delenv("PATHWAY_FAULTS", raising=False)
+    assert faults.active() is None
+    monkeypatch.setenv("PATHWAY_FAULTS", "drop=ch:gb,nth:1")
+    assert faults.active() is None  # cached: env is read once per process
+    faults.reset()
+    p = faults.active()
+    assert p is not None and p.directives[0].name == "drop"
+    assert faults.active() is p  # same plan, counters persist
+    faults.reset()
+    monkeypatch.delenv("PATHWAY_FAULTS", raising=False)
+    faults.reset()
+
+
+def test_incarnation_scoping():
+    # default inc:0 — a restarted group (incarnation 1) is fault-free
+    p0 = _plan("drop=ch:gb,nth:1", pid=0, inc=0)
+    assert p0.on_wire_send("gb7") == ("drop", 0.0)
+    p1 = _plan("drop=ch:gb,nth:1", pid=0, inc=1)
+    for _ in range(5):
+        assert p1.on_wire_send("gb7") is None
+    # inc:* fires in every incarnation
+    pstar = _plan("drop=ch:gb,nth:1,inc:*", pid=0, inc=3)
+    assert pstar.on_wire_send("gb7") == ("drop", 0.0)
+
+
+def test_wire_counters_deterministic():
+    p = _plan("drop=ch:gb,nth:2;dup=ch:jl,nth:1;delay=ch:wm,nth:2,ms:50")
+    assert p.on_wire_send("gb1") is None
+    assert p.on_wire_send("gb1") == ("drop", 0.0)
+    assert p.on_wire_send("gb1") is None  # fired once, never again
+    assert p.on_wire_send("jl9") == ("dup", 0.0)
+    assert p.on_wire_send("wm3") is None
+    assert p.on_wire_send("wm3") == ("delay", pytest.approx(0.05))
+    # pid-scoped directive on another pid never fires
+    p2 = _plan("drop=ch:gb,nth:1,pid:1", pid=0)
+    assert p2.on_wire_send("gb1") is None
+
+
+def test_slow_store_wraps_put_get(tmp_path):
+    class Store:
+        def __init__(self):
+            self.data = {}
+
+        def put(self, key, data):
+            self.data[key] = data
+
+        def get(self, key):
+            return self.data.get(key)
+
+        def list_keys(self, prefix):
+            return [k for k in self.data if k.startswith(prefix)]
+
+    p = _plan("slow_store=ms:30")
+    s = p.wrap_store(Store())
+    t0 = time.monotonic()
+    s.put("a", b"x")
+    assert s.get("a") == b"x"
+    assert time.monotonic() - t0 >= 0.055  # two ops, 30 ms each
+    assert s.list_keys("a") == ["a"]  # passthrough attrs survive
+    # no slow_store directive -> wrap is the identity
+    inner = Store()
+    assert _plan("drop=ch:x,nth:1").wrap_store(inner) is inner
+
+
+# --- group supervisor ------------------------------------------------------
+
+_SUP_CHILD = (
+    "import os,sys;"
+    "inc=int(os.environ.get('PATHWAY_MESH_INCARNATION','0'));"
+    "sys.exit(23 if inc==0 else 0)"
+)
+
+
+def test_supervisor_restarts_group_once_then_succeeds():
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+
+    sup = GroupSupervisor(
+        [sys.executable, "-c", _SUP_CHILD],
+        2,
+        max_restarts=2,
+        backoff_s=0.05,
+        poll_s=0.02,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    kinds = [k for _ts, k, _d in sup.events]
+    assert "rank-died" in kinds and "group-restart" in kinds
+    assert kinds[-1] == "group-done"
+
+
+def test_supervisor_budget_exhausted_propagates_failure():
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+
+    sup = GroupSupervisor(
+        [sys.executable, "-c", "import sys; sys.exit(23)"],
+        2,
+        max_restarts=1,
+        backoff_s=0.05,
+        poll_s=0.02,
+    )
+    assert sup.run() == 23
+    assert sup.restarts_used == 1
+    assert [k for _ts, k, _d in sup.events][-1] == "gave-up"
+
+
+def test_supervisor_env_budget(monkeypatch):
+    from pathway_tpu.parallel import supervisor
+
+    monkeypatch.setenv("PATHWAY_MESH_MAX_RESTARTS", "7")
+    assert supervisor.max_restarts_env() == 7
+
+
+# --- heartbeat failure detection ------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_failure_listener_fires_on_peer_eof(monkeypatch):
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "phoenix-eof-test")
+    base = _free_port()
+    meshes = [None, None]
+
+    def build(pid):
+        meshes[pid] = hx.HostMesh(2, pid, base, connect_timeout=30.0)
+
+    threads = [threading.Thread(target=build, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    m0, m1 = meshes
+    assert m0 is not None and m1 is not None
+    failures: list = []
+    try:
+        m0.add_failure_listener(lambda peer, reason: failures.append(
+            (peer, reason)
+        ))
+        m1.close()  # peer death: EOF on m0's reader
+        deadline = time.monotonic() + 10
+        while not failures and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert failures and failures[0][0] == 1
+        # the pending gather names the dead peer and the recorded cause
+        with pytest.raises(hx.HostMeshError, match="peer"):
+            m0.gather("ch", 0, timeout=5)
+        # a listener registered late still hears about it
+        late: list = []
+        m0.add_failure_listener(lambda p, r: late.append(p))
+        assert late == [1]
+    finally:
+        m0.close()
+
+
+def test_liveness_timeout_detects_wedged_peer(monkeypatch):
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "phoenix-liveness-test")
+    monkeypatch.setenv("PATHWAY_MESH_HEARTBEAT_MS", "100")
+    monkeypatch.setenv("PATHWAY_MESH_LIVENESS_TIMEOUT_MS", "700")
+    base = _free_port()
+    meshes = [None, None]
+
+    def build(pid):
+        meshes[pid] = hx.HostMesh(2, pid, base, connect_timeout=30.0)
+
+    threads = [threading.Thread(target=build, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    m0, m1 = meshes
+    assert m0 is not None and m1 is not None
+    failures: list = []
+    try:
+        m0.add_failure_listener(lambda peer, reason: failures.append(
+            (peer, reason)
+        ))
+        # wedge peer 1 WITHOUT closing its sockets: stop its heartbeat
+        # loop (and senders' will to live) — sockets stay open, so only
+        # the liveness monitor can catch this
+        m1._closed = True
+        deadline = time.monotonic() + 10
+        while not failures and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert failures, "liveness monitor never fired"
+        peer, reason = failures[0]
+        assert peer == 1 and "liveness timeout" in reason
+    finally:
+        m1._closed = False
+        m0.close()
+        m1.close()
+
+
+# --- serving degradation controller ---------------------------------------
+
+
+def test_degrade_controller_state_and_staleness():
+    from pathway_tpu.serving import degrade
+
+    degrade.reset()
+    try:
+        assert degrade.recovering() is None
+        degrade.enter_recovery("peer 1 failed: test")
+        degrade.enter_recovery("replay")
+        assert degrade.recovering() == "peer 1 failed: test"  # oldest
+        degrade.exit_recovery("peer 1 failed: test")
+        assert degrade.recovering() == "replay"
+        degrade.exit_recovery("replay")
+        assert degrade.recovering() is None
+        assert degrade.staleness_seconds() is None  # no index registered
+        degrade.mark_fresh()
+        s = degrade.staleness_seconds()
+        assert s is not None and s < 1.0
+        calls = []
+        degrade.register_stale_responder("/r", lambda vals: calls.append(
+            vals
+        ) or {"ok": 1})
+        assert degrade.stale_responder("/r")({"q": 2}) == {"ok": 1}
+        assert degrade.stale_responder("/other") is None
+    finally:
+        degrade.reset()
+
+
+def test_stale_knn_search_uses_registered_index():
+    from pathway_tpu.serving import degrade
+
+    degrade.reset()
+    try:
+        with pytest.raises(RuntimeError):
+            degrade.stale_knn_search([("q", 1, None)])
+
+        class FakeIndex:
+            def search(self, triples):
+                return [((7, 0.5),) for _ in triples]
+
+        class FakeExec:
+            index = FakeIndex()
+
+        holder = FakeExec()
+        degrade.register_index_reader(holder)
+        assert degrade.stale_knn_search([("q", 1, None)]) == [((7, 0.5),)]
+        assert degrade.staleness_seconds() is not None
+    finally:
+        degrade.reset()
+
+
+def test_rest_serves_stale_during_recovery():
+    """Phoenix degradation e2e: while recovery is active, a Surge-Gated
+    endpoint answers from the registered stale responder with explicit
+    staleness headers, honors x-pathway-max-staleness-ms, and flips back
+    to the live engine path when recovery ends."""
+    import requests
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.http import rest_connector
+    from pathway_tpu.serving import QoSConfig, degrade, drain_all
+
+    degrade.reset()
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    port = _free_port()
+    queries, writer = rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=QuerySchema,
+        route="/echo",
+        qos=QoSConfig(max_batch_size=4, max_wait_ms=5),
+    )
+    writer(queries.select(query_id=queries.id, result=queries.text))
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{port}/echo"
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if requests.post(
+                    url, json={"text": "up"}, timeout=5
+                ).status_code == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("server did not come up")
+
+        degrade.enter_recovery("chaos test")
+        degrade.mark_fresh()
+        # no responder yet: explicit 503, never a hang
+        r = requests.post(url, json={"text": "x"}, timeout=10)
+        assert r.status_code == 503
+        assert r.headers.get("x-pathway-stale") == "true"
+        assert "Retry-After" in r.headers
+
+        degrade.register_stale_responder(
+            "/echo", lambda vals: {"stale_echo": vals.get("text")}
+        )
+        r = requests.post(url, json={"text": "y"}, timeout=10)
+        assert r.status_code == 200
+        assert r.json() == {"stale_echo": "y"}
+        assert r.headers.get("x-pathway-stale") == "true"
+        assert float(r.headers["x-pathway-staleness-seconds"]) >= 0.0
+
+        # bounded staleness: snapshot is fresh, generous bound passes
+        r = requests.post(
+            url,
+            json={"text": "z"},
+            headers={"x-pathway-max-staleness-ms": "60000"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        # zero bound always sheds (staleness > 0 by the time we check)
+        r = requests.post(
+            url,
+            json={"text": "w"},
+            headers={"x-pathway-max-staleness-ms": "0"},
+            timeout=10,
+        )
+        assert r.status_code == 503
+        assert "Retry-After" in r.headers
+
+        degrade.exit_recovery("chaos test")
+        r = requests.post(url, json={"text": "live"}, timeout=30)
+        assert r.status_code == 200
+        assert r.headers.get("x-pathway-stale") is None
+    finally:
+        degrade.reset()
+        drain_all()
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+        t.join(timeout=30)
+
+
+# --- single-process chaos smoke: torn snapshot -----------------------------
+
+_TORN_WORKER = textwrap.dedent(
+    """
+    import os, json, pathlib, threading, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    base = pathlib.Path(os.environ["PW_TEST_DIR"])
+    out_file = base / ("out_%s.jsonl" % os.environ["PW_PHASE"])
+    stop_file = base / "STOP"
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(str(base / "in"), schema=S, mode="streaming")
+    r = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    pw.io.jsonlines.write(r, str(out_file))
+
+    def watch():
+        while True:
+            time.sleep(0.05)
+            if stop_file.exists():
+                rt = pw.internals.parse_graph.G.runtime
+                if rt is not None:
+                    rt.stop()
+                return
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(base / "pstorage")),
+        snapshot_every=1,
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+    print("CLEAN-EXIT", flush=True)
+    """
+)
+
+
+def _fold_counts(paths) -> dict:
+    state: dict = {}
+    for p in paths:
+        try:
+            lines = open(p).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            o = json.loads(line)
+            if o["diff"] > 0:
+                state[o["word"]] = o["count"]
+            elif state.get(o["word"]) == o["count"]:
+                del state[o["word"]]
+    return state
+
+
+def test_single_process_torn_snapshot_recovers(tmp_path):
+    """Chaos smoke (tier-1 safe, one process at a time): Fault Forge
+    kills the run between segment writes and the metadata commit (torn
+    snapshot); the restart recovers from the previous consistent cut +
+    log tail and converges on exactly the uninterrupted run's totals."""
+    base = tmp_path / "work"
+    (base / "in").mkdir(parents=True)
+    script = tmp_path / "worker.py"
+    script.write_text(_TORN_WORKER)
+
+    def write_words(fname, words):
+        with open(base / "in" / fname, "w") as f:
+            for w in words:
+                f.write(json.dumps({"word": w}) + "\n")
+
+    def run_phase(phase, fault=None, timeout=90):
+        env = dict(os.environ)
+        env.update(
+            PW_TEST_DIR=str(base),
+            PW_PHASE=phase,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
+        )
+        env.pop("PATHWAY_FAULTS", None)
+        if fault:
+            env["PATHWAY_FAULTS"] = fault
+        proc = subprocess.Popen(
+            [sys.executable, str(script)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        out = proc.communicate(timeout=timeout)[0]
+        return proc.returncode, out
+
+    write_words("f1.jsonl", ["a", "b", "a", "c", "a", "b"])
+    rc, out = run_phase("1", fault="torn=nth:1")
+    assert rc == faults.FAULT_EXIT, out[-2000:]
+    assert "CLEAN-EXIT" not in out
+
+    write_words("f2.jsonl", ["b", "d", "a"])
+    expected = {"a": 4, "b": 3, "c": 1, "d": 1}
+
+    stop = threading.Thread(
+        target=lambda: _await_fold_then_stop(base, expected), daemon=True
+    )
+    stop.start()
+    rc, out = run_phase("2", timeout=120)
+    stop.join(timeout=60)
+    assert rc == 0, out[-3000:]
+    assert "CLEAN-EXIT" in out
+    merged = _fold_counts(
+        [base / "out_1.jsonl", base / "out_2.jsonl"]
+    )
+    assert merged == expected
+
+
+def _await_fold_then_stop(base, expected, deadline_s=60):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if _fold_counts(
+            [base / "out_1.jsonl", base / "out_2.jsonl"]
+        ) == expected:
+            break
+        time.sleep(0.2)
+    (base / "STOP").touch()

@@ -1,4 +1,4 @@
-"""DCN wire codec (parallel/wire.py) + PWHX6 mesh behaviors: bit-exact
+"""DCN wire codec (parallel/wire.py) + PWHX mesh behaviors: bit-exact
 columnar roundtrips vs the pickle path, opt-in quantization, the
 version-mismatch fast-fail handshake, and the overlapped per-peer
 outbox."""
@@ -327,7 +327,7 @@ def test_decode_rejects_garbage():
         wire.decode_frame(wire.FRAME_CODEC + b"\x99short")
 
 
-# --- mesh integration: PWHX6 handshake + overlapped outbox -----------------
+# --- mesh integration: PWHX7 handshake + overlapped outbox -----------------
 
 
 def _free_port_pair() -> int:
@@ -432,7 +432,7 @@ def test_acceptor_detects_old_dialer_and_aborts_own_dial(monkeypatch):
         resp += chunk
     dialer.close()
     assert resp[: len(hx._VREJECT_TAG)] == hx._VREJECT_TAG
-    assert b"PWHX6" in resp
+    assert hx._HELLO_MAGIC in resp
     th.join(20)
     assert err_holder, "constructor should have aborted on version skew"
     assert isinstance(err_holder[0], hx.HostMeshError)
