@@ -1945,6 +1945,406 @@ def _bench_chaos_recovery(np):
     return out
 
 
+
+
+def _serve_chaos_load_phase(
+    np, router_port, workers, duration_s, n_docs, surge_period_s=None
+):
+    """Closed-loop load through the failover router: zipf-distributed
+    tenants over a million-user population, diurnal surge (a sinusoidal
+    activity factor gates how many workers are awake at once — the
+    scaled-down stand-in for the day/night traffic swing), per-request
+    deadline header.  Returns sustained QPS over the SERVED requests,
+    latency percentiles, the shed mix, and the error count (the
+    acceptance bar: error_served == 0 — shed only via explicit
+    429/503)."""
+    import threading
+
+    import requests
+
+    if surge_period_s is None:
+        surge_period_s = max(duration_s / 2.0, 2.0)
+    url = "http://127.0.0.1:%d/query" % router_port
+    served: list = []
+    statuses: dict = {}
+    lock = threading.Lock()
+    t_start = time.perf_counter()
+    stop_at = t_start + duration_s
+    tenants = 1_000_000
+
+    def worker(wid: int) -> None:
+        rng = np.random.default_rng(wid)
+        sess = requests.Session()
+        while time.perf_counter() < stop_at:
+            # diurnal surge: worker wid sleeps through the "night"
+            # fraction of the sinusoid — offered load swings between
+            # ~20% and 100% of the fleet
+            phase = (time.perf_counter() - t_start) / surge_period_s
+            activity = 0.6 + 0.4 * np.sin(2 * np.pi * phase)
+            if (wid + 0.5) / workers > activity:
+                time.sleep(0.02)
+                continue
+            tenant = int(rng.zipf(1.2)) % tenants
+            t0 = time.perf_counter()
+            try:
+                r = sess.post(
+                    url,
+                    json={
+                        "query": "doc %d" % (tenant % n_docs),
+                        "k": 8,
+                        "tenant": tenant,
+                    },
+                    headers={"x-pathway-deadline-ms": "8000"},
+                    timeout=10,
+                )
+                code = r.status_code
+            except Exception:
+                code = 0
+            dt_ms = (time.perf_counter() - t0) * 1000
+            with lock:
+                statuses[code] = statuses.get(code, 0) + 1
+                if code == 200:
+                    served.append(dt_ms)
+            if code in (429, 503):
+                time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    total = sum(statuses.values())
+    shed = sum(statuses.get(c, 0) for c in (429, 503))
+    errors = total - shed - len(served)
+    return {
+        "workers": workers,
+        "duration_s": round(elapsed, 2),
+        "qps": round(len(served) / elapsed, 1) if elapsed else 0.0,
+        "p50_ms": round(float(np.percentile(served, 50)), 3)
+        if served
+        else None,
+        "p99_ms": round(float(np.percentile(served, 99)), 3)
+        if served
+        else None,
+        "shed_rate": round(shed / total, 4) if total else 0.0,
+        "error_served": errors,
+        "status_counts": {str(k): v for k, v in sorted(statuses.items())},
+    }
+
+
+def _bench_serve_chaos(np):
+    """Replica Shield tier: the million-user serving simulation (CPU
+    smoke scale).  One writer pipeline streams consolidated index
+    deltas to GATED read replicas (each behind a Surge-Gate admission
+    envelope — PATHWAY_SERVING_RPS per replica, the per-instance
+    capacity-protection a production replica runs with); a failover
+    router balances a zipf-tenant, diurnal-surge closed loop over
+    them, with the offered load sized well beyond one gate's capacity.
+    Phases: `single` = router over ONE gated replica (the gate sheds
+    the excess explicitly); `replicated` = three gated replicas
+    absorbing the same offered load, with a Fault-Forge kill of
+    replica 1 mid-run and a Phoenix-Mesh supervised restart —
+    reporting sustained QPS, p50/p99, shed rate, error-served (must be
+    0) and the restarted replica's recovery-to-fresh seconds.
+
+    Host caveat recorded in the output: on a core-bound smoke box the
+    UNGATED aggregate is capped by raw CPU, so the scaling evidence is
+    the gated-capacity ratio (replicated_vs_single_qps) plus the raw
+    cpu_cores count for context."""
+    import pathlib
+    import secrets
+    import shutil
+    import socket as socket_mod
+    import tempfile
+    import threading
+
+    import requests
+
+    from pathway_tpu.observability import tracing as _tracing
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.serving.router import FailoverRouter
+    from pathway_tpu.testing.chaos import free_dcn_port
+
+    DIM = 64
+    N_DOCS = 24_000
+    workers = 12
+    phase_s = 8.0
+    # per-replica capacity envelope, sized so the closed-loop offered
+    # load (~70-110/s on the 2-core smoke box) saturates ONE gate with
+    # explicit shed while three gates absorb it — the horizontal-
+    # capacity evidence; on real hardware raise it toward the ungated
+    # per-replica ceiling
+    replica_rps = 25.0
+    base = pathlib.Path(tempfile.mkdtemp(prefix="pw-serve-chaos-"))
+    out: dict = {
+        "tenant_population": 1_000_000,
+        "n_docs": N_DOCS,
+        "dim": DIM,
+        "workers": workers,
+        "replica_gate_rps": replica_rps,
+        "cpu_cores": os.cpu_count(),
+    }
+    # span recording off for the load phases: the 2-core smoke box
+    # must spend its cycles serving, not tracing (the failover tests
+    # assert the stitched retry trace; the bench asserts throughput)
+    _tracer_was = _tracing.get_tracer().enabled
+    _tracing.get_tracer().enabled = False
+    writer = None
+    sups: list = []
+    sup_threads: list = []
+    routers: list = []
+    trickle_stop = threading.Event()
+    try:
+        (base / "docs").mkdir(parents=True)
+        (base / "q").mkdir()
+        with open(base / "docs" / "seed.jsonl", "w") as f:
+            for i in range(N_DOCS):
+                f.write(json.dumps({"text": "doc %d" % i}) + "\n")
+        repl_port = free_dcn_port(1)
+        http_ports = [free_dcn_port(1) for _ in range(3)]
+        env_common = {
+            "PW_WRITER_DIR": str(base),
+            "PATHWAY_DCN_SECRET": secrets.token_hex(16),
+            "PATHWAY_REPLICA_DIM": str(DIM),
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TRACING": "0",
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        }
+        script = base / "writer.py"
+        from pathway_tpu.testing.chaos import REPL_WRITER_SCRIPT
+
+        script.write_text(REPL_WRITER_SCRIPT)
+        writer_env = dict(os.environ)
+        writer_env.update(env_common)
+        writer_env["PATHWAY_REPL_PORT"] = str(repl_port)
+        t_boot = time.monotonic()
+        writer = subprocess.Popen(
+            [sys.executable, str(script)],
+            env=writer_env,
+            stdout=open(base / "writer.log", "wb"),
+            stderr=subprocess.STDOUT,
+        )
+        deadline = time.monotonic() + 180
+        up = False
+        while time.monotonic() < deadline:
+            s = socket_mod.socket()
+            try:
+                s.connect(("127.0.0.1", repl_port))
+                up = True
+                break
+            except OSError:
+                time.sleep(0.5)
+            finally:
+                s.close()
+        if not up:
+            raise RuntimeError(
+                "writer never opened the delta stream: "
+                + (base / "writer.log").read_text()[-2000:]
+            )
+        out["writer_boot_s"] = round(time.monotonic() - t_boot, 2)
+
+        def start_replica(rid: int, fault: str | None = None):
+            renv = dict(env_common)
+            renv["PATHWAY_REPLICA_ID"] = str(rid)
+            renv["PATHWAY_REPLICA_STORE"] = str(base / "pstorage")
+            renv["PATHWAY_REPL_PORT"] = str(repl_port)
+            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(http_ports[rid])
+            # the replica's Surge-Gate capacity envelope (per-instance
+            # rate protection): the offered load exceeds ONE gate, so
+            # horizontal capacity is the thing being measured
+            renv["PATHWAY_SERVING_ENABLED"] = "1"
+            renv["PATHWAY_SERVING_RPS"] = str(replica_rps)
+            renv["PATHWAY_SERVING_BURST"] = "15"
+            if fault:
+                renv["PATHWAY_FAULTS"] = fault
+            sup = GroupSupervisor(
+                [sys.executable, "-m", "pathway_tpu.serving.replica"],
+                1,
+                env=renv,
+                max_restarts=2,
+                backoff_s=0.2,
+                log_dir=str(base / ("replica%d-logs" % rid)),
+            )
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            sups.append(sup)
+            sup_threads.append(th)
+            return sup
+
+        def health(rid):
+            try:
+                return requests.get(
+                    "http://127.0.0.1:%d/replica/health" % http_ports[rid],
+                    timeout=2,
+                ).json()
+            except Exception:
+                return None
+
+        def wait_ready(rids, timeout=240):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                hs = {rid: health(rid) for rid in rids}
+                if all(
+                    h is not None and h.get("ready") for h in hs.values()
+                ):
+                    return hs
+                time.sleep(0.5)
+            raise RuntimeError(
+                "replicas never became ready: %r" % (hs,)
+            )
+
+        # Corpus churn cadence: ONE doc per second.  Every upsert
+        # invalidates the replica's prepared device corpus (DeviceCorpus
+        # re-preps on the next search), so the churn rate sets how often
+        # queries pay that re-prep — 1/s amortizes it across the whole
+        # second of queries, the realistic live-index regime.  The tick
+        # cadence doubles as the deterministic clock for the Fault-Forge
+        # replica kill (each trickled doc = one applied delta tick).
+        trickle_i = [0]
+
+        def trickle(seconds: float):
+            deadline = time.monotonic() + seconds
+            while not trickle_stop.is_set() and time.monotonic() < deadline:
+                trickle_i[0] += 1
+                with open(
+                    base / "docs" / ("t%d.jsonl" % trickle_i[0]), "w"
+                ) as f:
+                    f.write(
+                        json.dumps(
+                            {"text": "doc %d" % (trickle_i[0] % N_DOCS)}
+                        )
+                        + "\n"
+                    )
+                trickle_stop.wait(1.0)
+
+        # --- phase 1: single replica -----------------------------------
+        t0 = time.monotonic()
+        start_replica(0)
+        wait_ready([0])
+        out["replica0_boot_to_fresh_s"] = round(time.monotonic() - t0, 2)
+        router1 = FailoverRouter(
+            ["http://127.0.0.1:%d" % http_ports[0]],
+            health_interval_ms=200,
+        ).start()
+        routers.append(router1)
+        out["single"] = _serve_chaos_load_phase(
+            np, router1.port, workers, phase_s, N_DOCS
+        )
+        router1.stop()
+
+        # --- phase 2: three replicas + mid-run kill of replica 1 -------
+        # replica 1 exits (FAULT_EXIT) after applying its 10th delta
+        # tick.  It subscribes with only the handful of seed ticks to
+        # replay, so the 1-doc/s trickle below walks it to the kill
+        # threshold a few seconds INTO the load phase; the supervisor
+        # restarts it (incarnation 1 runs fault-free) and it
+        # re-hydrates + replays back to freshness mid-load.
+        start_replica(1, fault="kill=replica:1,tick:10")
+        start_replica(2)
+        wait_ready([1, 2])
+        router3 = FailoverRouter(
+            ["http://127.0.0.1:%d" % p for p in http_ports],
+            health_interval_ms=200,
+        ).start()
+        routers.append(router3)
+        ejections: list = []
+        router3.add_failure_listener(
+            lambda name, why: ejections.append(
+                (time.monotonic(), name, why)
+            )
+        )
+        load_result: dict = {}
+        repl_phase_s = phase_s * 3
+
+        def run_load():
+            load_result.update(
+                _serve_chaos_load_phase(
+                    np, router3.port, workers, repl_phase_s, N_DOCS
+                )
+            )
+
+        load_t = threading.Thread(target=run_load)
+        load_t.start()
+        threading.Thread(
+            target=trickle, args=(repl_phase_s,), daemon=True
+        ).start()
+        # watch for the injected death + the supervised recovery
+        died_at = readmitted_at = None
+        deadline = time.monotonic() + repl_phase_s + 120
+        while time.monotonic() < deadline:
+            if died_at is None:
+                died = [
+                    e for e in sups[1].events if e[1] == "rank-died"
+                ]
+                if died:
+                    died_at = died[0][0]
+            if died_at is not None:
+                h1 = health(1)
+                if (
+                    h1 is not None
+                    and h1.get("incarnation", 0) >= 1
+                    and h1.get("ready")
+                ):
+                    readmitted_at = time.monotonic()
+                    break
+            time.sleep(0.2)
+        load_t.join(timeout=repl_phase_s + 60)
+        out["replicated"] = load_result
+        out["chaos"] = {
+            "replica_killed": died_at is not None,
+            "kill_exit_code_23": any(
+                "exited 23" in e[2]
+                for e in sups[1].events
+                if e[1] == "rank-died"
+            ),
+            "supervised_restarts": sups[1].restarts_used,
+            "router_ejections": [
+                {"replica": name, "reason": why.split(":")[0]}
+                for _ts, name, why in ejections
+            ],
+            "recovery_to_fresh_s": (
+                round(readmitted_at - died_at, 2)
+                if died_at is not None and readmitted_at is not None
+                else None
+            ),
+        }
+        if out["single"]["qps"] and load_result.get("qps"):
+            out["replicated_vs_single_qps"] = round(
+                load_result["qps"] / out["single"]["qps"], 2
+            )
+            if out["single"]["p99_ms"] and load_result.get("p99_ms"):
+                out["replicated_vs_single_p99"] = round(
+                    out["single"]["p99_ms"] / load_result["p99_ms"], 2
+                )
+        out["error_served_total"] = out["single"][
+            "error_served"
+        ] + load_result.get("error_served", 1)
+        return out
+    finally:
+        _tracing.get_tracer().enabled = _tracer_was
+        trickle_stop.set()
+        (base / "STOP").touch()
+        for router in routers:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        for sup in sups:
+            sup.stop()
+        for th in sup_threads:
+            th.join(timeout=30)
+        if writer is not None:
+            writer.terminate()
+            try:
+                writer.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                writer.kill()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main() -> None:
     import numpy as np
 
@@ -2088,6 +2488,15 @@ def main() -> None:
         extra["chaos_recovery"] = _bench_chaos_recovery(np)
     except Exception as e:
         errors.append(f"chaos-recovery:{type(e).__name__}:{e}")
+
+    try:
+        # Replica Shield tier: writer + 3 read replicas + failover
+        # router under zipf/diurnal load with a supervised mid-run
+        # replica kill — sustained QPS vs single-replica, shed mix,
+        # error_served (must be 0), recovery-to-fresh seconds
+        extra["serve_chaos"] = _bench_serve_chaos(np)
+    except Exception as e:
+        errors.append(f"serve-chaos:{type(e).__name__}:{e}")
 
     try:
         extra["rag_e2e_qps"] = round(_bench_rag_qps(np, on_accel), 1)
@@ -2240,6 +2649,19 @@ if __name__ == "__main__":
         import numpy as _np
 
         print(json.dumps(_bench_checkpoint_recovery(_np), indent=2))
+    elif sys.argv[1:] == ["serve_chaos"]:
+        # standalone tier run; also records the SERVE_rNN.json artifact
+        import numpy as _np
+
+        _serve = _bench_serve_chaos(_np)
+        _doc = {"tier": "serve_chaos", **_serve}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "SERVE_r10.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
     elif sys.argv[1:] == ["chaos_recovery"]:
         # standalone tier run; also records the CHAOS_rNN.json artifact
         import numpy as _np

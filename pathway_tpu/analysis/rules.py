@@ -440,6 +440,81 @@ def serving_admission(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# 5a. replicated serving (Replica Shield)
+
+
+@rule("unreplicated-serving")
+def unreplicated_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """A gated REST ingress serving an external index with NO stale
+    read path and NO replica set: during any recovery window (peer
+    failure, restore replay, supervised restart) every read hard-503s
+    for the whole window — the Surge Gate can shed politely, but
+    nothing can answer.  PR 8's stale responder or a Replica Shield
+    replica set (serving/replica.py + serving/router.py) each close the
+    gap; INFO when replicas exist but nothing bounds staleness, so a
+    partitioned writer silently serves ever-older data."""
+    import os
+
+    from pathway_tpu.engine.index_node import ExternalIndexNode
+
+    index_nodes = [
+        n for n in facts.order if isinstance(n, ExternalIndexNode)
+    ]
+    if not index_nodes:
+        return
+    replicas = [
+        u
+        for u in os.environ.get("PATHWAY_SERVING_REPLICAS", "").split(",")
+        if u.strip()
+    ]
+    from pathway_tpu.serving import degrade
+
+    for node in facts.order:
+        if not isinstance(node, InputNode):
+            continue
+        subject = getattr(getattr(node, "source", None), "subject", None)
+        if subject is None or type(subject).__name__ != "RestServerSubject":
+            continue
+        if getattr(subject, "_qos", None) is None:
+            continue  # ungated ingress is serving-admission's finding
+        route = getattr(subject, "_route", "/")
+        if not replicas and degrade.stale_responder(route) is None:
+            yield Diagnostic(
+                "unreplicated-serving",
+                Severity.WARNING,
+                f"gated REST ingress {route!r} serves an external index "
+                "with no stale responder registered and no replica set "
+                "configured: every read hard-503s for the entire "
+                "recovery window (restore replay, peer failure, "
+                "supervised restart)",
+                node,
+                fix_hint="register a degraded answer path with "
+                "pathway_tpu.serving.degrade.register_stale_responder("
+                f"{route!r}, fn), or configure read replicas "
+                "(PATHWAY_SERVING_REPLICAS + serving/replica.py) behind "
+                "the failover router",
+                data={"route": route, "index_nodes": len(index_nodes)},
+            )
+        elif replicas and not os.environ.get(
+            "PATHWAY_SERVING_MAX_STALENESS_MS", ""
+        ):
+            yield Diagnostic(
+                "unreplicated-serving",
+                Severity.INFO,
+                f"REST ingress {route!r} has {len(replicas)} replica(s) "
+                "configured but max-staleness is unbounded: a "
+                "partitioned or dead writer keeps serving ever-older "
+                "answers with no shed point",
+                node,
+                fix_hint="set PATHWAY_SERVING_MAX_STALENESS_MS (or have "
+                "clients send x-pathway-max-staleness-ms) so reads past "
+                "the freshness bound shed explicitly with 503 + "
+                "Retry-After",
+                data={"route": route, "replicas": len(replicas)},
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5b. recoverability (Phoenix Mesh)
 
 

@@ -154,6 +154,17 @@ class _InnerArrangedMixin:
             arrs,
         )
 
+    def check_arranged_state(self, residual, arrangements) -> bool:
+        """Pre-mutation restore validation passes through the wrapper
+        to the inner exec (e.g. a sharded inner validating its shard
+        count against the snapshot's)."""
+        check = getattr(self.inner, "check_arranged_state", None)
+        if check is None:
+            return True
+        return check(
+            residual.get("__dcn_inner__", residual), arrangements
+        )
+
     def load_arranged_state(self, residual, arrangements) -> None:
         if "__dcn_inner__" in residual:
             self._load_wrapper_residual(residual.get("__dcn_extra__", {}))

@@ -14,7 +14,7 @@ from typing import Any, Protocol, Sequence
 
 import numpy as np
 
-from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.batch import END_OF_TIME, DiffBatch
 from pathway_tpu.engine.nodes import Node, NodeExec, _concat_inputs
 from pathway_tpu.internals.api import Pointer
 from pathway_tpu.internals.errors import record_error
@@ -108,6 +108,13 @@ class ExternalIndexExec(NodeExec):
 
         self._degrade = _degrade
         _degrade.register_index_reader(self)
+        # Replica Shield: when this process is the replication WRITER
+        # (PATHWAY_REPL_PORT set), every tick's consolidated corpus
+        # deltas stream to the read replicas (parallel/replicate.py);
+        # the resolved None costs one attribute check per tick otherwise
+        from pathway_tpu.parallel import replicate as _replicate
+
+        self._repl = _replicate.publisher()
 
     def state_dict(self) -> dict:
         # indexes holding device arrays expose their own host-side snapshot;
@@ -174,6 +181,7 @@ class ExternalIndexExec(NodeExec):
         # (replay ticks rebuild state while the REST handler reads it):
         # the shared guard serializes them. Uncontended cost is one
         # RLock acquire per tick.
+        repl_rows: list[tuple[int, int, tuple]] = []
         with self._degrade.index_guard:
             for b in inputs[0]:
                 for k, d, vals in b.iter_rows():
@@ -189,11 +197,31 @@ class ExternalIndexExec(NodeExec):
                             self.index.upsert(k, vals[self.d_data], meta)
                         except Exception as exc:
                             record_error(exc, str(node))
+                            continue  # a row the writer's index rejected
+                            # must not reach the replicas either
+                        if self._repl is not None:
+                            repl_rows.append((k, 1, (vals[self.d_data], meta)))
                     else:
                         self.index.remove(k)
+                        if self._repl is not None:
+                            repl_rows.append((k, -1, (None, None)))
         # the engine is ticking this node: whatever the corpus now holds
         # is as fresh as the stream — restart the staleness clock
         self._degrade.mark_fresh()
+        if self._repl is not None and t < END_OF_TIME:
+            # consolidated per-tick deltas to the read replicas; idle
+            # ticks publish an empty marker so replica freshness tracks
+            # the writer's tick cadence, not just corpus churn
+            from pathway_tpu.parallel.replicate import consolidate_rows
+
+            batches = []
+            if repl_rows:
+                batches.append(
+                    DiffBatch.from_rows(
+                        consolidate_rows(repl_rows), ("_data", "_meta")
+                    )
+                )
+            self._repl.publish(t, batches)
         # Surge Gate deadline propagation: queries whose REST deadline
         # already expired answer empty WITHOUT a device search — the
         # client got its 504, so the top-k would burn a batch slot for a

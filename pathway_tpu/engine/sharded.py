@@ -288,6 +288,60 @@ class _ShardedExec(NodeExec):
             if st:
                 ex.load_state(st)
 
+    # --- incremental (arrangement-backed) snapshots ---------------------
+    # Mirror of engine/dcn.py's _InnerArrangedMixin for the device-mesh
+    # layer: delegate the State Ledger protocol to every shard's inner
+    # exec, namespacing each shard's arrangement parts as "s<i>.<name>"
+    # so segment identity (and so bytes ∝ churn) is stable per shard
+    # across restarts.  Without this, device-mesh runs fell back to the
+    # monolithic state_dict pickle — the ROADMAP-verified gap that also
+    # blocked fast replica hydration of sharded graphs.
+
+    def enable_state_ledger(self) -> None:
+        for ex in self.shards:
+            hook = getattr(ex, "enable_state_ledger", None)
+            if hook is not None:
+                hook()
+
+    def arranged_state(self):
+        per_shard = []
+        for ex in self.shards:
+            fn = getattr(ex, "arranged_state", None)
+            arranged = fn() if fn is not None else None
+            if arranged is None:
+                # ANY shard on the monolith path forces the whole exec
+                # monolithic — a mixed snapshot could not restore
+                # consistently (the generation names one blob per node)
+                return None
+            per_shard.append(arranged)
+        arrs: dict[str, Any] = {}
+        for i, (_res, shard_arrs) in enumerate(per_shard):
+            for name, arr in shard_arrs.items():
+                arrs[f"s{i}.{name}"] = arr
+        return (
+            {"__shard_residuals__": [res for res, _a in per_shard]},
+            arrs,
+        )
+
+    def check_arranged_state(self, residual, arrangements) -> bool:
+        """Pre-mutation restore validation (persistence glue calls this
+        before ANY exec mutates): a snapshot taken under a different
+        shard count cannot restore — the per-shard key partition no
+        longer matches — so recovery must fall back to log replay over
+        pristine fresh state instead of loading a mis-partitioned
+        subset."""
+        shards = residual.get("__shard_residuals__")
+        return isinstance(shards, list) and len(shards) == len(self.shards)
+
+    def load_arranged_state(self, residual, arrangements) -> None:
+        residuals = residual["__shard_residuals__"]
+        per: list[dict] = [{} for _ in self.shards]
+        for key, arr in arrangements.items():
+            shard, _, name = key.partition(".")
+            per[int(shard[1:])][name] = arr
+        for ex, res, shard_arrs in zip(self.shards, residuals, per):
+            ex.load_arranged_state(res, shard_arrs)
+
 
 class ShardedGroupByExec(_ShardedExec):
     """groupby-reduce with per-shard disjoint state: rows are exchanged to

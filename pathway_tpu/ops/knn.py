@@ -165,7 +165,10 @@ def shard_base_indices(n: int, n_shards: int) -> np.ndarray:
 )
 def _sharded_topk_impl(queries, corpus, valid, base_idx, k, metric, bf16, mesh, axis):
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from pathway_tpu.parallel.collectives import _shard_map_compat
+
+    shard_map, check_kw = _shard_map_compat()
 
     def local(q, c, v, b):
         s = _scores(q, c, metric, bf16)
@@ -186,7 +189,7 @@ def _sharded_topk_impl(queries, corpus, valid, base_idx, k, metric, bf16, mesh, 
         mesh=mesh,
         in_specs=(P(), P(axis, None), P(axis), P(axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        **check_kw,
     )(queries, corpus, valid, base_idx)
 
 

@@ -87,8 +87,11 @@ def _next_pow2(x: int) -> int:
 def _impl(n_shards: int, capacity: int, mesh: Any, axis: str):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from pathway_tpu.parallel.collectives import _shard_map_compat
+
+    shard_map, check_kw = _shard_map_compat()
 
     def local(words, dst):
         # words: [per, W] i32; dst: [per] i32 (-1 = padding row)
@@ -122,7 +125,7 @@ def _impl(n_shards: int, capacity: int, mesh: Any, axis: str):
         mesh=mesh,
         in_specs=(P(axis, None), P(axis)),
         out_specs=P(axis, None),
-        check_vma=False,
+        **check_kw,
     )
 
 

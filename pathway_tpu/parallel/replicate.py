@@ -1,0 +1,802 @@
+"""Replica Shield wire tier — one writer streaming consolidated per-tick
+index deltas to N read replicas over the PWHX7-family framed protocol.
+
+The replicated read plane (ROADMAP "Replicated serving plane") splits the
+serving topology into three roles: ONE writer (the engine process that
+owns the index and the persistence store), N READ REPLICAS
+(serving/replica.py — hydrate from the newest committed snapshot
+generation, then apply this stream), and a failover ROUTER
+(serving/router.py).  This module is the writer↔replica wire:
+
+* ``DeltaStreamServer`` runs inside the writer.  The engine's
+  ``ExternalIndexExec`` publishes its per-tick consolidated corpus
+  deltas (``publish``); the server appends them to a bounded
+  retained-delta ring and fans them out to every subscribed replica
+  over per-subscriber bounded outboxes drained by sender threads — the
+  same overlap/fail-stop shape as the host mesh's per-peer outboxes
+  (parallel/host_exchange.py).  Frames reuse the typed columnar codec
+  (parallel/wire.py): a delta frame IS a mesh data frame
+  ``("data", 0, "repl:<node>", tick, [DiffBatch], tp)``, so key/diff
+  packing and the embedding-column stacking apply unchanged.
+
+* ``DeltaStreamClient`` runs inside each replica.  It dials the writer
+  (jittered backoff, HMAC challenge-response under the same
+  PATHWAY_DCN_SECRET job key as the mesh), subscribes from its
+  snapshot's tick, replays the ring tail, then applies live frames.
+  When the requested tick has already fallen off the ring the server
+  answers ``resync`` and the replica re-hydrates from the (by now
+  newer) snapshot generation instead — the bounded-ring contract of
+  the tentpole: replay when cheap, full re-hydrate when not.
+
+Freshness: every frame carries the writer's newest published tick, and
+idle ticks still emit (empty) tick markers, so a replica always knows
+whether it is caught up; heartbeats keep that knowledge fresh on idle
+streams and double as the liveness signal for a dead/partitioned
+writer.  The replica-side staleness clock
+(``pathway_replica_staleness_seconds``) restarts whenever the replica
+confirms ``applied_tick == newest_tick``.
+
+Fault Forge: the sender loop runs every data frame through the same
+``on_wire_send(channel)`` hook as the mesh, so
+``drop/dup/delay=ch:repl...`` directives target the delta stream with
+the familiar deterministic counters.
+
+Authentication matches the mesh's threat model: delta frames carry
+pickled control frames and codec batches, so every connection performs
+the nonce challenge-response under the per-job shared secret and every
+frame MAC covers (src, dst, seq, body).  A replica cannot be framed
+dead by a forged frame, and unauthenticated bytes never reach
+``pickle.loads``.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from pathway_tpu.parallel import wire
+from pathway_tpu.parallel.host_exchange import (
+    _MAC_LEN,
+    _NONCE_LEN,
+    _REJECT,
+    _frame_mac,
+    _job_key,
+)
+
+_REPL_MAGIC = b"PWRP1"  # replication protocol v1 (sits beside the mesh's
+# PWHX7: a replica is NOT a mesh rank — it never joins barriers — so the
+# subscription stream gets its own handshake magic and version lane)
+_OK_TAG = b"PWRO"
+
+REPL_CHANNEL = "repl:idx"  # delta frames' wire channel (Fault Forge
+# directives match it by prefix: drop/dup/delay=ch:repl)
+
+
+def ring_ticks_env() -> int:
+    """Bounded retained-delta ring depth (ticks), PATHWAY_REPL_RING
+    (default 1024).  A replica whose subscription tick fell off the ring
+    full-re-hydrates from the newest snapshot generation instead."""
+    raw = os.environ.get("PATHWAY_REPL_RING", "1024") or "1024"
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PATHWAY_REPL_RING={raw!r} is not an int"
+        ) from None
+    return max(n, 1)
+
+
+class ReplicationError(RuntimeError):
+    pass
+
+
+def _shutdown_close(conn: socket.socket) -> None:
+    """shutdown() BEFORE close(): a plain close() while another thread
+    is blocked in recv() on the same socket keeps the file description
+    alive (the in-flight syscall holds it), so no FIN ever reaches the
+    peer and both sides hang; shutdown() tears the connection down at
+    the description level, waking every blocked reader."""
+    try:
+        conn.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
+def _read_exact(conn: socket.socket, count: int) -> bytes | None:
+    buf = b""
+    while len(buf) < count:
+        try:
+            chunk = conn.recv(count - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _Subscriber:
+    """One connected replica: bounded outbox + sender thread owning the
+    connection's MAC sequence (frames leave in enqueue order)."""
+
+    __slots__ = (
+        "conn",
+        "replica_id",
+        "outbox",
+        "backlog",
+        "thread",
+        "dead",
+        "from_tick",
+    )
+
+    def __init__(self, conn: socket.socket, replica_id: int, depth: int):
+        self.conn = conn
+        self.replica_id = replica_id
+        self.outbox: queue.Queue = queue.Queue(maxsize=depth)
+        # ring-replay frames (suback first), sent by the sender thread
+        # BEFORE it starts draining the outbox: the backlog can exceed
+        # the outbox bound (up to ring_ticks entries), so it must never
+        # go through put_nowait — a deep rejoin used to crash the
+        # handshake thread with queue.Full and livelock the replica
+        self.backlog: list[tuple] = []
+        self.thread: threading.Thread | None = None
+        self.dead = False
+        self.from_tick = -1
+
+
+class DeltaStreamServer:
+    """Writer-side delta publisher: bounded retained ring + fan-out.
+
+    ``publish(tick, batches)`` is called from the engine thread once per
+    tick (idle ticks publish an empty marker so replicas track
+    freshness); subscribers receive every published tick newer than
+    their subscription point.  A subscriber that cannot keep up (full
+    outbox) is dropped — it reconnects and replays the ring, or
+    re-hydrates if it fell past the ring floor.  Never blocks the
+    engine tick."""
+
+    def __init__(
+        self,
+        port: int,
+        host: str = "127.0.0.1",
+        ring_ticks: int | None = None,
+        outbox_depth: int = 256,
+    ):
+        self.host = host
+        self.port = port
+        self._key = _job_key()
+        self.ring_ticks = (
+            ring_ticks_env() if ring_ticks is None else max(int(ring_ticks), 1)
+        )
+        self._outbox_depth = max(int(outbox_depth), 8)
+        self._lock = threading.Lock()
+        # (tick, [DiffBatch]) newest-last; floor = newest tick whose
+        # deltas are UNAVAILABLE (evicted from the ring, or covered only
+        # by the snapshot generation a restarted writer restored from —
+        # set_floor) — a subscription from below the floor must full-
+        # re-hydrate.  A fresh writer's floor stays -1: no ticks existed
+        # before its first publish, so the ring IS complete history and
+        # a from_tick=-1 subscriber replays it instead of resyncing.
+        self._ring: deque[tuple[int, list]] = deque()
+        self._floor = -1
+        self._newest = -1
+        self._subs: list[_Subscriber] = []
+        self._closed = False
+        hb_ms = float(
+            os.environ.get("PATHWAY_REPL_HEARTBEAT_MS", "1000") or 1000
+        )
+        self.heartbeat_s = max(hb_ms, 50.0) / 1000.0
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_published = REGISTRY.counter(
+            "pathway_repl_ticks_published_total",
+            "delta-stream ticks published by the writer (empty markers "
+            "included)",
+        )
+        self._m_delta_rows = REGISTRY.counter(
+            "pathway_repl_delta_rows_total",
+            "consolidated corpus delta rows streamed to replicas",
+        )
+        self._m_resyncs = REGISTRY.counter(
+            "pathway_repl_resyncs_total",
+            "subscriptions answered with a resync (requested tick fell "
+            "off the bounded retained-delta ring)",
+        )
+        self._m_subs = REGISTRY.gauge(
+            "pathway_repl_subscribers",
+            "replicas currently subscribed to the delta stream",
+        )
+        self._m_subs.set_function(lambda: len(self._subs))
+        self._m_dropped = REGISTRY.counter(
+            "pathway_repl_subscribers_dropped_total",
+            "replica subscriptions dropped (EOF, send failure, or a "
+            "full outbox — the replica reconnects and replays)",
+        )
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self.port = self._listener.getsockname()[1]  # resolve port 0
+        self._listener.listen(16)
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="pw-repl-accept"
+        ).start()
+        threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="pw-repl-hb"
+        ).start()
+
+    # --- writer-side API --------------------------------------------------
+
+    def publish(self, tick: int, batches: list) -> None:
+        """Append one tick's consolidated deltas (possibly empty) to the
+        ring and fan out.  Engine-thread hot path: O(subscribers) queue
+        puts, no I/O (sender threads own the sockets)."""
+        with self._lock:
+            if self._closed:
+                return
+            if tick <= self._newest:
+                # a second index node publishing the same lockstep tick:
+                # merge into the existing ring entry so replay stays
+                # one-entry-per-tick
+                for i in range(len(self._ring) - 1, -1, -1):
+                    if self._ring[i][0] == tick:
+                        self._ring[i][1].extend(batches)
+                        break
+            else:
+                self._ring.append((tick, list(batches)))
+                self._newest = tick
+                while len(self._ring) > self.ring_ticks:
+                    evicted, _b = self._ring.popleft()
+                    self._floor = max(self._floor, evicted)
+            subs = list(self._subs)
+        self._m_published.inc()
+        rows = sum(len(b) for b in batches)
+        if rows:
+            self._m_delta_rows.inc(rows)
+        frame = ("data", 0, REPL_CHANNEL, tick, list(batches), None)
+        for sub in subs:
+            self._offer(sub, frame)
+
+    def newest_tick(self) -> int:
+        return self._newest
+
+    def set_floor(self, tick: int) -> None:
+        """A restarted writer restored operator state at ``tick``: every
+        delta at or before it exists only inside that snapshot
+        generation, so subscriptions from below must full-re-hydrate.
+        Called by the persistence glue before replay re-publishes the
+        log tail (monotone — the floor never moves back)."""
+        with self._lock:
+            self._floor = max(self._floor, int(tick))
+
+    def _offer(self, sub: _Subscriber, frame: tuple) -> None:
+        """Non-blocking enqueue: a replica that cannot drain its outbox
+        is dropped (it will reconnect and ring-replay) — the writer's
+        tick cadence is never hostage to a slow replica."""
+        if sub.dead:
+            return
+        try:
+            sub.outbox.put_nowait(frame)
+        except queue.Full:
+            self._drop(sub, "outbox full (replica too slow)")
+
+    def _drop(self, sub: _Subscriber, reason: str) -> None:
+        with self._lock:
+            if sub.dead:
+                return
+            sub.dead = True
+            if sub in self._subs:
+                self._subs.remove(sub)
+        self._m_dropped.inc()
+        if not self._closed:
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "delta stream: dropped replica %d subscription (%s)",
+                sub.replica_id,
+                reason,
+            )
+        try:
+            sub.outbox.put_nowait(None)  # sender exit sentinel
+        except queue.Full:
+            pass
+        _shutdown_close(sub.conn)
+
+    # --- wiring -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        """Nonce challenge-response (mesh-grade), then the replica's
+        subscription frame, then register + backlog replay."""
+        try:
+            nonce = os.urandom(_NONCE_LEN)
+            conn.settimeout(30.0)
+            conn.sendall(nonce)
+            hello = _read_exact(conn, len(_REPL_MAGIC) + 12 + _MAC_LEN)
+            if hello is None or hello[: len(_REPL_MAGIC)] != _REPL_MAGIC:
+                conn.close()
+                return
+            claimed, mac = hello[:-_MAC_LEN], hello[-_MAC_LEN:]
+            if not hmac.compare_digest(
+                mac, hmac.new(self._key, claimed + nonce, "sha256").digest()
+            ):
+                try:
+                    conn.sendall(_REJECT)
+                except OSError:
+                    pass
+                conn.close()
+                return
+            replica_id, from_tick = struct.unpack(
+                "<iq", claimed[len(_REPL_MAGIC) :]
+            )
+            conn.sendall(
+                hmac.new(
+                    self._key, _OK_TAG + nonce + claimed, "sha256"
+                ).digest()
+            )
+            conn.settimeout(None)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        sub = _Subscriber(conn, replica_id, self._outbox_depth)
+        sub.from_tick = from_tick
+        with self._lock:
+            if self._closed:
+                conn.close()
+                return
+            resync = from_tick < self._floor
+            # the boundary tick (== from_tick) replays too: a second
+            # index node publishing the same lockstep tick merges into
+            # the existing ring entry, and per-tick consolidated deltas
+            # are idempotent state ops (last-op-per-key), so re-applying
+            # the boundary is safe and never loses the merged tail
+            backlog = (
+                []
+                if resync
+                else [e for e in self._ring if e[0] >= from_tick]
+            )
+            # registered (and backlog captured) under the lock: a publish
+            # racing this subscription lands in the outbox, which the
+            # sender drains only AFTER the backlog, so the replica sees
+            # ticks in order
+            self._subs.append(sub)
+            sub.backlog.append(
+                ("suback", self._newest, self._floor, bool(resync))
+            )
+            if resync:
+                self._m_resyncs.inc()
+            for tick, batches in backlog:
+                sub.backlog.append(
+                    ("data", 0, REPL_CHANNEL, tick, list(batches), None)
+                )
+        sub.thread = threading.Thread(
+            target=self._sender_loop,
+            args=(sub,),
+            daemon=True,
+            name=f"pw-repl-send-{replica_id}",
+        )
+        sub.thread.start()
+        # reader side only watches for EOF (the replica never sends data
+        # frames after the subscription) so a vanished replica is
+        # unsubscribed promptly instead of on the next full outbox
+        threading.Thread(
+            target=self._watch_eof, args=(sub,), daemon=True
+        ).start()
+
+    def _watch_eof(self, sub: _Subscriber) -> None:
+        _read_exact(sub.conn, 1)  # returns on EOF/error
+        self._drop(sub, "replica closed the subscription")
+
+    def _sender_loop(self, sub: _Subscriber) -> None:
+        from pathway_tpu.testing import faults
+
+        plan = faults.active()
+        seq = 0
+        backlog = sub.backlog
+        sub.backlog = []
+        while True:
+            if backlog:
+                frame = backlog.pop(0)
+            else:
+                frame = sub.outbox.get()
+            if frame is None or sub.dead:
+                return
+            try:
+                repeats = 1
+                if plan is not None and frame[0] == "data":
+                    action = plan.on_wire_send(str(frame[2]))
+                    if action is not None:
+                        if action[0] == "drop":
+                            continue
+                        if action[0] == "dup":
+                            repeats = 2
+                        elif action[0] == "delay":
+                            time.sleep(action[1])
+                body, _stats = wire.encode_frame(frame, "codec", None)
+                for _ in range(repeats):
+                    mac = _frame_mac(self._key, 0, sub.replica_id, seq, body)
+                    seq += 1
+                    sub.conn.sendall(
+                        struct.pack("<I", len(body)) + mac + body
+                    )
+            except Exception as e:  # OSError or encode bug: fail-stop
+                self._drop(sub, f"send failed: {e}")
+                return
+
+    def _heartbeat_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self.heartbeat_s)
+            if self._closed:
+                return
+            with self._lock:
+                subs = list(self._subs)
+                newest = self._newest
+            for sub in subs:
+                self._offer(sub, ("hb", newest))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            subs = list(self._subs)
+            self._subs.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for sub in subs:
+            sub.dead = True
+            try:
+                sub.outbox.put_nowait(None)
+            except queue.Full:
+                pass
+            _shutdown_close(sub.conn)
+
+
+# --- process-global writer publisher ---------------------------------------
+# Armed by PATHWAY_REPL_PORT on the writer process: ExternalIndexExec
+# publishes its per-tick corpus deltas here (engine/index_node.py), and
+# replicas subscribe at <host>:<port>.  None everywhere else — the hook
+# costs one cached is-None check per tick.
+
+_publisher: DeltaStreamServer | None = None
+_publisher_resolved = False
+_publisher_lock = threading.Lock()
+
+
+def publisher() -> DeltaStreamServer | None:
+    """The writer's process-global delta-stream server (lazily bound
+    from PATHWAY_REPL_PORT; PATHWAY_REPL_HOST overrides the bind host),
+    or None when this process is not a replication writer."""
+    global _publisher, _publisher_resolved
+    if _publisher_resolved:
+        return _publisher
+    with _publisher_lock:
+        if not _publisher_resolved:
+            raw = os.environ.get("PATHWAY_REPL_PORT", "")
+            if raw:
+                try:
+                    port = int(raw)
+                except ValueError:
+                    raise ReplicationError(
+                        f"PATHWAY_REPL_PORT={raw!r} is not an int"
+                    ) from None
+                _publisher = DeltaStreamServer(
+                    port,
+                    host=os.environ.get(
+                        "PATHWAY_REPL_HOST", "127.0.0.1"
+                    ),
+                )
+            _publisher_resolved = True
+    return _publisher
+
+
+def reset_publisher() -> None:
+    """Test hook: close and forget the process-global publisher."""
+    global _publisher, _publisher_resolved
+    with _publisher_lock:
+        if _publisher is not None:
+            _publisher.close()
+        _publisher = None
+        _publisher_resolved = False
+
+
+class DeltaStreamClient:
+    """Replica-side subscriber: dial, subscribe from a tick, replay the
+    ring tail, apply live frames; reconnect (from the last applied tick)
+    on writer death; full-re-hydrate on resync.
+
+    Callbacks (all invoked on the client's reader thread):
+
+    * ``on_deltas(tick, batches)`` — apply one tick's consolidated
+      deltas (batches may be empty: a freshness marker).
+    * ``on_resync() -> int`` — the requested tick fell off the writer's
+      ring: re-hydrate from the newest snapshot generation and return
+      the new subscription tick.
+    * ``on_applied(tick, n_applied)`` — after each applied tick (the
+      Fault Forge's replica-kill hook rides here).
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        replica_id: int,
+        from_tick: int,
+        on_deltas: Callable[[int, list], None],
+        on_resync: Callable[[], int] | None = None,
+        on_applied: Callable[[int, int], None] | None = None,
+        connect_timeout: float = 60.0,
+    ):
+        self.host = host
+        self.port = port
+        self.replica_id = int(replica_id)
+        self.from_tick = int(from_tick)
+        self.on_deltas = on_deltas
+        self.on_resync = on_resync
+        self.on_applied = on_applied
+        self.connect_timeout = connect_timeout
+        self._key = _job_key()
+        self._closed = False
+        self._conn: socket.socket | None = None
+        self.applied_tick = int(from_tick)
+        self.applied_count = 0  # ticks applied since process start (the
+        # deterministic counter kill=replica:N,tick:T fires on)
+        self.newest_known = -1
+        self.resyncs = 0
+        self.connected = False
+        # caught_up: applied_tick has reached the stream head at least
+        # once since the current subscription — the freshness bound a
+        # replica must clear before the router re-admits it
+        self.caught_up = False
+        self._fresh_at: float | None = None
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    # --- freshness --------------------------------------------------------
+
+    def staleness_seconds(self) -> float | None:
+        """Seconds since this replica last confirmed it was caught up
+        with the writer's newest published tick (None until the first
+        catch-up).  A connected, caught-up replica reads 0.0 — the
+        clock only runs while the replica is behind the stream head or
+        cut off from the writer.  Mirrors serving/degrade.py's
+        staleness clock."""
+        with self._lock:
+            if (
+                self.connected
+                and self.caught_up
+                and self.newest_known <= self.applied_tick
+            ):
+                return 0.0
+            if self._fresh_at is None:
+                return None
+            return max(0.0, time.monotonic() - self._fresh_at)
+
+    def _note_progress(self) -> None:
+        with self._lock:
+            if self.newest_known <= self.applied_tick:
+                self.caught_up = True
+                self._fresh_at = time.monotonic()
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name=f"pw-repl-client-{self.replica_id}",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._closed = True
+        conn = self._conn
+        if conn is not None:
+            _shutdown_close(conn)
+
+    def _dial(self) -> socket.socket | None:
+        import random as _random
+
+        deadline = time.monotonic() + self.connect_timeout
+        attempt = 0
+        while not self._closed and time.monotonic() < deadline:
+            s: socket.socket | None = None
+            try:
+                s = socket.create_connection(
+                    (self.host, self.port), timeout=5.0
+                )
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(10.0)
+                nonce = _read_exact(s, _NONCE_LEN)
+                if nonce is None:
+                    raise OSError("writer closed during handshake")
+                hello = _REPL_MAGIC + struct.pack(
+                    "<iq", self.replica_id, self.from_tick
+                )
+                s.sendall(
+                    hello
+                    + hmac.new(self._key, hello + nonce, "sha256").digest()
+                )
+                ok = _read_exact(s, _MAC_LEN)
+                if ok is None:
+                    raise OSError("writer closed during handshake")
+                if ok == _REJECT:
+                    s.close()
+                    raise ReplicationError(
+                        f"replica {self.replica_id}: writer rejected the "
+                        "subscription — authentication failed (is "
+                        "PATHWAY_DCN_SECRET identical on the writer and "
+                        "every replica?)"
+                    )
+                expected = hmac.new(
+                    self._key, _OK_TAG + nonce + hello, "sha256"
+                ).digest()
+                if not hmac.compare_digest(ok, expected):
+                    raise OSError("unexpected handshake response")
+                s.settimeout(None)
+                return s
+            except OSError:
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                attempt += 1
+                backoff = min(2.0, 0.05 * (2 ** min(attempt, 6)))
+                time.sleep(backoff * (0.5 + _random.random()))
+        return None
+
+    def _run(self) -> None:
+        while not self._closed:
+            conn = self._dial()
+            if conn is None:
+                if self._closed:
+                    return
+                # writer unreachable within the budget: keep trying —
+                # the replica serves (increasingly stale) reads
+                # meanwhile, and the router's staleness bound decides
+                # admission
+                continue
+            self._conn = conn
+            with self._lock:
+                self.connected = True
+                self.caught_up = False
+            try:
+                self._read_stream(conn)
+            finally:
+                with self._lock:
+                    self.connected = False
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+                # reconnect from whatever we applied last
+                self.from_tick = self.applied_tick
+
+    def _read_stream(self, conn: socket.socket) -> None:
+        recv_seq = 0
+        while not self._closed:
+            head = _read_exact(conn, 4 + _MAC_LEN)
+            if head is None:
+                return
+            (length,) = struct.unpack("<I", head[:4])
+            body = _read_exact(conn, length)
+            if body is None:
+                return
+            if not hmac.compare_digest(
+                head[4:],
+                _frame_mac(self._key, 0, self.replica_id, recv_seq, body),
+            ):
+                return  # forged/replayed frame: drop the link, redial
+            recv_seq += 1
+            try:
+                frame = wire.decode_frame(body)
+            except Exception:
+                return  # corrupt frame: fail-stop this link, redial
+            kind = frame[0]
+            if kind == "hb":
+                with self._lock:
+                    self.newest_known = max(self.newest_known, frame[1])
+                self._note_progress()
+            elif kind == "suback":
+                _k, newest, _floor, resync = frame
+                with self._lock:
+                    self.newest_known = max(self.newest_known, newest)
+                if resync:
+                    self.resyncs += 1
+                    if self.on_resync is None:
+                        # no re-hydrate path: accept the gap (at-least-
+                        # once corpus; the snapshotless caller asked for
+                        # whatever the ring still holds)
+                        self.from_tick = self.applied_tick
+                        continue
+                    new_tick = int(self.on_resync())
+                    if new_tick <= self.from_tick or new_tick < _floor:
+                        # the store has no newer generation yet (e.g.
+                        # the writer restarted and has not snapshotted
+                        # past its restore point): wait for one instead
+                        # of hot-looping dial->resync->dial
+                        time.sleep(0.5)
+                    self.from_tick = max(self.from_tick, new_tick)
+                    self.applied_tick = max(self.applied_tick, new_tick)
+                    return  # redial with the new subscription tick
+                self._note_progress()
+            elif kind == "data":
+                _k, _src, _channel, tick, batches, _tp = frame
+                if tick < self.applied_tick:
+                    continue  # writer-restart overlap: already applied
+                # tick == applied_tick is NOT skipped: a second index
+                # node publishing the same lockstep tick, and the
+                # boundary tick of a reconnect replay, both arrive as
+                # equal-tick frames — consolidated per-tick deltas are
+                # idempotent state ops, so re-applying is safe and
+                # skipping would lose the merged tail
+                try:
+                    self.on_deltas(tick, batches)
+                except Exception:
+                    # an apply failure must not kill the reader thread
+                    # (the replica would zombie: alive, serving ever-
+                    # staler reads, never reconnecting).  Fail-stop the
+                    # link like a corrupt frame: redial replays from
+                    # applied_tick, re-attempting this tick.
+                    import logging
+
+                    logging.getLogger("pathway_tpu").exception(
+                        "replica %d: applying delta tick %d failed; "
+                        "dropping the subscription to retry",
+                        self.replica_id,
+                        tick,
+                    )
+                    time.sleep(0.5)  # a deterministic failure must
+                    # not hot-loop dial->apply->fail
+                    return
+                self.applied_tick = tick
+                self.applied_count += 1
+                with self._lock:
+                    self.newest_known = max(self.newest_known, tick)
+                self._note_progress()
+                if self.on_applied is not None:
+                    self.on_applied(tick, self.applied_count)
+
+
+def consolidate_rows(rows: list[tuple[int, int, tuple]]) -> list:
+    """Collapse one tick's raw corpus updates to per-key FINAL ops (the
+    "consolidated per-tick deltas" of the tentpole): the last op per key
+    wins, upsert-after-remove collapses to the upsert, and emission
+    order is the order keys were last touched — so a replica applying
+    the result converges to the same corpus as applying the raw stream.
+
+    Returns ``pickle``-free row tuples ready for DiffBatch.from_rows.
+    """
+    final: dict[int, tuple[int, int, tuple]] = {}
+    for row in rows:
+        k = row[0]
+        final.pop(k, None)  # re-insert to keep last-touch order
+        final[k] = row
+    return list(final.values())

@@ -36,6 +36,7 @@ import random
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Callable, Mapping
 
@@ -85,6 +86,15 @@ class GroupSupervisor:
         self.events: list[tuple[float, str, str]] = []
         self.last_codes: list[int | None] = []
         self._rng = random.Random(0xF0E1)
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        """Ask a running :meth:`run` loop (e.g. on another thread — the
+        replica supervisors in the chaos bench) to SIGTERM the current
+        group and return.  A group whose ranks serve until terminated
+        (read replicas) has no natural all-exited-0 end, so the owner
+        drives shutdown explicitly."""
+        self._stop.set()
 
     def _event(self, kind: str, detail: str) -> None:
         self.events.append((time.monotonic(), kind, detail))
@@ -147,6 +157,13 @@ class GroupSupervisor:
             procs = self._spawn_group(incarnation)
             failed: int | None = None
             while True:
+                if self._stop.is_set():
+                    self._terminate(procs)
+                    self.last_codes = [p.returncode for p in procs]
+                    self._event(
+                        "group-stopped", f"incarnation {incarnation}"
+                    )
+                    return 0
                 codes = [p.poll() for p in procs]
                 bad = [
                     (i, c) for i, c in enumerate(codes) if c not in (None, 0)

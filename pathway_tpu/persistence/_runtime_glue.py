@@ -838,6 +838,18 @@ class PersistenceDriver:
                 )
             if chosen is not None:
                 state_time = self._restore_operators(chosen)
+        # Replica Shield: a restarted WRITER restored the index at
+        # state_time — ticks at or before it are not in the (fresh)
+        # delta ring, only in the snapshot generation; tell the
+        # publisher before replay re-publishes the log tail, so replica
+        # subscriptions from older ticks resync instead of silently
+        # missing the gap
+        if state_time >= 0:
+            from pathway_tpu.parallel import replicate
+
+            pub = replicate.publisher()
+            if pub is not None:
+                pub.set_floor(state_time)
         # receiver-side floor: drop exchanged partitions already covered
         # by this process's restored state
         if hm is not None and state_time >= 0:
@@ -987,6 +999,26 @@ class PersistenceDriver:
                         cls,
                         ident,
                         exc_info=True,
+                    )
+                    if self.selective:
+                        continue
+                    return -1
+                check = getattr(ex, "check_arranged_state", None)
+                if check is not None and not check(
+                    state["residual"], arrs
+                ):
+                    # structural mismatch the class-name check cannot
+                    # see (e.g. PATHWAY_ENGINE_SHARDS changed between
+                    # runs): surfaced BEFORE any exec mutates, so the
+                    # fallback replays the log over pristine state
+                    import logging
+
+                    logging.getLogger("pathway_tpu").warning(
+                        "snapshot for node %s (%s) does not match the "
+                        "current execution layout; falling back to log "
+                        "replay",
+                        cls,
+                        ident,
                     )
                     if self.selective:
                         continue

@@ -31,7 +31,28 @@ from pathway_tpu.serving.gate import (
 )
 from pathway_tpu.serving import degrade
 
+# Replica Shield (serving/replica.py, serving/router.py) is NOT eagerly
+# imported: the replica/router roles pull aiohttp and the replication
+# wire, which the engine layer (which imports this package on every
+# run) never needs.  `ReplicaServer` / `FailoverRouter` resolve lazily.
+_LAZY = {
+    "ReplicaServer": ("pathway_tpu.serving.replica", "ReplicaServer"),
+    "FailoverRouter": ("pathway_tpu.serving.router", "FailoverRouter"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])
+
+
 __all__ = [
+    "FailoverRouter",
+    "ReplicaServer",
     "AdmissionController",
     "DeadlineExceeded",
     "MicroBatcher",

@@ -1,0 +1,640 @@
+"""Replica Shield read replicas — the horizontal read plane.
+
+A replica is a NEW process role beside the lockstep mesh group: it runs
+no engine graph and joins no barriers.  It holds a full copy of the
+serving index, built in two steps and kept fresh by a third:
+
+1. **Hydrate** from the newest committed snapshot generation in the
+   writer's persistence store (``hydrate_index_state`` walks the PR-8
+   retained-generation list newest-first and loads the
+   ``ExternalIndexNode`` state blob — the same artifact the PR-7 mmap
+   recovery path restores), giving the corpus as of the snapshot's
+   tick.
+2. **Subscribe** to the writer's delta stream
+   (parallel/replicate.py) from that tick: the ring tail replays, then
+   live consolidated per-tick deltas apply.  A subscription that fell
+   off the writer's bounded ring answers ``resync`` and the replica
+   re-hydrates from the (by now newer) generation instead.
+3. **Serve** reads over HTTP with explicit freshness: every response
+   carries ``x-pathway-replica`` / ``x-pathway-applied-tick`` /
+   ``x-pathway-staleness-seconds``, stale answers add
+   ``x-pathway-stale: true``, and a request's
+   ``x-pathway-max-staleness-ms`` bound sheds with 503 + Retry-After
+   instead of silently serving older data — the same header contract
+   PR 8's degraded single-process path established
+   (serving/degrade.py), now per replica.
+
+Freshness for ROUTING: ``ready`` is True only once the replica has
+caught up with the writer's newest published tick since its current
+subscription — a restarted replica is only re-admitted by the failover
+router (serving/router.py) after it clears this bound.
+
+Observability: ``pathway_replica_staleness_seconds`` (gauge, labeled by
+replica), ``pathway_replica_applied_tick``, request/shed counters.
+Monotone ``applied_tick`` is exported on every response and in
+``GET /replica/health``.
+
+``python -m pathway_tpu.serving.replica`` runs the env-configured KNN
+replica (TpuDenseKnnIndex + the deterministic ``text_vector``
+pseudo-embedder) — the role the chaos bench and the multi-process tests
+spawn under the Phoenix Mesh supervisor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+_STALE_AFTER_MS_ENV = "PATHWAY_REPLICA_STALE_AFTER_MS"
+
+
+def text_vector(text: str, dim: int) -> np.ndarray:
+    """Deterministic pseudo-embedding: the same text always maps to the
+    same unit vector, on the writer and on every replica — so the
+    replicated serving plane (and its tests/bench) needs no shared
+    encoder weights.  Not a semantic embedder; similar ONLY for equal
+    text prefixes by construction (chunks are seeded per token)."""
+    acc = np.zeros(dim, dtype=np.float64)
+    for i, tok in enumerate(str(text).split() or [""]):
+        seed = hashlib.blake2b(
+            f"{i}:{tok}".encode(), digest_size=8
+        ).digest()
+        rng = np.random.default_rng(int.from_bytes(seed, "little"))
+        acc += rng.standard_normal(dim)
+    norm = float(np.linalg.norm(acc))
+    if norm > 0:
+        acc /= norm
+    return acc.astype(np.float32)
+
+
+def hydrate_index_state(
+    store: Any, node_class: str = "ExternalIndexNode"
+) -> tuple[Any, int, int] | None:
+    """Load the newest committed index snapshot from a writer's
+    persistence store: ``(index_state, tick, gen)`` or None when no
+    generation holds an index yet.
+
+    Candidates are walked newest-first — the current ``state`` then the
+    PR-8 ``retained_states`` list (legacy ``prev_state``) — so a torn
+    latest generation degrades to the previous committed one instead of
+    failing the hydrate, mirroring the group-min restore."""
+    from pathway_tpu.persistence._runtime_glue import (
+        PersistenceDriver,
+        _META_KEY,
+    )
+
+    raw = store.get(_META_KEY)
+    if raw is None:
+        return None
+    meta = json.loads(raw.decode())
+    candidates = [meta.get("state")]
+    candidates += [
+        r.get("state") for r in reversed(meta.get("retained_states", []))
+    ]
+    if meta.get("prev_state"):
+        candidates.append(meta["prev_state"])
+    seen: set[int] = set()
+    for snap in candidates:
+        if not snap or int(snap.get("gen", -1)) in seen:
+            continue
+        gen = int(snap["gen"])
+        seen.add(gen)
+        for ident, cls in snap.get("nodes", {}).items():
+            if cls != node_class:
+                continue
+            blob = store.get(PersistenceDriver._state_key(gen, ident))
+            if blob is None:
+                continue  # torn generation: fall back to an older one
+            state = pickle.loads(blob)
+            if not isinstance(state, dict) or "index_state" not in state:
+                continue
+            return (
+                state["index_state"],
+                int(snap.get("time", 0)),
+                gen,
+            )
+    return None
+
+
+_M: dict | None = None
+
+
+def _metrics() -> dict:
+    global _M
+    if _M is None:
+        from pathway_tpu.observability import REGISTRY
+
+        _M = {
+            "staleness": REGISTRY.gauge(
+                "pathway_replica_staleness_seconds",
+                "seconds since this replica last confirmed it was caught "
+                "up with the writer's newest published tick, by replica",
+                labelnames=("replica",),
+            ),
+            "applied": REGISTRY.gauge(
+                "pathway_replica_applied_tick",
+                "newest writer tick this replica has applied (monotone)",
+                labelnames=("replica",),
+            ),
+            "requests": REGISTRY.counter(
+                "pathway_replica_requests_total",
+                "read requests served by this replica, by status class",
+                labelnames=("replica", "status"),
+            ),
+            "resyncs": REGISTRY.counter(
+                "pathway_replica_resyncs_total",
+                "full re-hydrates (subscription fell off the writer's "
+                "retained-delta ring)",
+                labelnames=("replica",),
+            ),
+        }
+    return _M
+
+
+def default_knn_responder(server: "ReplicaServer", values: dict) -> dict:
+    """Answer a KNN read against the replica's corpus: ``vec`` (raw
+    query vector) or ``query`` (text through :func:`text_vector`), plus
+    ``k``.  Matches return as ``[key, score]`` pairs, best first."""
+    k = int(values.get("k", 3))
+    if values.get("vec") is not None:
+        vec = np.asarray(values["vec"], dtype=np.float32)
+    else:
+        vec = text_vector(str(values.get("query", "")), server.dim)
+    results = server.search([(vec, k, None)])[0]
+    return {"matches": [[int(key), float(score)] for key, score in results]}
+
+
+class ReplicaServer:
+    """One read replica: hydrated index + delta subscription + HTTP.
+
+    ``index_factory`` builds the (empty) index object; ``store_root``
+    (optional) hydrates it from the writer's persistence store;
+    ``writer_port`` subscribes to the delta stream.  ``responder(server,
+    values) -> payload`` answers one read (default: KNN over ``vec`` /
+    ``query``+``k``).  ``qos`` (a serving.QoSConfig) bounds concurrent
+    reads with the Surge-Gate admission controller — the router load-
+    balances IN FRONT of this gate, so a saturated replica sheds 429
+    and the router steers elsewhere."""
+
+    def __init__(
+        self,
+        *,
+        replica_id: int,
+        index_factory: Callable[[], Any],
+        store_root: str | None = None,
+        writer_host: str = "127.0.0.1",
+        writer_port: int | None = None,
+        http_host: str = "127.0.0.1",
+        http_port: int = 0,
+        route: str = "/query",
+        responder: Callable[["ReplicaServer", dict], Any] | None = None,
+        qos: Any = None,
+        dim: int = 32,
+        stale_after_ms: float | None = None,
+    ):
+        self.replica_id = int(replica_id)
+        self.index_factory = index_factory
+        self.store_root = store_root
+        self.writer_host = writer_host
+        self.writer_port = writer_port
+        self.http_host = http_host
+        self.http_port = http_port
+        self.route = route
+        self.responder = responder or default_knn_responder
+        self.dim = dim
+        if stale_after_ms is None:
+            stale_after_ms = float(
+                os.environ.get(_STALE_AFTER_MS_ENV, "3000") or 3000
+            )
+        self.stale_after_s = max(stale_after_ms, 0.0) / 1000.0
+        self.index = index_factory()
+        self.hydrated_tick = -1
+        self.hydrated_gen = -1
+        self._index_lock = threading.RLock()
+        self._client: Any = None
+        self._closed = False
+        self.incarnation = int(
+            os.environ.get("PATHWAY_MESH_INCARNATION", "0") or 0
+        )
+        m = _metrics()
+        label = str(self.replica_id)
+        self._m_requests = m["requests"]
+        self._m_resyncs = m["resyncs"].labels(label)
+        m["staleness"].labels(label).set_function(
+            lambda: self.staleness_seconds() or 0.0
+        )
+        m["applied"].labels(label).set_function(
+            lambda: float(self.applied_tick)
+        )
+        from pathway_tpu.serving.admission import AdmissionController
+
+        self.admission = (
+            AdmissionController(qos, route=f"replica{self.replica_id}")
+            if qos is not None
+            else None
+        )
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._http = _ReplicaHttp(self)
+
+    # --- state ------------------------------------------------------------
+
+    @property
+    def applied_tick(self) -> int:
+        c = self._client
+        if c is not None:
+            return max(c.applied_tick, self.hydrated_tick)
+        return self.hydrated_tick
+
+    @property
+    def ready(self) -> bool:
+        """Freshness bound for router admission: hydrated AND caught up
+        with the writer's newest published tick since the current
+        subscription.  With no delta stream configured (snapshot-only
+        replica) readiness is just successful hydration."""
+        c = self._client
+        if c is None:
+            return self.hydrated_tick >= 0 or self.writer_port is None
+        return bool(c.caught_up)
+
+    def staleness_seconds(self) -> float | None:
+        c = self._client
+        if c is None:
+            return None
+        return c.staleness_seconds()
+
+    def is_stale(self) -> bool:
+        """A response right now would be stale: never caught up, the
+        catch-up confirmation has aged past the bound (writer dead or
+        partitioned), or the stream is behind."""
+        c = self._client
+        if c is None:
+            return self.writer_port is not None
+        s = c.staleness_seconds()
+        if s is None:
+            return True
+        return s > self.stale_after_s
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ReplicaServer":
+        self.hydrate()
+        if self.writer_port is not None:
+            from pathway_tpu.parallel.replicate import DeltaStreamClient
+
+            self._client = DeltaStreamClient(
+                self.writer_host,
+                self.writer_port,
+                self.replica_id,
+                from_tick=self.hydrated_tick,
+                on_deltas=self._apply_deltas,
+                on_resync=self._resync,
+                on_applied=self._on_applied,
+            )
+            self._client.start()
+        self._http.start()
+        self.http_port = self._http.port
+        return self
+
+    def stop(self) -> None:
+        self._closed = True
+        if self._client is not None:
+            self._client.close()
+        self._http.stop()
+
+    # --- hydrate + deltas -------------------------------------------------
+
+    def _open_store(self):
+        from pathway_tpu.persistence.backends import FilesystemStore
+
+        return FilesystemStore(self.store_root)
+
+    def hydrate(self) -> int:
+        """(Re-)hydrate the index from the newest committed generation;
+        returns the hydrated tick (-1 when no store/snapshot exists —
+        the replica then builds purely from the delta stream)."""
+        if self.store_root is None:
+            return self.hydrated_tick
+        got = hydrate_index_state(self._open_store())
+        if got is None:
+            return self.hydrated_tick
+        index_state, tick, gen = got
+        fresh = self.index_factory()
+        kind, payload = index_state
+        if kind == "dict":
+            fresh.load_state(payload)
+        else:
+            fresh = payload
+        with self._index_lock:
+            self.index = fresh
+            self.hydrated_tick = tick
+            self.hydrated_gen = gen
+        return tick
+
+    def _resync(self) -> int:
+        """Delta-stream callback: the subscription tick fell off the
+        writer's bounded ring — beyond it, full re-hydrate (tentpole
+        contract (c))."""
+        self._m_resyncs.inc()
+        return self.hydrate()
+
+    def _apply_deltas(self, tick: int, batches: list) -> None:
+        with self._index_lock:
+            for b in batches:
+                for k, d, vals in b.iter_rows():
+                    if d > 0:
+                        self.index.upsert(k, vals[0], vals[1])
+                    else:
+                        self.index.remove(k)
+
+    def _on_applied(self, tick: int, n_applied: int) -> None:
+        from pathway_tpu.testing import faults
+
+        plan = faults.active()
+        if plan is not None:
+            plan.on_replica_tick(self.replica_id, n_applied)
+
+    def search(self, triples: list) -> list:
+        with self._index_lock:
+            return self.index.search(triples)
+
+    # --- serving ----------------------------------------------------------
+
+    def health(self) -> dict:
+        c = self._client
+        s = self.staleness_seconds()
+        return {
+            "replica": self.replica_id,
+            "incarnation": self.incarnation,
+            "applied_tick": self.applied_tick,
+            "newest_tick": c.newest_known if c is not None else -1,
+            "staleness_seconds": s,
+            "connected": bool(c.connected) if c is not None else False,
+            "ready": self.ready,
+            "stale": self.is_stale(),
+            "inflight": self._inflight
+            if self.admission is None
+            else self.admission.inflight,
+            "resyncs": c.resyncs if c is not None else 0,
+            "hydrated_gen": self.hydrated_gen,
+        }
+
+    def _count(self, status: int) -> None:
+        self._m_requests.labels(str(self.replica_id), str(status)).inc()
+
+
+class _ReplicaHttp:
+    """The replica's aiohttp front (own loop thread, PathwayWebserver
+    pattern): POST <route> answers reads, GET /replica/health reports
+    freshness for the router's poller."""
+
+    def __init__(self, server: ReplicaServer):
+        self.server = server
+        self.port = server.http_port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_ready = threading.Event()
+        self._stop_async: Any = None
+        self._thread: threading.Thread | None = None
+        self._started = False
+        self._stopped = False
+        self._bound = threading.Event()
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name=f"pw-replica-http-{self.server.replica_id}",
+        )
+        self._thread.start()
+        self._bound.wait(30.0)
+
+    def _run(self) -> None:
+        from aiohttp import web
+
+        srv = self.server
+        app = web.Application()
+
+        async def handle_read(request: web.Request) -> web.Response:
+            return await self._handle_read(request)
+
+        async def handle_health(request: web.Request) -> web.Response:
+            return web.json_response(srv.health())
+
+        app.router.add_post(srv.route, handle_read)
+        app.router.add_get("/replica/health", handle_health)
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        stop_ev = asyncio.Event()
+        self._stop_async = lambda: loop.call_soon_threadsafe(stop_ev.set)
+        self._loop_ready.set()
+
+        async def main():
+            runner = web.AppRunner(app, shutdown_timeout=1.0)
+            await runner.setup()
+            site = web.TCPSite(runner, srv.http_host, self.port)
+            await site.start()
+            self.port = runner.addresses[0][1] if runner.addresses else self.port
+            self._bound.set()
+            if not self._stopped:
+                await stop_ev.wait()
+            await runner.cleanup()
+
+        try:
+            loop.run_until_complete(main())
+        finally:
+            self._bound.set()
+            loop.close()
+
+    async def _handle_read(self, request):
+        from aiohttp import web
+
+        from pathway_tpu.observability import tracing
+
+        srv = self.server
+        span = tracing.get_tracer().span(
+            "replica.request",
+            parent=tracing.parse_traceparent(
+                request.headers.get("traceparent")
+            ),
+            root=True,
+            replica=srv.replica_id,
+            route=srv.route,
+        )
+        with span:
+            status, payload, headers = await self._serve(request)
+            span.set_attribute("status", status)
+        srv._count(status)
+        if span.context is not None:
+            headers["traceparent"] = span.context.traceparent()
+        return web.json_response(payload, status=status, headers=headers)
+
+    async def _serve(self, request) -> tuple[int, Any, dict]:
+        import math
+
+        from pathway_tpu.serving.admission import ShedError
+
+        srv = self.server
+        staleness = srv.staleness_seconds()
+        stale = srv.is_stale()
+        headers = {
+            "x-pathway-replica": str(srv.replica_id),
+            "x-pathway-applied-tick": str(srv.applied_tick),
+            "x-pathway-staleness-seconds": (
+                f"{staleness:.3f}" if staleness is not None else "unknown"
+            ),
+        }
+        if stale:
+            headers["x-pathway-stale"] = "true"
+        # the request's freshness bound: shed explicitly rather than
+        # silently serve data older than the client can accept
+        max_raw = request.headers.get("x-pathway-max-staleness-ms")
+        if max_raw is not None:
+            try:
+                bound_ms = float(max_raw)
+            except ValueError:
+                bound_ms = None
+            if bound_ms is not None and math.isfinite(bound_ms):
+                over = staleness is None or staleness * 1000.0 > bound_ms
+                # a caught-up replica is FRESH (staleness ~0 between
+                # heartbeats) — only shed when genuinely over the bound
+                if over or (bound_ms <= 0.0 and stale):
+                    return (
+                        503,
+                        {
+                            "error": "replica staler than "
+                            "x-pathway-max-staleness-ms",
+                            "replica": srv.replica_id,
+                        },
+                        {"Retry-After": "1.0", **headers},
+                    )
+        if srv.admission is not None:
+            try:
+                srv.admission.admit()
+            except ShedError as e:
+                return (
+                    e.status,
+                    {"error": f"request shed: {e.reason}"},
+                    {"Retry-After": f"{e.retry_after_s:.3f}", **headers},
+                )
+        else:
+            with srv._inflight_lock:
+                srv._inflight += 1
+        try:
+            try:
+                values = await request.json()
+            except ValueError:
+                values = {}
+            loop = asyncio.get_running_loop()
+            payload = await loop.run_in_executor(
+                None, srv.responder, srv, values
+            )
+            return 200, payload, headers
+        except Exception as exc:
+            return (
+                500,
+                {"error": f"{type(exc).__name__}: {exc}"},
+                headers,
+            )
+        finally:
+            if srv.admission is not None:
+                srv.admission.on_flushed(1)
+                srv.admission.complete()
+            else:
+                with srv._inflight_lock:
+                    srv._inflight -= 1
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if not self._started or self._stopped:
+            return
+        self._stopped = True
+        self._loop_ready.wait(timeout)
+        stop_async = self._stop_async
+        if stop_async is not None:
+            try:
+                stop_async()
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+def main() -> int:
+    """Env-configured KNN replica — the subprocess role the chaos bench
+    and the multi-process failover tests spawn (usually under the
+    Phoenix Mesh supervisor for restart-on-kill):
+
+    PATHWAY_REPLICA_ID        this replica's id (default 0)
+    PATHWAY_REPLICA_STORE     writer's persistence root (hydration)
+    PATHWAY_REPL_PORT         writer's delta-stream port
+    PATHWAY_REPL_WRITER_HOST  writer host (default 127.0.0.1)
+    PATHWAY_REPLICA_HTTP_PORT HTTP port (default 0 = ephemeral)
+    PATHWAY_REPLICA_DIM       vector dimensionality (default 32)
+    PATHWAY_REPLICA_ROUTE     read route (default /query)
+
+    Prints ``REPLICA-READY <http_port>`` once serving, then runs until
+    SIGTERM.  Exit code 0 on clean termination; Fault-Forge kills exit
+    with FAULT_EXIT (23) like every injected death.
+    """
+    import signal
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # same guard as bench.py: under the axon sitecustomize the env
+        # route still initializes the tunneled backend; config.update
+        # does not
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
+
+    # the replica's Surge-Gate admission (its serving-capacity
+    # envelope): PATHWAY_SERVING_ENABLED=1 + the standard
+    # PATHWAY_SERVING_* knobs (RPS/BURST/MAX_INFLIGHT...) bound each
+    # replica exactly like a gated writer endpoint — the router
+    # balances IN FRONT of these gates
+    from pathway_tpu.serving import QoSConfig, serving_enabled_via_env
+
+    qos = QoSConfig.from_env() if serving_enabled_via_env() else None
+    dim = int(os.environ.get("PATHWAY_REPLICA_DIM", "32") or 32)
+    writer_port_raw = os.environ.get("PATHWAY_REPL_PORT", "")
+    server = ReplicaServer(
+        replica_id=int(os.environ.get("PATHWAY_REPLICA_ID", "0") or 0),
+        index_factory=lambda: TpuDenseKnnIndex(dimensions=dim),
+        store_root=os.environ.get("PATHWAY_REPLICA_STORE") or None,
+        writer_host=os.environ.get(
+            "PATHWAY_REPL_WRITER_HOST", "127.0.0.1"
+        ),
+        writer_port=int(writer_port_raw) if writer_port_raw else None,
+        http_port=int(
+            os.environ.get("PATHWAY_REPLICA_HTTP_PORT", "0") or 0
+        ),
+        route=os.environ.get("PATHWAY_REPLICA_ROUTE", "/query"),
+        qos=qos,
+        dim=dim,
+    )
+    server.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    signal.signal(signal.SIGINT, lambda *_a: stop.set())
+    print(f"REPLICA-READY {server.http_port}", flush=True)
+    while not stop.is_set():
+        stop.wait(0.2)
+    server.stop()
+    print("REPLICA-CLEAN-EXIT", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

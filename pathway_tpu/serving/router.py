@@ -1,0 +1,627 @@
+"""Replica Shield failover router — deadline-aware, occupancy-weighted
+balancing over the read replicas, IN FRONT of each replica's Surge Gate.
+
+The router is a thin asyncio HTTP proxy holding no index state: it
+forwards each read to the best-qualified replica and turns replica
+failure into a retry instead of a client-visible error.
+
+Routing policy (per request):
+
+* **Qualify** — a replica is eligible when it is not ejected, reports
+  ``ready`` (caught up with the writer since its current subscription —
+  a restarted replica is only re-admitted once it clears this
+  freshness bound) and, when the request carries
+  ``x-pathway-max-staleness-ms``, its last reported staleness fits the
+  bound.  The replica re-checks the bound locally at serve time, so a
+  stale-between-polls replica answers 503 and the router moves on.
+* **Degrade before shed** — when no replica is fresh but some are alive
+  and the request did NOT bound staleness, the router serves from a
+  stale replica (PR 8's stale-responder contract: explicit
+  ``x-pathway-stale`` headers, never silent).  Explicit 503 +
+  ``Retry-After`` goes out only when NO replica qualifies at all.
+* **Pick** — occupancy-weighted: fewest in-flight (router-side counter
+  + the replica's reported admission occupancy), EWMA latency as the
+  tie-break.
+* **Retry** — a transport failure (dead replica: connection refused /
+  reset mid-response) ejects the replica, fires failure listeners
+  (the HostMesh ``add_failure_listener`` contract), and retries the
+  SAME request on a different replica within the ORIGINAL deadline —
+  never the ejected one, at most ``PATHWAY_SERVING_RETRIES`` (default
+  1) extra attempts.  Every attempt is a ``router.attempt`` child span,
+  so the retry hop is visible in the stitched trace.
+* **Hedge** — with ``PATHWAY_SERVING_HEDGE_MS`` set, a primary attempt
+  that has not answered within the hedge budget gets a duplicate on a
+  second replica; the first response wins and the loser is cancelled
+  (duplicate-suppressed — reads are idempotent, exactly one response
+  reaches the client).
+
+Health: a background poller GETs every replica's ``/replica/health``
+each ``PATHWAY_ROUTER_HEALTH_MS`` (heartbeat analog); consecutive
+misses eject.  Ejected replicas keep being polled and re-admit only
+once they report ``ready`` again.
+
+Deadlines: ``x-pathway-deadline-ms`` propagates with the REMAINING
+budget per attempt, so a retried request never outlives its original
+deadline, and the trace context rides ``traceparent`` end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+import time
+from typing import Any, Callable
+
+_FWD_HEADERS = (
+    # request headers forwarded to the replica verbatim
+    "x-pathway-max-staleness-ms",
+    "content-type",
+)
+_BACK_HEADERS = (
+    # response headers surfaced back to the client
+    "x-pathway-replica",
+    "x-pathway-applied-tick",
+    "x-pathway-staleness-seconds",
+    "x-pathway-stale",
+    "retry-after",
+    "content-type",
+)
+
+
+def replicas_from_env() -> list[str]:
+    """PATHWAY_SERVING_REPLICAS: comma-separated replica base URLs
+    (e.g. ``http://127.0.0.1:9101,http://127.0.0.1:9102``)."""
+    raw = os.environ.get("PATHWAY_SERVING_REPLICAS", "")
+    return [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
+
+
+def hedge_ms_env() -> float:
+    raw = os.environ.get("PATHWAY_SERVING_HEDGE_MS", "") or "0"
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        raise ValueError(
+            f"PATHWAY_SERVING_HEDGE_MS={raw!r} is not a number"
+        ) from None
+
+
+class _Transport(Exception):
+    """Replica transport failure (dead/unreachable) — retryable."""
+
+
+class ReplicaEndpoint:
+    """Router-side view of one replica: URL + health + occupancy."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.inflight = 0  # router-side in-flight (attempts)
+        self.reported_inflight = 0  # replica's admission occupancy
+        self.ewma_ms = 0.0
+        self.applied_tick = -1
+        self.staleness_s: float | None = None
+        self.ready = False
+        self.alive = False  # last health poll answered
+        self.ejected = False
+        self.eject_reason = ""
+        self.misses = 0
+
+    def score(self) -> tuple:
+        return (
+            self.inflight + self.reported_inflight,
+            self.ewma_ms,
+            random.random(),
+        )
+
+    def qualifies(self, max_staleness_ms: float | None) -> bool:
+        if self.ejected or not self.ready:
+            return False
+        if max_staleness_ms is None:
+            return True
+        s = self.staleness_s
+        return s is not None and s * 1000.0 <= max_staleness_ms
+
+    def serves_stale(self) -> bool:
+        """Degraded tier: alive (answers health) but not fresh."""
+        return self.alive and not self.ejected
+
+
+class FailoverRouter:
+    def __init__(
+        self,
+        replicas: list[str] | None = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        retries: int | None = None,
+        hedge_ms: float | None = None,
+        health_interval_ms: float | None = None,
+        liveness_misses: int = 3,
+        default_deadline_ms: float = 30_000.0,
+        max_deadline_ms: float = 120_000.0,
+    ):
+        urls = replicas if replicas is not None else replicas_from_env()
+        if not urls:
+            raise ValueError(
+                "FailoverRouter needs at least one replica URL (pass "
+                "replicas=[...] or set PATHWAY_SERVING_REPLICAS)"
+            )
+        self.endpoints = [
+            ReplicaEndpoint(f"replica{i}", u) for i, u in enumerate(urls)
+        ]
+        self.host = host
+        self.port = port
+        if retries is None:
+            retries = int(os.environ.get("PATHWAY_SERVING_RETRIES", "1") or 1)
+        self.retries = max(int(retries), 0)
+        self.hedge_s = (
+            hedge_ms_env() if hedge_ms is None else max(float(hedge_ms), 0.0)
+        ) / 1000.0
+        if health_interval_ms is None:
+            health_interval_ms = float(
+                os.environ.get("PATHWAY_ROUTER_HEALTH_MS", "250") or 250
+            )
+        self.health_interval_s = max(health_interval_ms, 20.0) / 1000.0
+        self.liveness_misses = max(int(liveness_misses), 1)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.max_deadline_ms = float(max_deadline_ms)
+        self._lock = threading.Lock()
+        self._failure_listeners: list[Callable[[str, str], None]] = []
+        self._past_failures: list[tuple[str, str]] = []
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_ready = threading.Event()
+        self._bound = threading.Event()
+        self._stop_async: Any = None
+        self._thread: threading.Thread | None = None
+        self._started = False
+        self._stopped = False
+        from pathway_tpu.observability import REGISTRY
+
+        self._m_requests = REGISTRY.counter(
+            "pathway_router_requests_total",
+            "routed read requests, by chosen replica and outcome "
+            "(ok / shed / stale_shed / error / no_replica)",
+            labelnames=("replica", "outcome"),
+        )
+        self._m_retries = REGISTRY.counter(
+            "pathway_router_retries_total",
+            "same-deadline retries after a replica failed mid-request",
+        )
+        self._m_hedges = REGISTRY.counter(
+            "pathway_router_hedges_total",
+            "hedged duplicates fired after PATHWAY_SERVING_HEDGE_MS, by "
+            "which attempt won",
+            labelnames=("winner",),
+        )
+        self._m_ejections = REGISTRY.counter(
+            "pathway_router_ejections_total",
+            "replica ejections, by replica and reason",
+            labelnames=("replica", "reason"),
+        )
+        self._m_inflight = REGISTRY.gauge(
+            "pathway_router_replica_inflight",
+            "router-side in-flight attempts per replica",
+            labelnames=("replica",),
+        )
+        for ep in self.endpoints:
+            self._m_inflight.labels(ep.name).set_function(
+                lambda ep=ep: ep.inflight
+            )
+
+    # --- failure listeners (HostMesh contract) ----------------------------
+
+    def add_failure_listener(self, fn: Callable[[str, str], None]) -> None:
+        """``fn(replica_name, reason)`` fires at ejection; late
+        registrants replay past ejections (mesh parity)."""
+        with self._lock:
+            self._failure_listeners.append(fn)
+            past = list(self._past_failures)
+        for name, reason in past:
+            try:
+                fn(name, reason)
+            except Exception:
+                pass
+
+    def _eject(self, ep: ReplicaEndpoint, reason: str) -> None:
+        with self._lock:
+            if ep.ejected:
+                return
+            ep.ejected = True
+            ep.ready = False
+            ep.eject_reason = reason
+            listeners = list(self._failure_listeners)
+            self._past_failures.append((ep.name, reason))
+        self._m_ejections.labels(ep.name, reason.split(":")[0]).inc()
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "router: ejected %s (%s)", ep.name, reason
+        )
+        for fn in listeners:
+            try:
+                fn(ep.name, reason)
+            except Exception:
+                pass
+
+    def _readmit(self, ep: ReplicaEndpoint) -> None:
+        with self._lock:
+            if not ep.ejected:
+                return
+            ep.ejected = False
+            ep.eject_reason = ""
+        import logging
+
+        logging.getLogger("pathway_tpu").info(
+            "router: re-admitted %s (fresh at tick %d)",
+            ep.name,
+            ep.applied_tick,
+        )
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "FailoverRouter":
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pw-router"
+        )
+        self._thread.start()
+        self._bound.wait(30.0)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if not self._started or self._stopped:
+            return
+        self._stopped = True
+        self._loop_ready.wait(timeout)
+        stop_async = self._stop_async
+        if stop_async is not None:
+            try:
+                stop_async()
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        import aiohttp
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        stop_ev = asyncio.Event()
+        self._stop_async = lambda: loop.call_soon_threadsafe(stop_ev.set)
+        self._loop_ready.set()
+
+        async def main():
+            self._session = aiohttp.ClientSession()
+            runner = web.AppRunner(app, shutdown_timeout=1.0)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self.port = (
+                runner.addresses[0][1] if runner.addresses else self.port
+            )
+            self._bound.set()
+            poller = asyncio.ensure_future(self._health_loop())
+            if not self._stopped:
+                await stop_ev.wait()
+            poller.cancel()
+            await self._session.close()
+            await runner.cleanup()
+
+        try:
+            loop.run_until_complete(main())
+        finally:
+            self._bound.set()
+            loop.close()
+
+    # --- health -----------------------------------------------------------
+
+    async def _health_loop(self) -> None:
+        import aiohttp
+
+        while True:
+            for ep in self.endpoints:
+                try:
+                    async with self._session.get(
+                        ep.url + "/replica/health",
+                        timeout=aiohttp.ClientTimeout(total=1.0),
+                    ) as resp:
+                        h = await resp.json()
+                    ep.alive = True
+                    ep.misses = 0
+                    ep.applied_tick = int(h.get("applied_tick", -1))
+                    s = h.get("staleness_seconds")
+                    ep.staleness_s = None if s is None else float(s)
+                    ep.reported_inflight = int(h.get("inflight", 0))
+                    was_ready = ep.ready
+                    ep.ready = bool(h.get("ready", False))
+                    if ep.ejected and ep.ready:
+                        # the freshness bound for re-admission: the
+                        # replica reports caught-up again
+                        self._readmit(ep)
+                    del was_ready
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    ep.misses += 1
+                    ep.alive = False
+                    ep.ready = False
+                    if ep.misses >= self.liveness_misses and not ep.ejected:
+                        self._eject(
+                            ep,
+                            f"liveness: {ep.misses} consecutive health "
+                            "probes failed",
+                        )
+            await asyncio.sleep(self.health_interval_s)
+
+    # --- request path -----------------------------------------------------
+
+    def _deadline_budget_s(self, request) -> float:
+        import math
+
+        raw = request.headers.get("x-pathway-deadline-ms")
+        budget_ms = None
+        if raw is not None:
+            try:
+                budget_ms = float(raw)
+            except ValueError:
+                budget_ms = None
+            if budget_ms is not None and not math.isfinite(budget_ms):
+                budget_ms = None
+        if budget_ms is None:
+            budget_ms = self.default_deadline_ms
+        return min(budget_ms, self.max_deadline_ms) / 1000.0
+
+    @staticmethod
+    def _max_staleness_ms(request) -> float | None:
+        import math
+
+        raw = request.headers.get("x-pathway-max-staleness-ms")
+        if raw is None:
+            return None
+        try:
+            v = float(raw)
+        except ValueError:
+            return None
+        return v if math.isfinite(v) else None
+
+    def _candidates(
+        self, max_staleness_ms: float | None, tried: set
+    ) -> list[ReplicaEndpoint]:
+        fresh = [
+            ep
+            for ep in self.endpoints
+            if ep.name not in tried and ep.qualifies(max_staleness_ms)
+        ]
+        if fresh:
+            return sorted(fresh, key=ReplicaEndpoint.score)
+        if max_staleness_ms is None:
+            # degrade-before-shed: an unbounded read prefers a stale
+            # answer (explicit x-pathway-stale headers) over a 503
+            stale = [
+                ep
+                for ep in self.endpoints
+                if ep.name not in tried and ep.serves_stale()
+            ]
+            return sorted(stale, key=ReplicaEndpoint.score)
+        return []
+
+    async def _attempt(
+        self, ep: ReplicaEndpoint, request, body: bytes, deadline: float
+    ) -> tuple[int, bytes, dict]:
+        """One forwarded attempt; raises _Transport on a dead replica."""
+        import aiohttp
+
+        from pathway_tpu.observability import tracing
+
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise asyncio.TimeoutError()
+        headers = {
+            k: request.headers[k] for k in _FWD_HEADERS if k in request.headers
+        }
+        headers["x-pathway-deadline-ms"] = f"{remaining * 1000.0:.1f}"
+        span = tracing.get_tracer().span(
+            "router.attempt", replica=ep.name
+        )
+        ep.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            with span:
+                if span.context is not None:
+                    headers["traceparent"] = span.context.traceparent()
+                try:
+                    async with self._session.post(
+                        ep.url + request.path,
+                        data=body,
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=remaining),
+                    ) as resp:
+                        payload = await resp.read()
+                        out_headers = {
+                            k: v
+                            for k, v in resp.headers.items()
+                            if k.lower() in _BACK_HEADERS
+                        }
+                        span.set_attribute("status", resp.status)
+                        return resp.status, payload, out_headers
+                except asyncio.TimeoutError:
+                    span.set_attribute("status", "deadline")
+                    raise
+                except aiohttp.ClientError as e:
+                    span.set_attribute("status", f"transport:{type(e).__name__}")
+                    raise _Transport(f"{type(e).__name__}: {e}") from e
+        finally:
+            ep.inflight -= 1
+            dt_ms = (time.perf_counter() - t0) * 1000.0
+            ep.ewma_ms = 0.8 * ep.ewma_ms + 0.2 * dt_ms
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        from pathway_tpu.observability import tracing
+
+        body = await request.read()
+        deadline = time.monotonic() + self._deadline_budget_s(request)
+        max_st = self._max_staleness_ms(request)
+        span = tracing.get_tracer().span(
+            "router.request",
+            parent=tracing.parse_traceparent(
+                request.headers.get("traceparent")
+            ),
+            root=True,
+            ingress=True,
+            route=request.path,
+        )
+        with span:
+            status, payload, headers, outcome, replica = (
+                await self._route(request, body, deadline, max_st)
+            )
+            span.set_attribute("status", status)
+            span.set_attribute("outcome", outcome)
+        self._m_requests.labels(replica, outcome).inc()
+        if span.context is not None:
+            headers["traceparent"] = span.context.traceparent()
+        # content type rides the passthrough headers (aiohttp rejects a
+        # content_type argument when the header is already present)
+        return web.Response(body=payload, status=status, headers=headers)
+
+    async def _route(
+        self, request, body: bytes, deadline: float, max_st: float | None
+    ) -> tuple[int, bytes, dict, str, str]:
+        tried: set[str] = set()
+        last_shed: tuple[int, bytes, dict] | None = None
+        failure_retries = 0
+        while True:
+            cands = self._candidates(max_st, tried)
+            if not cands:
+                break
+            ep = cands[0]
+            tried.add(ep.name)
+            try:
+                status, payload, headers = await self._attempt_hedged(
+                    ep, cands[1:], tried, request, body, deadline
+                )
+            except asyncio.TimeoutError:
+                # the ORIGINAL deadline is spent: no retry can help
+                return (
+                    504,
+                    _json_err("deadline exceeded at router"),
+                    {"content-type": "application/json"},
+                    "deadline",
+                    ep.name,
+                )
+            except _Transport as e:
+                # dead replica: eject, fire listeners, retry a sibling
+                # within the same deadline (never this one — `tried`).
+                # Only FAILURES consume the bounded retry budget.
+                self._eject(ep, f"transport: {e}")
+                if failure_retries >= self.retries:
+                    break
+                failure_retries += 1
+                self._m_retries.inc()
+                continue
+            if status in (429, 503):
+                # shed (admission or staleness-bound): steer to a
+                # sibling — bounded by the `tried` set, NOT by the
+                # failure-retry budget, so an idle qualified replica is
+                # always reached before a shed passes through
+                last_shed = (status, payload, headers)
+                continue
+            outcome = "ok" if status == 200 else f"status_{status}"
+            return status, payload, headers, outcome, ep.name
+        if last_shed is not None:
+            status, payload, headers = last_shed
+            headers.setdefault("Retry-After", "1.0")
+            return status, payload, headers, "shed", "none"
+        # no replica qualifies at all: explicit 503 + Retry-After
+        return (
+            503,
+            _json_err(
+                "no replica qualifies"
+                + (
+                    f" within x-pathway-max-staleness-ms={max_st:g}"
+                    if max_st is not None
+                    else " (all ejected or unreachable)"
+                )
+            ),
+            {
+                "Retry-After": "1.0",
+                "content-type": "application/json",
+            },
+            "no_replica",
+            "none",
+        )
+
+    async def _attempt_hedged(
+        self,
+        primary: ReplicaEndpoint,
+        alternates: list[ReplicaEndpoint],
+        tried: set,
+        request,
+        body: bytes,
+        deadline: float,
+    ) -> tuple[int, bytes, dict]:
+        """Primary attempt, plus a duplicate-suppressed hedge on a
+        second replica when the primary is slower than the hedge
+        budget.  Exactly one result is returned; the loser's task is
+        cancelled."""
+        if self.hedge_s <= 0 or not alternates:
+            return await self._attempt(primary, request, body, deadline)
+        task_a = asyncio.ensure_future(
+            self._attempt(primary, request, body, deadline)
+        )
+        done, _pending = await asyncio.wait(
+            {task_a}, timeout=self.hedge_s
+        )
+        if done:
+            return task_a.result()  # fast path: no hedge fired
+        hedge_ep = alternates[0]
+        tried.add(hedge_ep.name)
+        task_b = asyncio.ensure_future(
+            self._attempt(hedge_ep, request, body, deadline)
+        )
+        pending = {task_a, task_b}
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    exc = t.exception()
+                    if exc is None:
+                        self._m_hedges.labels(
+                            "primary" if t is task_a else "hedge"
+                        ).inc()
+                        return t.result()
+                    # a transport-failed leg must STILL eject + fire
+                    # failure listeners even when the other leg goes on
+                    # to win — a dead primary masked by its hedge would
+                    # otherwise keep its routing spot (inflight 0 beats
+                    # every live sibling's score) until health polls
+                    # catch up
+                    if isinstance(exc, _Transport):
+                        leg = primary if t is task_a else hedge_ep
+                        self._eject(leg, f"transport: {exc}")
+                # let the surviving leg decide; both failed → re-raise
+                # the primary's error for normal retry handling
+            task_a.result()  # raises
+            raise _Transport("hedged attempts both failed")
+        finally:
+            for t in (task_a, task_b):
+                if not t.done():
+                    t.cancel()
+
+
+def _json_err(msg: str) -> bytes:
+    import json as _json
+
+    return _json.dumps({"error": msg}).encode()

@@ -15,6 +15,68 @@ from __future__ import annotations
 import json
 import random
 import socket
+import textwrap
+
+# Replica Shield writer role, shared by the test chaos matrix
+# (tests/test_distributed.py) and the `bench.py serve_chaos` tier so the
+# two harnesses drive the SAME pipeline: streaming jsonlines docs ->
+# deterministic pseudo-embedding -> TpuKnn external index (+ an empty
+# query stream), persistence snapshots, and the PATHWAY_REPL_PORT delta
+# publisher.  Env contract: PW_WRITER_DIR (base dir with docs/ and q/
+# subdirs; a STOP file there stops the run), PATHWAY_REPLICA_DIM,
+# PATHWAY_REPL_PORT, PATHWAY_DCN_SECRET.
+REPL_WRITER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, json, time, pathlib, threading
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+    from pathway_tpu.serving.replica import text_vector
+
+    base = pathlib.Path(os.environ["PW_WRITER_DIR"])
+    DIM = int(os.environ["PATHWAY_REPLICA_DIM"])
+    stop_file = base / "STOP"
+
+    class DocS(pw.Schema):
+        text: str
+
+    docs = pw.io.jsonlines.read(
+        str(base / "docs"), schema=DocS, mode="streaming"
+    )
+    docs = docs.select(
+        vec=pw.apply(lambda t: text_vector(t, DIM), docs.text),
+        text=docs.text,
+    )
+    queries = pw.io.jsonlines.read(
+        str(base / "q"), schema=DocS, mode="streaming"
+    )
+    queries = queries.select(
+        vec=pw.apply(lambda t: text_vector(t, DIM), queries.text)
+    )
+    from pathway_tpu.stdlib.indexing import DataIndex, TpuKnn
+
+    index = DataIndex(docs, TpuKnn(docs.vec, dimensions=DIM))
+    res = index.query_as_of_now(queries.vec, number_of_matches=2).select(
+        texts=pw.right.text
+    )
+    pw.io.null.write(res)
+
+    def watch():
+        while not stop_file.exists():
+            time.sleep(0.1)
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(base / "pstorage")),
+        snapshot_every=2,
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=30)
+    print("WRITER-CLEAN-EXIT", flush=True)
+    """
+)
 
 
 def free_dcn_port(n: int = 2) -> int:

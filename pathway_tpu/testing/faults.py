@@ -23,6 +23,15 @@ Directives:
     are already exchanging the next round) on process P (default: every
     process). Fires only in supervisor incarnation I (default 0), so a
     restarted group does not re-kill itself.
+``kill=replica:<R>[,tick:<T>][,inc:<I>]``
+    Replica-scoped kill (Replica Shield): ``os._exit(FAULT_EXIT)`` on
+    read replica R when it has APPLIED its T-th delta-stream tick
+    (default 1) — the deterministic counter is the replica's per-process
+    applied-tick count, so the kill lands at the same corpus state every
+    run.  Same incarnation gating as engine kills: a supervised restart
+    of the replica runs fault-free by default.  The delta stream itself
+    is targeted with the wire directives below via its channel prefix
+    (``ch:repl`` — e.g. ``delay=ch:repl,nth:3,ms:200``).
 ``drop=ch:<prefix>,nth:<K>[,pid:<P>][,inc:<I>]``
     Silently drop the K-th wire frame sent on channels whose name starts
     with ``<prefix>`` (``bar`` = barrier frames, ``hb`` = heartbeats).
@@ -160,11 +169,25 @@ class FaultPlan:
             if args.get("pid") is not None:
                 d.arg_int("pid")
             if name == "kill":
-                d.arg_int("tick")
-                if args.get("at", "head") not in ("head", "tail"):
-                    raise FaultSpecError(
-                        "kill: `at` must be head or tail"
-                    )
+                if args.get("replica") is not None:
+                    # replica-scoped kill: tick optional (default 1 =
+                    # first applied delta tick); `at` is meaningless —
+                    # replicas apply whole ticks, they never exchange
+                    d.arg_int("replica")
+                    if args.get("tick") is not None:
+                        d.arg_int("tick")
+                    if args.get("at") is not None:
+                        raise FaultSpecError(
+                            "kill: `at` does not apply to replica-"
+                            "scoped kills (replicas have no tick "
+                            "head/tail)"
+                        )
+                else:
+                    d.arg_int("tick")
+                    if args.get("at", "head") not in ("head", "tail"):
+                        raise FaultSpecError(
+                            "kill: `at` must be head or tail"
+                        )
             elif name == "torn":
                 d.arg_int("nth")
             elif name == "slow_store":
@@ -209,6 +232,8 @@ class FaultPlan:
         for d in self.directives:
             if d.name != "kill" or d.fired:
                 continue
+            if d.args.get("replica") is not None:
+                continue  # replica-scoped kills fire in on_replica_tick
             if not d.matches_process(self.pid, self.incarnation):
                 continue
             if d.args.get("at", "head") != phase:
@@ -216,6 +241,26 @@ class FaultPlan:
             if n >= (d.arg_int("tick") or 0):
                 d.fired += 1
                 self._exit(f"kill at tick {n} ({phase})")
+
+    def on_replica_tick(self, replica_id: int, n_applied: int) -> None:
+        """Called by a read replica (serving/replica.py) after applying
+        each delta-stream tick; ``n_applied`` is the deterministic
+        per-process applied-tick counter ``kill=replica:R,tick:T``
+        fires on."""
+        for d in self.directives:
+            if d.name != "kill" or d.fired:
+                continue
+            want = d.args.get("replica")
+            if want is None or int(want) != int(replica_id):
+                continue
+            if not d.matches_process(self.pid, self.incarnation):
+                continue
+            if n_applied >= (d.arg_int("tick", 1) or 1):
+                d.fired += 1
+                self._exit(
+                    f"kill replica {replica_id} after applied tick "
+                    f"{n_applied}"
+                )
 
     def on_wire_send(self, channel: str) -> tuple[str, float] | None:
         """Called by the mesh sender thread per outgoing frame. Returns

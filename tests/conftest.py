@@ -18,6 +18,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: the full chaos/replication suites
+    # (multi-process supervised kills, long closed-loop load) carry the
+    # marker; fast smokes of the same machinery stay in tier-1
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-process chaos/replication suites excluded "
+        "from the tier-1 `-m 'not slow'` run",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _clear_parse_graph():
     from pathway_tpu.internals import parse_graph

@@ -1055,3 +1055,197 @@ def test_two_process_duplicated_frame_is_idempotent(tmp_path):
         f"w{j}": len([i for i in range(100) if i % 7 == j]) for j in range(7)
     }
     assert merged == expected
+
+
+# ---------------------------------------------------------------------------
+# Replica Shield chaos leg: writer + 2 subprocess replicas + router, with a
+# Fault-Forge replica kill and a supervised (incarnation-gated) restart.
+
+
+
+@pytest.mark.slow
+def test_replica_shield_chaos_kill_and_supervised_restart(tmp_path):
+    """Full replication chaos leg: a real writer pipeline streams deltas
+    to two subprocess replicas behind the failover router; Fault Forge
+    kills replica 1 after its 12th applied tick; its Phoenix-Mesh
+    supervisor restarts it (incarnation 1 runs fault-free), it
+    re-hydrates + replays, and the router re-admits it — while the
+    client-visible error count stays zero."""
+    import secrets
+    import threading
+
+    import requests
+
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.serving.router import FailoverRouter
+    from pathway_tpu.testing import faults
+
+    base = tmp_path
+    (base / "docs").mkdir()
+    (base / "q").mkdir()
+    DIM = 16
+    repl_port = _free_port()
+    http_ports = [_free_port(), _free_port()]
+    secret = secrets.token_hex(16)
+    env_common = {
+        "PW_WRITER_DIR": str(base),
+        "PATHWAY_DCN_SECRET": secret,
+        "PATHWAY_REPLICA_DIM": str(DIM),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+    }
+
+    def write_docs(lo, hi, tag):
+        with open(base / "docs" / f"{tag}.jsonl", "w") as f:
+            for i in range(lo, hi):
+                f.write(json.dumps({"text": f"doc {i}"}) + "\n")
+
+    write_docs(0, 8, "f0")
+    from pathway_tpu.testing.chaos import REPL_WRITER_SCRIPT
+
+    script = base / "writer.py"
+    script.write_text(REPL_WRITER_SCRIPT)
+    writer_env = dict(os.environ)
+    writer_env.update(env_common)
+    writer_env["PATHWAY_REPL_PORT"] = str(repl_port)
+    writer = subprocess.Popen(
+        [sys.executable, str(script)],
+        env=writer_env,
+        stdout=open(base / "writer.log", "wb"),
+        stderr=subprocess.STDOUT,
+    )
+    sups: list[GroupSupervisor] = []
+    sup_threads: list = []
+    router = None
+    try:
+        # wait for the writer's delta stream port to answer
+        deadline = time.monotonic() + 120
+        up = False
+        while time.monotonic() < deadline:
+            s = socket.socket()
+            try:
+                s.connect(("127.0.0.1", repl_port))
+                up = True
+                break
+            except OSError:
+                time.sleep(0.5)
+            finally:
+                s.close()
+        assert up, (base / "writer.log").read_text()[-3000:]
+
+        # two supervised replicas; replica 1 carries the fault spec
+        for rid in range(2):
+            renv = dict(env_common)
+            renv["PATHWAY_REPLICA_ID"] = str(rid)
+            renv["PATHWAY_REPLICA_STORE"] = str(base / "pstorage")
+            renv["PATHWAY_REPL_PORT"] = str(repl_port)
+            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(http_ports[rid])
+            if rid == 1:
+                renv["PATHWAY_FAULTS"] = "kill=replica:1,tick:12"
+            sup = GroupSupervisor(
+                [sys.executable, "-m", "pathway_tpu.serving.replica"],
+                1,
+                env=renv,
+                max_restarts=2,
+                backoff_s=0.2,
+                log_dir=str(base / f"replica{rid}-logs"),
+            )
+            sups.append(sup)
+            th = threading.Thread(target=sup.run, daemon=True)
+            sup_threads.append(th)
+            th.start()
+
+        router = FailoverRouter(
+            [f"http://127.0.0.1:{p}" for p in http_ports],
+            health_interval_ms=150,
+        ).start()
+        failures: list = []
+        router.add_failure_listener(
+            lambda name, why: failures.append((name, why))
+        )
+
+        def health(rid):
+            try:
+                return requests.get(
+                    f"http://127.0.0.1:{http_ports[rid]}/replica/health",
+                    timeout=2,
+                ).json()
+            except Exception:
+                return None
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            hs = [health(0), health(1)]
+            if all(h is not None and h["ready"] for h in hs):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"replicas never became ready: {hs}")
+
+        # drive load while trickling docs so replica 1 accumulates
+        # applied ticks toward its injected death
+        statuses: dict = {}
+        url = f"http://127.0.0.1:{router.port}/query"
+        killed_seen = restarted_ready = False
+        for i in range(200):
+            if i % 4 == 0:
+                write_docs(8 + i, 9 + i, f"t{i}")
+            try:
+                r = requests.post(
+                    url, json={"query": f"doc {i % 8}", "k": 1}, timeout=15
+                )
+                statuses[r.status_code] = statuses.get(r.status_code, 0) + 1
+            except Exception:
+                statuses["transport"] = statuses.get("transport", 0) + 1
+            if failures and not killed_seen:
+                killed_seen = True
+            h1 = health(1)
+            if (
+                killed_seen
+                and h1 is not None
+                and h1.get("incarnation") == 1
+                and h1.get("ready")
+            ):
+                restarted_ready = True
+                break
+            time.sleep(0.15)
+
+        assert killed_seen, "router never observed the replica death"
+        assert restarted_ready, (
+            "restarted replica never became ready again",
+            health(1),
+            statuses,
+        )
+        # the kill was the injected one, and the supervisor restarted it
+        assert sups[1].restarts_used >= 1
+        died = [e for e in sups[1].events if e[1] == "rank-died"]
+        assert died and f"exited {faults.FAULT_EXIT}" in died[0][2]
+        # client-visible contract: shed only explicitly, NEVER an error
+        errors = sum(
+            v
+            for k, v in statuses.items()
+            if k not in (200, 429, 503)
+        )
+        assert errors == 0, statuses
+        assert statuses.get(200, 0) > 0, statuses
+        # the router re-admitted the restarted replica
+        ep1 = router.endpoints[1]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ep1.ejected:
+            time.sleep(0.2)
+        assert not ep1.ejected
+    finally:
+        (base / "STOP").touch()
+        if router is not None:
+            router.stop()
+        for sup in sups:
+            sup.stop()
+        for th in sup_threads:
+            th.join(timeout=30)
+        writer.terminate()
+        try:
+            writer.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            writer.kill()

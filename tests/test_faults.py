@@ -527,3 +527,125 @@ def _await_fold_then_stop(base, expected, deadline_s=60):
             break
         time.sleep(0.2)
     (base / "STOP").touch()
+
+
+# --- Replica Shield fault specs (replica-scoped kills + delta-stream wire) --
+
+
+def test_replica_kill_spec_parses_fires_on_applied_tick():
+    p = _plan("kill=replica:1,tick:3")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    # a different replica never fires
+    for n in range(1, 6):
+        p.on_replica_tick(0, n)
+    assert not exits
+    p.on_replica_tick(1, 1)
+    p.on_replica_tick(1, 2)
+    assert not exits
+    p.on_replica_tick(1, 3)
+    assert exits and "replica 1" in exits[0]
+    # fired once, never again
+    p.on_replica_tick(1, 4)
+    assert len(exits) == 1
+
+
+def test_replica_kill_ignored_by_engine_tick_hook():
+    p = _plan("kill=replica:0,tick:1")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    for t in range(1, 8):
+        p.on_tick(t, "head")
+        p.on_tick(t, "tail")
+    assert not exits  # replica-scoped kills never fire on engine ticks
+    p.on_replica_tick(0, 1)
+    assert len(exits) == 1
+
+
+def test_replica_kill_tick_defaults_to_first_applied():
+    p = _plan("kill=replica:2")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    p.on_replica_tick(2, 1)
+    assert len(exits) == 1
+
+
+def test_replica_kill_incarnation_scoped():
+    # default inc:0 — a supervised replica restart runs fault-free
+    p1 = _plan("kill=replica:0,tick:1", inc=1)
+    exits: list[str] = []
+    p1._exit = lambda what: exits.append(what)
+    for n in range(1, 6):
+        p1.on_replica_tick(0, n)
+    assert not exits
+    pstar = _plan("kill=replica:0,tick:1,inc:*", inc=4)
+    pstar._exit = lambda what: exits.append(what)
+    pstar.on_replica_tick(0, 1)
+    assert len(exits) == 1
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "kill=replica:notanint",  # replica must be an int
+        "kill=replica:1,at:head",  # `at` is meaningless for replicas
+        "kill=replica:1,tick:x",  # tick must be an int when given
+    ],
+)
+def test_replica_kill_spec_validation(bad):
+    with pytest.raises(faults.FaultSpecError):
+        _plan(bad)
+
+
+def test_delta_stream_wire_faults_deterministic(monkeypatch):
+    """drop/dup/delay=ch:repl target the replication delta stream with
+    the same deterministic counters as the mesh wire hooks: the N-th
+    matching frame is affected, exactly once."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "fault-test-secret")
+    monkeypatch.setenv("PATHWAY_FAULTS", "drop=ch:repl,nth:2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.delenv("PATHWAY_MESH_INCARNATION", raising=False)
+    faults.reset()
+    try:
+        from pathway_tpu.engine.batch import DiffBatch
+        from pathway_tpu.parallel.replicate import (
+            DeltaStreamClient,
+            DeltaStreamServer,
+        )
+
+        srv = DeltaStreamServer(0)
+        applied: list[int] = []
+        cl = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            0,
+            from_tick=-1,
+            on_deltas=lambda t, bs: applied.append(t),
+        )
+        cl.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not cl.connected:
+                time.sleep(0.05)
+            for t in range(4):
+                srv.publish(
+                    t,
+                    [
+                        DiffBatch.from_rows(
+                            [(t, 1, ("x", None))], ("_data", "_meta")
+                        )
+                    ],
+                )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and (
+                not applied or applied[-1] < 3
+            ):
+                time.sleep(0.05)
+            # the 2nd data frame (tick 1) was dropped on the wire —
+            # deterministic by count, not timing
+            assert applied == [0, 2, 3], applied
+        finally:
+            cl.close()
+            srv.close()
+    finally:
+        faults.reset()

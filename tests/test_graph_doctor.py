@@ -647,3 +647,100 @@ def test_asof_now_duplicate_id_poisons_row_not_run():
     declare()
     with pytest.raises(ValueError, match="id contract"):
         pw.run()  # terminate_on_error=True default
+
+
+# --- rule: unreplicated-serving (Replica Shield) ---------------------------
+
+
+def _gated_index_graph(tmp_port=18099):
+    """Gated REST ingress + an external index: the serving topology the
+    unreplicated-serving rule inspects."""
+    from pathway_tpu.io.http import rest_connector
+    from pathway_tpu.serving import QoSConfig
+    from pathway_tpu.stdlib.indexing import DataIndex, TpuKnn
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=np.ndarray),
+        [(np.asarray([1.0, 0.0], dtype=np.float32),)],
+    )
+    queries, _writer = rest_connector(
+        host="127.0.0.1",
+        port=tmp_port,
+        schema=pw.schema_from_types(q=str),
+        route="/knn",
+        qos=QoSConfig(),
+    )
+    qvec = queries.select(
+        vec=pw.apply(
+            lambda s: np.asarray([1.0, 0.0], dtype=np.float32), queries.q
+        )
+    )
+    index = DataIndex(docs, TpuKnn(docs.vec, dimensions=2))
+    reply = index.query_as_of_now(qvec.vec, number_of_matches=1)
+    pw.io.null.write(reply.select(score=pw.right._pw_index_reply_score))
+    return queries
+
+
+def test_unreplicated_serving_warns_without_responder_or_replicas(
+    monkeypatch,
+):
+    from pathway_tpu.serving import degrade
+
+    monkeypatch.delenv("PATHWAY_SERVING_REPLICAS", raising=False)
+    degrade.reset()
+    _gated_index_graph()
+    found = run_doctor().by_rule("unreplicated-serving")
+    assert len(found) == 1
+    assert found[0].severity == Severity.WARNING
+    assert "hard-503" in found[0].message
+
+
+def test_unreplicated_serving_negative_with_stale_responder(monkeypatch):
+    from pathway_tpu.serving import degrade
+
+    monkeypatch.delenv("PATHWAY_SERVING_REPLICAS", raising=False)
+    degrade.reset()
+    _gated_index_graph(tmp_port=18100)
+    degrade.register_stale_responder("/knn", lambda vals: {"stale": True})
+    try:
+        assert not run_doctor().by_rule("unreplicated-serving")
+    finally:
+        degrade.reset()
+
+
+def test_unreplicated_serving_info_when_staleness_unbounded(monkeypatch):
+    from pathway_tpu.serving import degrade
+
+    degrade.reset()
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS",
+        "http://127.0.0.1:9101,http://127.0.0.1:9102",
+    )
+    monkeypatch.delenv("PATHWAY_SERVING_MAX_STALENESS_MS", raising=False)
+    _gated_index_graph(tmp_port=18101)
+    found = run_doctor().by_rule("unreplicated-serving")
+    assert len(found) == 1
+    assert found[0].severity == Severity.INFO
+    assert "max-staleness" in found[0].message
+    # bounding staleness clears the finding
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_STALENESS_MS", "2000")
+    assert not run_doctor().by_rule("unreplicated-serving")
+
+
+def test_unreplicated_serving_negative_without_index(monkeypatch):
+    """A gated REST endpoint with no external index in the graph is not
+    a serving plane — the rule stays quiet."""
+    from pathway_tpu.io.http import rest_connector
+    from pathway_tpu.serving import QoSConfig, degrade
+
+    monkeypatch.delenv("PATHWAY_SERVING_REPLICAS", raising=False)
+    degrade.reset()
+    queries, writer = rest_connector(
+        host="127.0.0.1",
+        port=18102,
+        schema=pw.schema_from_types(q=str),
+        route="/echo",
+        qos=QoSConfig(),
+    )
+    writer(queries.select(query_id=queries.id, result=queries.q))
+    assert not run_doctor().by_rule("unreplicated-serving")

@@ -604,3 +604,109 @@ def test_fsspec_file_protocol_nested_keys(tmp_path):
         from pathway_tpu.persistence.backends import store_for_backend
 
         store_for_backend(pw.persistence.Backend.s3("memory://x", object()))
+
+
+def test_sharded_groupby_kill_restart_incremental_snapshot(tmp_path):
+    """Kill/restart matrix extended to a device-mesh SHARDED pipeline
+    (Replica Shield satellite): sharded wrapper execs delegate
+    arranged_state to their inner shard execs, so device-mesh runs
+    snapshot incrementally (segment files on disk, zero replayed events
+    on restart) instead of falling back to monolith pickles."""
+    import pytest
+
+    from pathway_tpu.parallel.mesh import (
+        make_mesh,
+        set_engine_mesh,
+    )
+
+    try:
+        mesh = make_mesh(2)
+    except Exception:
+        pytest.skip("no 2-device mesh available")
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    pdir = tmp_path / "pstorage"
+    out_a = tmp_path / "out_a.jsonl"
+    out_b = tmp_path / "out_b.jsonl"
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir)), snapshot_every=1
+    )
+
+    set_engine_mesh(mesh)
+    try:
+
+        def build(out_path):
+            rows = pw.io.fs.read(
+                str(input_dir),
+                format="json",
+                schema=NumSchema,
+                mode="streaming",
+            )
+            agg = rows.groupby(rows.k).reduce(
+                rows.k,
+                s=pw.reducers.sum(rows.v),
+                cnt=pw.reducers.count(),
+            )
+            pw.io.jsonlines.write(agg, str(out_path))
+
+        _write_rows(
+            input_dir / "f1.jsonl",
+            [
+                {"k": "x", "t": 0, "v": 3},
+                {"k": "y", "t": 1, "v": 5},
+                {"k": "x", "t": 2, "v": 4},
+                {"k": "z", "t": 3, "v": 9},
+            ],
+        )
+        build(out_a)
+        _run_until.cfg = cfg
+
+        def _a_done():
+            try:
+                return _final_rows(out_a, ["k"]).get(("x",)) == (2, 7)
+            except OSError:
+                return False
+
+        assert _run_until(_a_done)
+        rt = pw.internals.parse_graph.G.last_runtime
+        from pathway_tpu.engine.sharded import ShardedGroupByExec
+
+        sharded = [
+            ex
+            for ex in rt.execs.values()
+            if isinstance(ex, ShardedGroupByExec)
+        ]
+        assert sharded, "pipeline did not shard under the engine mesh"
+        # the sharded exec exposes the ledger protocol: per-shard parts
+        arranged = sharded[0].arranged_state()
+        assert arranged is not None
+        residual, arrs = arranged
+        assert set(arrs) == {"s0.ledger", "s1.ledger"}
+        # ...and the store holds real segment files for it
+        segs = list((pdir).rglob("*.seg"))
+        assert segs, "sharded snapshot wrote no segment files"
+
+        pw.internals.parse_graph.G.clear()
+        _write_rows(
+            input_dir / "f2.jsonl",
+            [{"k": "x", "t": 4, "v": 10}, {"k": "w", "t": 5, "v": 1}],
+        )
+        build(out_b)
+
+        def _b_done():
+            try:
+                got = _final_rows(out_b, ["k"])
+            except OSError:
+                return False
+            return got.get(("x",)) == (3, 17) and got.get(("w",)) == (
+                1,
+                1,
+            )
+
+        assert _run_until(_b_done)
+        rt = pw.internals.parse_graph.G.last_runtime
+        drv = rt.persistence_driver
+        assert drv.restored_from_snapshot
+        assert drv.replayed_events == 0, drv.replayed_events
+    finally:
+        set_engine_mesh(None)

@@ -1,0 +1,892 @@
+"""Replica Shield tests — delta stream, replica hydration, failover
+router semantics, and the tier-1 single-host 2-replica failover smoke.
+
+The heavy multi-process chaos legs (supervised replica kills under a
+real writer pipeline) live in test_distributed.py behind the ``slow``
+marker; everything here is in-process and fast.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+
+@pytest.fixture(autouse=True)
+def _repl_env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "replication-test-secret")
+    from pathway_tpu.parallel import replicate
+
+    yield
+    replicate.reset_publisher()
+
+
+class ToyIndex:
+    """Dict-backed index: deterministic, no device work — the unit-test
+    stand-in for TpuDenseKnnIndex."""
+
+    def __init__(self):
+        self.d = {}
+
+    def upsert(self, key, data, meta):
+        self.d[key] = (data, meta)
+
+    def remove(self, key):
+        self.d.pop(key, None)
+
+    def search(self, triples):
+        out = []
+        for _q, k, _f in triples:
+            out.append(tuple((key, 1.0) for key in sorted(self.d)[: int(k)]))
+        return out
+
+
+def _batch(rows):
+    from pathway_tpu.engine.batch import DiffBatch
+
+    return DiffBatch.from_rows(rows, ("_data", "_meta"))
+
+
+def _wait(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# delta stream
+
+
+def test_consolidate_rows_last_op_wins():
+    from pathway_tpu.parallel.replicate import consolidate_rows
+
+    rows = [
+        (1, 1, ("a", None)),
+        (2, 1, ("b", None)),
+        (1, -1, (None, None)),
+        (1, 1, ("a2", None)),
+        (3, 1, ("c", None)),
+        (2, -1, (None, None)),
+    ]
+    out = consolidate_rows(rows)
+    assert [(r[0], r[1]) for r in out] == [(1, 1), (3, 1), (2, -1)]
+    assert out[0][2] == ("a2", None)
+
+
+def test_delta_stream_roundtrip_replay_and_staleness():
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0)
+    applied = []
+    cl = DeltaStreamClient(
+        "127.0.0.1",
+        srv.port,
+        0,
+        from_tick=-1,
+        on_deltas=lambda t, bs: applied.append(
+            (t, sum(len(b) for b in bs))
+        ),
+    )
+    cl.start()
+    try:
+        srv.publish(0, [_batch([(1, 1, ("x", None)), (2, 1, ("y", "m"))])])
+        srv.publish(1, [])  # idle marker still advances freshness
+        srv.publish(2, [_batch([(1, -1, (None, None))])])
+        assert _wait(lambda: applied and applied[-1][0] == 2)
+        assert applied == [(0, 2), (1, 0), (2, 1)]
+        assert cl.applied_tick == 2
+        assert cl.caught_up
+        # caught-up replica reads ~0 staleness continuously
+        assert cl.staleness_seconds() == 0.0
+
+        # a late subscriber replays the ring tail INCLUDING its
+        # boundary tick (consolidated deltas are idempotent state ops;
+        # re-applying the boundary is how a same-tick merge from a
+        # second index node is never lost)
+        late = []
+        cl2 = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            1,
+            from_tick=0,
+            on_deltas=lambda t, bs: late.append(t),
+        )
+        cl2.start()
+        assert _wait(lambda: late and late[-1] == 2)
+        assert late == [0, 1, 2]
+        cl2.close()
+    finally:
+        cl.close()
+        srv.close()
+
+
+def test_delta_stream_resync_beyond_ring():
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0, ring_ticks=2)
+    for t in range(10):
+        srv.publish(t, [])
+    resyncs = []
+
+    def on_resync():
+        resyncs.append(1)
+        return 8  # "re-hydrated from a generation at tick 8"
+
+    applied = []
+    cl = DeltaStreamClient(
+        "127.0.0.1",
+        srv.port,
+        0,
+        from_tick=1,  # far below the ring floor
+        on_deltas=lambda t, bs: applied.append(t),
+        on_resync=on_resync,
+    )
+    cl.start()
+    try:
+        assert _wait(lambda: cl.applied_tick >= 9)
+        assert resyncs == [1]
+        assert cl.resyncs == 1
+        # nothing before the re-hydrate tick was (incorrectly) replayed
+        # (the boundary tick itself may re-apply — idempotent)
+        assert all(t >= 8 for t in applied)
+        assert cl.caught_up
+    finally:
+        cl.close()
+        srv.close()
+
+
+def test_delta_stream_rejects_wrong_secret(monkeypatch):
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+        ReplicationError,
+    )
+
+    srv = DeltaStreamServer(0)
+    try:
+        monkeypatch.setenv("PATHWAY_DCN_SECRET", "a-different-secret")
+        cl = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            0,
+            from_tick=-1,
+            on_deltas=lambda t, bs: None,
+            connect_timeout=5.0,
+        )
+        with pytest.raises(ReplicationError, match="authentication"):
+            cl._dial()
+    finally:
+        srv.close()
+
+
+def test_writer_never_blocks_on_slow_replica():
+    """A replica that stops draining is dropped (bounded outbox), the
+    writer's publish cadence is unaffected, and the counter records the
+    drop."""
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0, outbox_depth=8)
+    gate = threading.Event()
+
+    def stall(t, bs):
+        gate.wait(30.0)
+
+    cl = DeltaStreamClient(
+        "127.0.0.1", srv.port, 0, from_tick=-1, on_deltas=stall
+    )
+    cl.start()
+    _wait(lambda: len(srv._subs) == 1, timeout=10)
+    t0 = time.monotonic()
+    for t in range(200):
+        srv.publish(t, [_batch([(t, 1, ("x", None))])])
+    publish_wall = time.monotonic() - t0
+    assert publish_wall < 5.0  # never blocked on the stalled replica
+    assert _wait(lambda: len(srv._subs) == 0, timeout=10)
+    gate.set()
+    cl.close()
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hydration
+
+
+def _fake_store_with_generations(tmp_path):
+    """A persistence store shaped like the writer's: metadata naming a
+    newest generation (torn: blob missing) and a retained older one
+    (intact)."""
+    import pickle
+
+    from pathway_tpu.persistence.backends import FilesystemStore
+
+    store = FilesystemStore(str(tmp_path / "pstorage"))
+    old_state = {
+        "live_queries": {},
+        "emitted": {},
+        "index_state": ("dict", {"corpus": "OLD", "metadata": {}}),
+    }
+    store.put("states/gen-000004/00007.pkl", pickle.dumps(old_state))
+    meta = {
+        "last_time": 40,
+        "chunks": {},
+        "state": {
+            "gen": 5,
+            "time": 50,
+            "nodes": {"7": "ExternalIndexNode"},
+            # gen-5 blob deliberately missing: a torn latest generation
+        },
+        "retained_states": [
+            {
+                "state": {
+                    "gen": 4,
+                    "time": 40,
+                    "nodes": {"7": "ExternalIndexNode"},
+                },
+                "chunks": {},
+            }
+        ],
+    }
+    store.put("metadata.json", json.dumps(meta).encode())
+    return store
+
+
+def test_hydrate_prefers_newest_but_survives_torn_generation(tmp_path):
+    from pathway_tpu.serving.replica import hydrate_index_state
+
+    store = _fake_store_with_generations(tmp_path)
+    got = hydrate_index_state(store)
+    assert got is not None
+    index_state, tick, gen = got
+    assert gen == 4 and tick == 40
+    assert index_state == ("dict", {"corpus": "OLD", "metadata": {}})
+
+    # an intact newest generation wins
+    import pickle
+
+    new_state = {
+        "live_queries": {},
+        "emitted": {},
+        "index_state": ("dict", {"corpus": "NEW", "metadata": {}}),
+    }
+    store.put("states/gen-000005/00007.pkl", pickle.dumps(new_state))
+    index_state, tick, gen = hydrate_index_state(store)
+    assert gen == 5 and tick == 50
+    assert index_state[1]["corpus"] == "NEW"
+
+
+def test_hydrate_empty_store(tmp_path):
+    from pathway_tpu.persistence.backends import FilesystemStore
+    from pathway_tpu.serving.replica import hydrate_index_state
+
+    assert (
+        hydrate_index_state(FilesystemStore(str(tmp_path / "empty")))
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# replica HTTP serving
+
+
+def test_replica_serves_and_sheds_on_staleness_bound():
+    import requests
+
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+
+    srv = DeltaStreamServer(0)
+    rep = ReplicaServer(
+        replica_id=7,
+        index_factory=ToyIndex,
+        writer_port=srv.port,
+        responder=lambda s, v: {
+            "n": len(s.index.d),
+            "matches": s.search([(None, v.get("k", 3), None)])[0],
+        },
+        stale_after_ms=500,
+    ).start()
+    try:
+        srv.publish(0, [_batch([(i, 1, (f"d{i}", None)) for i in range(4)])])
+        assert _wait(lambda: rep.ready)
+        url = f"http://127.0.0.1:{rep.http_port}/query"
+        r = requests.post(url, json={"k": 2}, timeout=10)
+        assert r.status_code == 200
+        assert r.json()["n"] == 4
+        assert r.headers["x-pathway-replica"] == "7"
+        assert "x-pathway-stale" not in r.headers
+        assert float(r.headers["x-pathway-staleness-seconds"]) < 1.0
+        # fresh replica passes a zero staleness bound
+        r = requests.post(
+            url,
+            json={},
+            headers={"x-pathway-max-staleness-ms": "0"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+
+        # writer dies: staleness grows past the bound -> explicit shed,
+        # unbounded reads still answer WITH the stale headers
+        srv.close()
+        assert _wait(lambda: rep.is_stale(), timeout=10)
+        r = requests.post(
+            url,
+            json={},
+            headers={"x-pathway-max-staleness-ms": "100"},
+            timeout=10,
+        )
+        assert r.status_code == 503
+        assert "Retry-After" in r.headers
+        r = requests.post(url, json={}, timeout=10)
+        assert r.status_code == 200
+        assert r.headers["x-pathway-stale"] == "true"
+        assert float(r.headers["x-pathway-staleness-seconds"]) > 0.4
+    finally:
+        rep.stop()
+        srv.close()
+
+
+def test_replica_health_endpoint_reports_freshness():
+    import requests
+
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+
+    srv = DeltaStreamServer(0)
+    rep = ReplicaServer(
+        replica_id=3, index_factory=ToyIndex, writer_port=srv.port
+    ).start()
+    try:
+        srv.publish(5, [_batch([(1, 1, ("a", None))])])
+        assert _wait(lambda: rep.applied_tick == 5)
+        h = requests.get(
+            f"http://127.0.0.1:{rep.http_port}/replica/health", timeout=5
+        ).json()
+        assert h["replica"] == 3
+        assert h["applied_tick"] == 5
+        assert h["ready"] is True
+        assert h["connected"] is True
+    finally:
+        rep.stop()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# failover router
+
+
+def _start_plane(n_replicas=2, qos=None, stale_after_ms=3000):
+    """writer + N toy replicas + router, all in-process."""
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+    from pathway_tpu.serving.router import FailoverRouter
+
+    srv = DeltaStreamServer(0)
+    reps = []
+    for rid in range(n_replicas):
+        reps.append(
+            ReplicaServer(
+                replica_id=rid,
+                index_factory=ToyIndex,
+                writer_port=srv.port,
+                responder=lambda s, v: _toy_responder(s, v),
+                qos=qos,
+                stale_after_ms=stale_after_ms,
+            ).start()
+        )
+    router = FailoverRouter(
+        [f"http://127.0.0.1:{r.http_port}" for r in reps],
+        health_interval_ms=100,
+    ).start()
+    return srv, reps, router
+
+
+def _toy_responder(server, values):
+    delay = float(values.get("delay_s", 0.0))
+    if delay:
+        time.sleep(delay)
+    res = server.search([(None, int(values.get("k", 3)), None)])[0]
+    return {"matches": [[k, s] for k, s in res], "replica": server.replica_id}
+
+
+def test_router_two_replica_failover_smoke():
+    """Tier-1 failover smoke (<60 s): queries keep answering across a
+    replica death; the killed replica's restart is only re-admitted
+    once fresh; a mid-query kill is retried on the sibling within the
+    original deadline with the retry hop visible in the trace."""
+    import requests
+
+    from pathway_tpu.observability import tracing
+    from pathway_tpu.serving.replica import ReplicaServer
+
+    srv, reps, router = _start_plane(2)
+    try:
+        srv.publish(0, [_batch([(i, 1, (f"d{i}", None)) for i in range(3)])])
+        assert _wait(lambda: all(r.ready for r in reps))
+        assert _wait(
+            lambda: all(ep.ready for ep in router.endpoints), timeout=10
+        )
+        url = f"http://127.0.0.1:{router.port}/query"
+        r = requests.post(url, json={"k": 2}, timeout=10)
+        assert r.status_code == 200
+
+        failures = []
+        router.add_failure_listener(lambda name, why: failures.append(name))
+
+        # mid-query kill: whichever replica holds the in-flight request
+        # dies with it (its responder wedges, its server is torn down
+        # mid-response); the router retries the SAME request on the
+        # sibling within the original deadline
+        wedge = threading.Semaphore(1)  # only the FIRST attempt wedges
+        gate = threading.Event()
+
+        def wedging_responder(s, v):
+            if v.get("block") and wedge.acquire(blocking=False):
+                gate.wait(30.0)
+                raise RuntimeError("victim never answers")
+            return _toy_responder(s, v)
+
+        for rep in reps:
+            rep.responder = wedging_responder
+
+        result: dict = {}
+
+        def do_request():
+            t0 = time.monotonic()
+            r = requests.post(
+                url,
+                json={"k": 2, "block": True},
+                headers={"x-pathway-deadline-ms": "20000"},
+                timeout=25,
+            )
+            result["elapsed"] = time.monotonic() - t0
+            result["resp"] = r
+
+        req_t = threading.Thread(target=do_request)
+        req_t.start()
+        # find the replica holding the wedged in-flight attempt
+        assert _wait(
+            lambda: any(ep.inflight > 0 for ep in router.endpoints),
+            timeout=10,
+        )
+        victim = next(ep for ep in router.endpoints if ep.inflight > 0)
+        victim_idx = int(victim.name.replace("replica", ""))
+        reps[victim_idx]._http.stop()  # mid-query death
+        req_t.join(timeout=25)
+        r = result["resp"]
+        assert r.status_code == 200, r.text
+        assert r.json()["replica"] != victim_idx
+        assert result["elapsed"] < 20.0  # within the original deadline
+        # the retry hop is a visible child attempt in the stitched trace
+        trace_id = r.headers["traceparent"].split("-")[1]
+        attempts = [
+            s
+            for s in tracing.get_tracer().spans(seconds=60)
+            if s.trace_id == trace_id and s.name == "router.attempt"
+        ]
+        assert len(attempts) == 2, [s.attributes for s in attempts]
+        assert {s.attributes.get("replica") for s in attempts} == {
+            "replica0",
+            "replica1",
+        }
+        assert _wait(lambda: failures, timeout=10)
+        assert failures[0] == victim.name
+        gate.set()
+
+        # steady failover: every subsequent request answers 200
+        for _ in range(10):
+            r = requests.post(url, json={"k": 1}, timeout=10)
+            assert r.status_code == 200
+
+        # restart the victim on ITS OLD PORT: re-admitted only once it
+        # reports ready (hydrated + caught up with the stream)
+        old_port = reps[victim_idx].http_port
+        reps[victim_idx].stop()  # release the dead server's stream client
+        reps[victim_idx] = ReplicaServer(
+            replica_id=victim_idx,
+            index_factory=ToyIndex,
+            writer_port=srv.port,
+            http_port=old_port,
+            responder=lambda s, v: _toy_responder(s, v),
+        ).start()
+        assert _wait(lambda: reps[victim_idx].ready, timeout=15)
+        assert _wait(lambda: not victim.ejected, timeout=15)
+    finally:
+        router.stop()
+        for r in reps:
+            r.stop()
+        srv.close()
+
+
+def test_router_max_staleness_zero_routes_fresh_or_sheds():
+    import requests
+
+    srv, reps, router = _start_plane(2, stale_after_ms=400)
+    try:
+        srv.publish(0, [_batch([(1, 1, ("a", None))])])
+        assert _wait(lambda: all(r.ready for r in reps))
+        assert _wait(lambda: all(ep.ready for ep in router.endpoints))
+        url = f"http://127.0.0.1:{router.port}/query"
+        # fresh plane: a zero bound still routes (staleness == 0)
+        r = requests.post(
+            url,
+            json={},
+            headers={"x-pathway-max-staleness-ms": "0"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        # writer gone: every replica exceeds the bound -> explicit 503 +
+        # Retry-After from the router (no replica qualifies)
+        srv.close()
+        assert _wait(lambda: all(r.is_stale() for r in reps), timeout=10)
+        assert _wait(
+            lambda: all(
+                ep.staleness_s is None or ep.staleness_s > 0.4
+                for ep in router.endpoints
+            ),
+            timeout=10,
+        )
+        r = requests.post(
+            url,
+            json={},
+            headers={"x-pathway-max-staleness-ms": "200"},
+            timeout=10,
+        )
+        assert r.status_code == 503
+        assert "Retry-After" in r.headers
+        # unbounded reads degrade to a stale answer instead (explicit
+        # stale headers — PR 8's contract through the new hop)
+        r = requests.post(url, json={}, timeout=10)
+        assert r.status_code == 200
+        assert r.headers.get("x-pathway-stale") == "true"
+    finally:
+        router.stop()
+        for r in reps:
+            r.stop()
+        srv.close()
+
+
+def test_router_occupancy_weighted_pick():
+    from pathway_tpu.serving.router import ReplicaEndpoint
+
+    a = ReplicaEndpoint("replica0", "http://a")
+    b = ReplicaEndpoint("replica1", "http://b")
+    for ep in (a, b):
+        ep.ready = True
+        ep.staleness_s = 0.0
+    a.inflight = 5
+    b.inflight = 1
+    assert sorted([a, b], key=ReplicaEndpoint.score)[0] is b
+    b.reported_inflight = 10  # replica-reported admission occupancy
+    assert sorted([a, b], key=ReplicaEndpoint.score)[0] is a
+    # ejection disqualifies regardless of load
+    a.ejected = True
+    assert not a.qualifies(None)
+    assert b.qualifies(None)
+    # staleness bound disqualifies
+    b.staleness_s = 2.0
+    assert not b.qualifies(1000.0)
+    assert b.qualifies(3000.0)
+
+
+def test_router_hedges_slow_replica(monkeypatch):
+    """PATHWAY_SERVING_HEDGE_MS: a slow primary gets a duplicate on the
+    sibling; the fast response wins and exactly one response returns."""
+    import requests
+
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+    from pathway_tpu.serving.router import FailoverRouter
+
+    srv = DeltaStreamServer(0)
+    slow_gate = threading.Event()
+
+    def slow_responder(s, v):
+        if s.replica_id == 0:
+            slow_gate.wait(10.0)
+        return {"replica": s.replica_id}
+
+    reps = [
+        ReplicaServer(
+            replica_id=rid,
+            index_factory=ToyIndex,
+            writer_port=srv.port,
+            responder=slow_responder,
+        ).start()
+        for rid in range(2)
+    ]
+    router = FailoverRouter(
+        [f"http://127.0.0.1:{r.http_port}" for r in reps],
+        hedge_ms=150,
+        health_interval_ms=100,
+    ).start()
+    try:
+        srv.publish(0, [])
+        assert _wait(lambda: all(r.ready for r in reps))
+        assert _wait(lambda: all(ep.ready for ep in router.endpoints))
+        # force the slow replica primary: bias occupancy
+        router.endpoints[1].reported_inflight = 5
+        t0 = time.monotonic()
+        r = requests.post(
+            f"http://127.0.0.1:{router.port}/query", json={}, timeout=10
+        )
+        elapsed = time.monotonic() - t0
+        assert r.status_code == 200
+        assert r.json()["replica"] == 1  # the hedge won
+        assert elapsed < 5.0
+    finally:
+        slow_gate.set()
+        router.stop()
+        for r in reps:
+            r.stop()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real writer pipeline -> snapshot hydration -> delta stream
+
+
+def test_pipeline_writer_snapshot_hydration_and_stream(
+    tmp_path, monkeypatch
+):
+    """The full writer path: a real pipeline with persistence publishes
+    per-tick corpus deltas; a replica hydrates from the newest committed
+    generation, replays the stream tail, reaches freshness, and answers
+    KNN reads that match the writer's corpus."""
+    import requests
+
+    from pathway_tpu.parallel import replicate
+    from pathway_tpu.serving.replica import ReplicaServer, text_vector
+
+    monkeypatch.setenv("PATHWAY_REPL_PORT", "0")
+    replicate.reset_publisher()
+    DIM = 8
+    in_dir = tmp_path / "in"
+    q_dir = tmp_path / "q"
+    in_dir.mkdir()
+    q_dir.mkdir()
+
+    class DocS(pw.Schema):
+        text: str
+
+    with open(in_dir / "f0.jsonl", "w") as f:
+        for i in range(8):
+            f.write(json.dumps({"text": f"doc {i}"}) + "\n")
+
+    docs = pw.io.jsonlines.read(str(in_dir), schema=DocS, mode="streaming")
+    docs = docs.select(
+        vec=pw.apply(lambda t: text_vector(t, DIM), docs.text),
+        text=docs.text,
+    )
+    queries = pw.io.jsonlines.read(
+        str(q_dir), schema=DocS, mode="streaming"
+    )
+    queries = queries.select(
+        vec=pw.apply(lambda t: text_vector(t, DIM), queries.text)
+    )
+    from pathway_tpu.stdlib.indexing import DataIndex, TpuKnn
+
+    index = DataIndex(docs, TpuKnn(docs.vec, dimensions=DIM))
+    res = index.query_as_of_now(queries.vec, number_of_matches=2).select(
+        texts=pw.right.text
+    )
+    pw.io.null.write(res)
+
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(tmp_path / "pstorage")),
+        snapshot_every=2,
+    )
+    run_t = threading.Thread(
+        target=lambda: pw.run(
+            persistence_config=cfg, autocommit_duration_ms=25
+        ),
+        daemon=True,
+    )
+    run_t.start()
+    rep = None
+    try:
+        assert _wait(
+            lambda: replicate.publisher() is not None
+            and replicate.publisher().newest_tick() >= 0,
+            timeout=60,
+        )
+        pub = replicate.publisher()
+        for i in range(8, 20):
+            with open(in_dir / f"f{i}.jsonl", "w") as f:
+                f.write(json.dumps({"text": f"doc {i}"}) + "\n")
+            time.sleep(0.05)
+        from pathway_tpu.persistence.backends import FilesystemStore
+        from pathway_tpu.serving.replica import hydrate_index_state
+
+        assert _wait(
+            lambda: hydrate_index_state(
+                FilesystemStore(str(tmp_path / "pstorage"))
+            )
+            is not None,
+            timeout=60,
+        )
+        from pathway_tpu.stdlib.indexing._index_impls import (
+            TpuDenseKnnIndex,
+        )
+
+        rep = ReplicaServer(
+            replica_id=0,
+            index_factory=lambda: TpuDenseKnnIndex(dimensions=DIM),
+            store_root=str(tmp_path / "pstorage"),
+            writer_port=pub.port,
+            dim=DIM,
+        ).start()
+        assert rep.hydrated_tick >= 0  # came from a real generation
+        assert _wait(lambda: rep.ready, timeout=30)
+        assert _wait(
+            lambda: rep.index.corpus is not None
+            and len(rep.index.corpus) == 20,
+            timeout=30,
+        )
+        r = requests.post(
+            f"http://127.0.0.1:{rep.http_port}/query",
+            json={"query": "doc 12", "k": 1},
+            timeout=15,
+        )
+        assert r.status_code == 200
+        # exact self-match under the deterministic pseudo-embedder:
+        # cosine distance score -(1-cos) == 0 for the identical vector
+        top = r.json()["matches"][0]
+        assert abs(top[1]) < 1e-5
+    finally:
+        if rep is not None:
+            rep.stop()
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+        run_t.join(timeout=30)
+
+
+def test_gated_replica_sheds_429_not_500():
+    """A replica behind a Surge-Gate admission envelope sheds with an
+    explicit 429 + Retry-After — never a 500 (regression: the ShedError
+    handler used to miss its import and turn every shed into an
+    error)."""
+    import requests
+
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving import QoSConfig
+    from pathway_tpu.serving.replica import ReplicaServer
+
+    srv = DeltaStreamServer(0)
+    rep = ReplicaServer(
+        replica_id=9,
+        index_factory=ToyIndex,
+        writer_port=srv.port,
+        responder=lambda s, v: {"ok": True},
+        qos=QoSConfig(rate_limit_rps=1.0, rate_limit_burst=1.0),
+    ).start()
+    try:
+        srv.publish(0, [])
+        assert _wait(lambda: rep.ready)
+        url = f"http://127.0.0.1:{rep.http_port}/query"
+        codes = []
+        for _ in range(8):
+            r = requests.post(url, json={}, timeout=10)
+            codes.append(r.status_code)
+            if r.status_code == 429:
+                assert "Retry-After" in r.headers
+        assert 200 in codes and 429 in codes, codes
+        assert 500 not in codes, codes
+    finally:
+        rep.stop()
+        srv.close()
+
+
+def test_second_publish_same_tick_reaches_live_subscribers():
+    """Two index nodes publishing the SAME lockstep tick: the second
+    frame merges into the ring AND still applies on live subscribers
+    (equal-tick frames are not skipped — consolidated deltas are
+    idempotent), so connected replicas and ring-replaying replicas
+    converge to the same corpus."""
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0)
+    seen: dict[int, set] = {}
+    cl = DeltaStreamClient(
+        "127.0.0.1",
+        srv.port,
+        0,
+        from_tick=-1,
+        on_deltas=lambda t, bs: seen.setdefault(t, set()).update(
+            k for b in bs for k, _d, _v in b.iter_rows()
+        ),
+    )
+    cl.start()
+    try:
+        _wait(lambda: len(srv._subs) == 1, timeout=10)
+        srv.publish(5, [_batch([(1, 1, ("a", None))])])
+        srv.publish(5, [_batch([(2, 1, ("b", None))])])  # second node
+        assert _wait(lambda: seen.get(5) == {1, 2}, timeout=10), seen
+        # ...and a late ring-replayer sees the merged entry too
+        late: dict[int, set] = {}
+        cl2 = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            1,
+            from_tick=-1,
+            on_deltas=lambda t, bs: late.setdefault(t, set()).update(
+                k for b in bs for k, _d, _v in b.iter_rows()
+            ),
+        )
+        cl2.start()
+        assert _wait(lambda: late.get(5) == {1, 2}, timeout=10), late
+        cl2.close()
+    finally:
+        cl.close()
+        srv.close()
+
+
+def test_deep_rejoin_backlog_larger_than_outbox():
+    """A replica rejoining from hundreds of ticks behind (backlog far
+    beyond the sender outbox bound) replays the whole tail — the
+    backlog rides a dedicated list, never put_nowait into the bounded
+    outbox (regression: queue.Full used to kill the handshake thread
+    and livelock the rejoin)."""
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0, outbox_depth=16)
+    for t in range(600):
+        srv.publish(t, [_batch([(t, 1, ("x", None))])])
+    applied = []
+    cl = DeltaStreamClient(
+        "127.0.0.1",
+        srv.port,
+        0,
+        from_tick=100,
+        on_deltas=lambda t, bs: applied.append(t),
+    )
+    cl.start()
+    try:
+        assert _wait(lambda: cl.applied_tick == 599, timeout=20)
+        assert applied == list(range(100, 600))
+        assert cl.resyncs == 0  # within the ring: replay, not resync
+    finally:
+        cl.close()
+        srv.close()
