@@ -2048,7 +2048,14 @@ def _bench_serve_chaos(np):
     absorbing the same offered load, with a Fault-Forge kill of
     replica 1 mid-run and a Phoenix-Mesh supervised restart —
     reporting sustained QPS, p50/p99, shed rate, error-served (must be
-    0) and the restarted replica's recovery-to-fresh seconds.
+    0) and the restarted replica's recovery-to-fresh seconds;
+    `writer_takeover` (Shard Harbor) = mid-load SIGKILL of the primary
+    writer with a StandbyWriter resuming the delta stream on the same
+    endpoint under a bumped incarnation — reporting the
+    handoff-to-fresh window and error-served during it (must be 0);
+    `shard_sweep` = shard×replica layouts (1×3, 3×1, 3×2) at the full
+    corpus, reporting per-layout QPS/p99 and per-member resident
+    corpus bytes (the ~1/S memory evidence).
 
     Host caveat recorded in the output: on a core-bound smoke box the
     UNGATED aggregate is capped by raw CPU, so the scaling evidence is
@@ -2093,6 +2100,8 @@ def _bench_serve_chaos(np):
     _tracer_was = _tracing.get_tracer().enabled
     _tracing.get_tracer().enabled = False
     writer = None
+    standby = None
+    prior_secret = os.environ.get("PATHWAY_DCN_SECRET")
     sups: list = []
     sup_threads: list = []
     routers: list = []
@@ -2105,9 +2114,15 @@ def _bench_serve_chaos(np):
                 f.write(json.dumps({"text": "doc %d" % i}) + "\n")
         repl_port = free_dcn_port(1)
         http_ports = [free_dcn_port(1) for _ in range(3)]
+        # the bench process itself runs an in-process StandbyWriter
+        # (phase 3), so the job secret must live in ITS env too —
+        # restored in the finally so later tiers of a full bench run
+        # see the same environment a standalone run would
+        job_secret = prior_secret or secrets.token_hex(16)
+        os.environ["PATHWAY_DCN_SECRET"] = job_secret
         env_common = {
             "PW_WRITER_DIR": str(base),
-            "PATHWAY_DCN_SECRET": secrets.token_hex(16),
+            "PATHWAY_DCN_SECRET": job_secret,
             "PATHWAY_REPLICA_DIM": str(DIM),
             "JAX_PLATFORMS": "cpu",
             "PATHWAY_TRACING": "0",
@@ -2319,17 +2334,278 @@ def _bench_serve_chaos(np):
                 out["replicated_vs_single_p99"] = round(
                     out["single"]["p99_ms"] / load_result["p99_ms"], 2
                 )
-        out["error_served_total"] = out["single"][
-            "error_served"
-        ] + load_result.get("error_served", 1)
+
+        # --- phase 3: writer SIGKILL -> standby takeover ----------------
+        # The standby shadows the live delta stream; the primary dies
+        # by SIGKILL mid-load; the standby respawns the writer role on
+        # the SAME endpoint under incarnation 1 (restore newest
+        # generation + connector-log replay + ring floor); the phase-2
+        # replicas reconnect through resync-from-floor and reads keep
+        # answering (error_served must stay 0 — stale degrade, never
+        # errors).
+        from pathway_tpu.parallel.standby import StandbyWriter
+
+        standby_env = dict(env_common)
+        standby_env["PATHWAY_REPL_PORT"] = str(repl_port)
+        standby = StandbyWriter(
+            "127.0.0.1",
+            repl_port,
+            argv=[sys.executable, str(script)],
+            env=standby_env,
+            store_root=str(base / "pstorage"),
+            position_path=str(base / "standby-pos.json"),
+            grace_s=1.5,
+            poll_s=0.1,
+        ).start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and standby.applied_tick < 0:
+            time.sleep(0.2)
+        router_to = FailoverRouter(
+            ["http://127.0.0.1:%d" % p for p in http_ports],
+            health_interval_ms=200,
+        ).start()
+        routers.append(router_to)
+        to_phase_s = phase_s * 2
+        to_load: dict = {}
+        to_t = threading.Thread(
+            target=lambda: to_load.update(
+                _serve_chaos_load_phase(
+                    np, router_to.port, workers, to_phase_s, N_DOCS
+                )
+            )
+        )
+        to_t.start()
+        trickle_stop.clear()
+        threading.Thread(
+            target=trickle, args=(to_phase_s,), daemon=True
+        ).start()
+        time.sleep(2.0)
+        t_kill = time.monotonic()
+        writer.kill()  # SIGKILL: no flush, no goodbye
+        took_over = standby.wait_takeover(timeout=60)
+        resumed_at = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            hs = [health(rid) for rid in range(3)]
+            if all(
+                h is not None
+                and h.get("ready")
+                and h.get("writer_incarnation", -1) >= 1
+                for h in hs
+            ):
+                resumed_at = time.monotonic()
+                break
+            time.sleep(0.3)
+        to_t.join(timeout=to_phase_s + 120)
+        out["writer_takeover"] = {
+            "standby_took_over": bool(took_over),
+            "takeover_incarnation": standby.takeover_incarnation,
+            "handoff_to_fresh_s": (
+                round(resumed_at - t_kill, 2)
+                if resumed_at is not None
+                else None
+            ),
+            "load_during_handoff": to_load,
+            "error_served": to_load.get("error_served"),
+        }
+        router_to.stop()
+
+        # --- phase 4: shard x replica sweep -----------------------------
+        # Layout 1x3 reuses the running plane (takeover writer +
+        # phase-2 replicas: every member holds the FULL corpus); the
+        # 3-shard layouts restart the writer with
+        # PATHWAY_SERVING_SHARDS=3 and spawn shard-owning members —
+        # per-member resident corpus bytes is the ~1/S evidence.
+        sweep: list = []
+        sweep_phase_s = phase_s * 1.5
+
+        def member_stats(ports):
+            stats = []
+            for p in ports:
+                try:
+                    h = requests.get(
+                        "http://127.0.0.1:%d/replica/health" % p,
+                        timeout=2,
+                    ).json()
+                    stats.append(
+                        {
+                            "shard": h.get("shard"),
+                            "corpus_docs": h.get("corpus_docs"),
+                            "corpus_bytes": h.get("corpus_bytes"),
+                        }
+                    )
+                except Exception:
+                    stats.append(None)
+            return stats
+
+        def record_layout(
+            name, n_shards, members, router_obj, ports, gate_rps
+        ):
+            res = _serve_chaos_load_phase(
+                np, router_obj.port, workers, sweep_phase_s, N_DOCS
+            )
+            sweep.append(
+                {
+                    "layout": name,
+                    "shards": n_shards,
+                    "members_per_shard": members,
+                    "member_gate_rps": gate_rps,
+                    "qps": res["qps"],
+                    "p50_ms": res["p50_ms"],
+                    "p99_ms": res["p99_ms"],
+                    "shed_rate": res["shed_rate"],
+                    "error_served": res["error_served"],
+                    "per_member": member_stats(ports),
+                }
+            )
+
+        router_1x3 = FailoverRouter(
+            ["http://127.0.0.1:%d" % p for p in http_ports],
+            health_interval_ms=200,
+        ).start()
+        routers.append(router_1x3)
+        record_layout("1x3", 1, 3, router_1x3, http_ports, replica_rps)
+        router_1x3.stop()
+
+        # tear the unsharded plane down; the sharded writer owns the
+        # port next
+        for sup in sups:
+            sup.stop()
+        for th in sup_threads:
+            th.join(timeout=30)
+        sups.clear()
+        sup_threads.clear()
+        standby.stop()  # SIGTERMs its supervised takeover writer
+
+        def start_sharded_writer():
+            wenv = dict(os.environ)
+            wenv.update(env_common)
+            wenv["PATHWAY_REPL_PORT"] = str(repl_port)
+            wenv["PATHWAY_SERVING_SHARDS"] = "3"
+            p = subprocess.Popen(
+                [sys.executable, str(script)],
+                env=wenv,
+                stdout=open(base / "writer-sharded.log", "wb"),
+                stderr=subprocess.STDOUT,
+            )
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                s = socket_mod.socket()
+                try:
+                    s.connect(("127.0.0.1", repl_port))
+                    return p
+                except OSError:
+                    time.sleep(0.5)
+                finally:
+                    s.close()
+            raise RuntimeError(
+                "sharded writer never opened the delta stream: "
+                + (base / "writer-sharded.log").read_text()[-2000:]
+            )
+
+        def start_shard_member(rid, shard, http_port, gate_rps):
+            renv = dict(env_common)
+            renv["PATHWAY_REPLICA_ID"] = str(rid)
+            renv["PATHWAY_REPLICA_STORE"] = str(base / "pstorage")
+            renv["PATHWAY_REPL_PORT"] = str(repl_port)
+            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(http_port)
+            renv["PATHWAY_SERVING_ENABLED"] = "1"
+            # gates sized by scatter fan-out: an S-shard read touches
+            # ONE member per shard, so at equal plane QPS each member
+            # sees S× the per-member rate of the unsharded layout —
+            # and one shard's shed fails the WHOLE read (never a
+            # partial corpus), compounding under-sized gates
+            renv["PATHWAY_SERVING_RPS"] = str(gate_rps)
+            renv["PATHWAY_SERVING_BURST"] = "15"
+            renv["PATHWAY_SERVING_SHARDS"] = "3"
+            renv["PATHWAY_REPLICA_SHARD"] = str(shard)
+            sup = GroupSupervisor(
+                [sys.executable, "-m", "pathway_tpu.serving.replica"],
+                1,
+                env=renv,
+                max_restarts=1,
+                backoff_s=0.2,
+                log_dir=str(base / ("shard-member%d-logs" % rid)),
+            )
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            sups.append(sup)
+            sup_threads.append(th)
+
+        def wait_ready_ports(ports, timeout=300):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                ok = 0
+                for p in ports:
+                    try:
+                        h = requests.get(
+                            "http://127.0.0.1:%d/replica/health" % p,
+                            timeout=2,
+                        ).json()
+                        if h.get("ready"):
+                            ok += 1
+                    except Exception:
+                        pass
+                if ok == len(ports):
+                    return
+                time.sleep(0.5)
+            raise RuntimeError("shard members never became ready")
+
+        writer = start_sharded_writer()
+        for layout_name, members_per_shard in (("3x1", 1), ("3x2", 2)):
+            n_members = 3 * members_per_shard
+            gate_rps = replica_rps * 3.0 / members_per_shard
+            ports = [free_dcn_port(1) for _ in range(n_members)]
+            for i in range(n_members):
+                start_shard_member(100 + i, i % 3, ports[i], gate_rps)
+            wait_ready_ports(ports)
+            shard_urls = [
+                [
+                    "http://127.0.0.1:%d" % ports[i]
+                    for i in range(n_members)
+                    if i % 3 == s
+                ]
+                for s in range(3)
+            ]
+            router_s = FailoverRouter(
+                shards=shard_urls, health_interval_ms=200
+            ).start()
+            routers.append(router_s)
+            record_layout(
+                layout_name, 3, members_per_shard, router_s, ports, gate_rps
+            )
+            router_s.stop()
+            for sup in sups:
+                sup.stop()
+            for th in sup_threads:
+                th.join(timeout=30)
+            sups.clear()
+            sup_threads.clear()
+        out["shard_sweep"] = sweep
+
+        out["error_served_total"] = (
+            out["single"]["error_served"]
+            + load_result.get("error_served", 1)
+            + to_load.get("error_served", 1)
+            + sum(leg["error_served"] for leg in sweep)
+        )
         return out
     finally:
         _tracing.get_tracer().enabled = _tracer_was
+        if prior_secret is None:
+            os.environ.pop("PATHWAY_DCN_SECRET", None)
+        else:
+            os.environ["PATHWAY_DCN_SECRET"] = prior_secret
         trickle_stop.set()
         (base / "STOP").touch()
         for router in routers:
             try:
                 router.stop()
+            except Exception:
+                pass
+        if standby is not None:
+            try:
+                standby.stop()
             except Exception:
                 pass
         for sup in sups:
@@ -2657,7 +2933,7 @@ if __name__ == "__main__":
         _doc = {"tier": "serve_chaos", **_serve}
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "SERVE_r10.json"),
+                         "SERVE_r11.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

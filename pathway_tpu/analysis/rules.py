@@ -452,7 +452,10 @@ def unreplicated_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
     nothing can answer.  PR 8's stale responder or a Replica Shield
     replica set (serving/replica.py + serving/router.py) each close the
     gap; INFO when replicas exist but nothing bounds staleness, so a
-    partitioned writer silently serves ever-older data."""
+    partitioned writer silently serves ever-older data.  Shard Harbor
+    facets: WARNING when a replicated plane has no standby writer
+    (PATHWAY_REPL_STANDBY — the single ingest writer is an SPOF), INFO
+    when the shard layout leaves some key range with a single owner."""
     import os
 
     from pathway_tpu.engine.index_node import ExternalIndexNode
@@ -467,8 +470,21 @@ def unreplicated_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
         for u in os.environ.get("PATHWAY_SERVING_REPLICAS", "").split(",")
         if u.strip()
     ]
+    # a Shard Harbor plane may be configured ONLY via the shard map —
+    # its members ARE the replica set.  Reuse the router's parser (the
+    # single source of truth); a torn map is the router's boot error,
+    # not this rule's concern, so fall back to the count heuristics.
+    from pathway_tpu.serving.router import shard_map_from_env
+
+    try:
+        shard_map = shard_map_from_env()
+    except ValueError:
+        shard_map = None
+    if shard_map:
+        replicas = replicas + [u for part in shard_map for u in part]
     from pathway_tpu.serving import degrade
 
+    first_gated = None
     for node in facts.order:
         if not isinstance(node, InputNode):
             continue
@@ -495,22 +511,91 @@ def unreplicated_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
                 "the failover router",
                 data={"route": route, "index_nodes": len(index_nodes)},
             )
-        elif replicas and not os.environ.get(
-            "PATHWAY_SERVING_MAX_STALENESS_MS", ""
-        ):
+        elif replicas:
+            if not os.environ.get("PATHWAY_SERVING_MAX_STALENESS_MS", ""):
+                yield Diagnostic(
+                    "unreplicated-serving",
+                    Severity.INFO,
+                    f"REST ingress {route!r} has {len(replicas)} "
+                    "replica(s) configured but max-staleness is "
+                    "unbounded: a partitioned or dead writer keeps "
+                    "serving ever-older answers with no shed point",
+                    node,
+                    fix_hint="set PATHWAY_SERVING_MAX_STALENESS_MS (or "
+                    "have clients send x-pathway-max-staleness-ms) so "
+                    "reads past the freshness bound shed explicitly "
+                    "with 503 + Retry-After",
+                    data={"route": route, "replicas": len(replicas)},
+                )
+            if not os.environ.get("PATHWAY_REPL_STANDBY", ""):
+                yield Diagnostic(
+                    "unreplicated-serving",
+                    Severity.WARNING,
+                    f"REST ingress {route!r} has a replicated read "
+                    "plane but NO standby writer configured: the "
+                    "single ingest writer is the last serving SPOF — "
+                    "kill it and every replica serves permanently "
+                    "stale data with nothing publishing deltas, "
+                    "snapshotting, or ingesting",
+                    node,
+                    fix_hint="run a standby writer (python -m "
+                    "pathway_tpu.parallel.standby -- <writer argv>) "
+                    "and point replicas at its takeover endpoint via "
+                    "PATHWAY_REPL_STANDBY=host:port",
+                    data={"route": route, "replicas": len(replicas)},
+                )
+        if first_gated is None:
+            first_gated = node
+    # Shard Harbor: a shard whose key range has ONE owner turns any
+    # single member death into a partial-corpus outage (bounded reads
+    # shed 503 for that key range until it recovers).  One plane-level
+    # finding, anchored at the first gated ingress.
+    if first_gated is None:
+        return
+    if shard_map:
+        # the map names exact ownership: per-shard claims are precise
+        single_owner = [
+            s for s, part in enumerate(shard_map) if len(part) == 1
+        ]
+        if single_owner:
             yield Diagnostic(
                 "unreplicated-serving",
                 Severity.INFO,
-                f"REST ingress {route!r} has {len(replicas)} replica(s) "
-                "configured but max-staleness is unbounded: a "
-                "partitioned or dead writer keeps serving ever-older "
-                "answers with no shed point",
-                node,
-                fix_hint="set PATHWAY_SERVING_MAX_STALENESS_MS (or have "
-                "clients send x-pathway-max-staleness-ms) so reads past "
-                "the freshness bound shed explicitly with 503 + "
-                "Retry-After",
-                data={"route": route, "replicas": len(replicas)},
+                f"shard(s) {single_owner} of the serving plane have a "
+                "single owner: one member death makes that key range "
+                "unavailable (bounded reads shed 503 naming the "
+                "shard) until the supervisor restarts it",
+                first_gated,
+                fix_hint="give every shard at least two members in "
+                "PATHWAY_SERVING_SHARD_MAP",
+                data={"single_owner_shards": single_owner},
+            )
+    else:
+        try:
+            n_shards = int(
+                os.environ.get("PATHWAY_SERVING_SHARDS", "1") or 1
+            )
+        except ValueError:
+            n_shards = 1
+        # count-only pigeonhole: fewer than 2 members per shard on
+        # average guarantees SOME shard has a single owner — which one
+        # depends on the layout only the shard map can name
+        if n_shards > 1 and replicas and len(replicas) < 2 * n_shards:
+            yield Diagnostic(
+                "unreplicated-serving",
+                Severity.INFO,
+                f"{len(replicas)} replica(s) over "
+                f"PATHWAY_SERVING_SHARDS={n_shards} leaves at least "
+                "one shard with a single owner (which one depends on "
+                "the layout): one member death makes that key range "
+                "unavailable (bounded reads shed 503 naming the "
+                "shard) until the supervisor restarts it",
+                first_gated,
+                fix_hint="raise the replica count to at least "
+                f"{2 * n_shards} (2 per shard), or declare exact "
+                "ownership via PATHWAY_SERVING_SHARD_MAP for a "
+                "per-shard diagnosis",
+                data={"shards": n_shards, "replicas": len(replicas)},
             )
 
 

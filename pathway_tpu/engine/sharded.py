@@ -37,6 +37,12 @@ SHARD_MASK = 0xFFFF  # low 16 bits route the row (reference value.rs:38)
 
 
 def shard_of(gks: np.ndarray, n_shards: int) -> np.ndarray:
+    """THE ownership function: device-mesh sharded execs, the DCN
+    router (engine/dcn.py `_DcnRouter`), and the serving plane's
+    corpus sharding (parallel/replicate.py `corpus_shard_of` — Shard
+    Harbor replica×shard ownership) all route by this same jk-hash
+    partition, so a key's owner is one agreed fact across every
+    layer."""
     return ((gks.astype(np.uint64) & np.uint64(SHARD_MASK)) % np.uint64(
         n_shards
     )).astype(np.int32)

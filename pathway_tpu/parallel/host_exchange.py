@@ -314,6 +314,42 @@ class HostMesh:
         self._version_skew: dict[int, bytes] = {}
         self._out: dict[int, socket.socket] = {}
         self._closed = False
+        # receive-side decode pool (wide fan-in long tail): each peer
+        # already has its own reader thread, but that thread decodes a
+        # frame before it can recv the NEXT one — on wide fan-ins the
+        # per-link decode serializes behind the gather wait.  A small
+        # shared pool takes (MAC-verified) bodies off the readers so
+        # recv and decode overlap across peers.  Safe to run unordered:
+        # every delivery slot is keyed (channel, tick, src) and written
+        # once.  PATHWAY_DCN_DECODE_POOL: "" = auto (pool of
+        # min(4, n-1) threads once the fan-in is ≥ 3 peers), 0 = inline
+        # decode (the pre-pool behavior), N = N threads.
+        pool_raw = os.environ.get("PATHWAY_DCN_DECODE_POOL", "") or ""
+        if pool_raw:
+            try:
+                pool_n = int(pool_raw)
+            except ValueError:
+                raise HostMeshError(
+                    f"PATHWAY_DCN_DECODE_POOL={pool_raw!r} is not an int"
+                ) from None
+        else:
+            pool_n = min(4, n - 1) if n - 1 >= 3 else 0
+        self._decode_pool = None
+        self._decode_slots: threading.Semaphore | None = None
+        if pool_n > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._decode_pool = ThreadPoolExecutor(
+                max_workers=pool_n,
+                thread_name_prefix=f"pw-dcn-decode-{pid}",
+            )
+            # BOUNDED pending-decode backlog: the inline path's natural
+            # backpressure (reader busy decoding → kernel socket buffer
+            # fills → sender blocks) must survive the pool, or
+            # undecoded bodies accumulate without limit under a gather
+            # storm — readers block here instead, like every other
+            # bounded queue in this subsystem
+            self._decode_slots = threading.Semaphore(pool_n * 8)
         # per-peer overlapped delivery: bounded outbox + one sender
         # thread per peer (owns that connection's MAC sequence counter)
         depth = int(os.environ.get("PATHWAY_DCN_OUTBOX", "32") or 32)
@@ -568,32 +604,25 @@ class HostMesh:
                 self._last_heard[src] = time.monotonic()
                 self._m_recv_bytes.labels(str(src)).inc(len(head) + len(body))
                 self._m_recv_msgs.labels(str(src)).inc()
-                t0 = time.perf_counter()
-                frame = wire.decode_frame(body)
-                (
-                    dec_codec
-                    if body[:1] == wire.FRAME_CODEC
-                    else dec_pickle
-                ).observe(time.perf_counter() - t0)
-                kind = frame[0]
-                if kind == "hb":
-                    continue  # liveness already refreshed above
-                with self._cv:
-                    if kind == "data":
-                        _k, fsrc, channel, tick, payload, tp = frame
-                        self._data.setdefault((channel, tick), {})[
-                            fsrc
-                        ] = payload
-                        if tp is not None:
-                            self._data_tps.setdefault(
-                                (channel, tick), {}
-                            )[fsrc] = tp
-                    elif kind == "bar":
-                        _k, fsrc, rnd, value, tp = frame
-                        self._bars.setdefault(rnd, {})[fsrc] = value
-                        if tp is not None:
-                            self._bar_tps.setdefault(rnd, {})[fsrc] = tp
-                    self._cv.notify_all()
+                pool = self._decode_pool
+                if pool is not None:
+                    # overlap: the reader goes straight back to recv
+                    # while a pool worker decodes + delivers.  Unordered
+                    # delivery is safe — every slot is keyed
+                    # (channel, tick, src) and written once — and the
+                    # MAC sequence was already verified in order above.
+                    # The slot acquire bounds the pending backlog
+                    # (released by the worker).
+                    self._decode_slots.acquire()
+                    pool.submit(
+                        self._decode_deliver,
+                        conn,
+                        body,
+                        dec_codec,
+                        dec_pickle,
+                    )
+                else:
+                    self._decode_deliver(conn, body, dec_codec, dec_pickle)
         except Exception:
             # transport faults AND decode failures (wire.WireError, a
             # struct/pickle error from a codec bug or a version skew
@@ -607,6 +636,60 @@ class HostMesh:
                 self._mark_dead(
                     src, "connection closed (peer EOF or corrupt frame)"
                 )
+
+    def _decode_deliver(
+        self, conn: socket.socket, body: bytes, dec_codec, dec_pickle
+    ) -> None:
+        """Decode one MAC-verified frame body and deliver it under the
+        condition variable.  Runs inline (reader thread) or on the
+        decode pool; a decode failure on the pool path closes the link
+        so the reader fail-stops exactly like an inline failure."""
+        try:
+            t0 = time.perf_counter()
+            frame = wire.decode_frame(body)
+            (
+                dec_codec
+                if body[:1] == wire.FRAME_CODEC
+                else dec_pickle
+            ).observe(time.perf_counter() - t0)
+            kind = frame[0]
+            if kind == "hb":
+                return  # liveness already refreshed by the reader
+            with self._cv:
+                if kind == "data":
+                    _k, fsrc, channel, tick, payload, tp = frame
+                    self._data.setdefault((channel, tick), {})[
+                        fsrc
+                    ] = payload
+                    if tp is not None:
+                        self._data_tps.setdefault(
+                            (channel, tick), {}
+                        )[fsrc] = tp
+                elif kind == "bar":
+                    _k, fsrc, rnd, value, tp = frame
+                    self._bars.setdefault(rnd, {})[fsrc] = value
+                    if tp is not None:
+                        self._bar_tps.setdefault(rnd, {})[fsrc] = tp
+                self._cv.notify_all()
+        except Exception:
+            if self._decode_pool is None:
+                raise  # inline path: the reader's fail-stop handler
+            # pool path: tear the link so the reader fail-stops.
+            # shutdown() BEFORE close(): the reader is blocked in
+            # recv() on this socket, and a bare close() neither wakes
+            # it (the in-flight syscall pins the description) nor is
+            # safe against the freed fd being reused by a new accept
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        finally:
+            if self._decode_pool is not None:
+                self._decode_slots.release()
 
     # --- liveness (Phoenix Mesh) ------------------------------------------
 
@@ -945,6 +1028,8 @@ class HostMesh:
                     continue
         for th in self._senders.values():
             th.join(timeout=2.0)
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown(wait=False)
         try:
             self._listener.close()
         except OSError:

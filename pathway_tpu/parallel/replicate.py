@@ -28,6 +28,29 @@ generation, then apply this stream), and a failover ROUTER
   newer) snapshot generation instead — the bounded-ring contract of
   the tentpole: replay when cheap, full re-hydrate when not.
 
+Shard Harbor (sharded corpus ownership): with
+``PATHWAY_SERVING_SHARDS`` = S > 1 the writer splits every
+consolidated per-tick delta batch by the DCN jk-hash partition
+(engine/sharded.py ``shard_of`` — the same low-16-bit key routing the
+device mesh and ``_DcnRouter`` use) and fans EACH SHARD'S stream only
+to that shard's owners: a replica subscribing with ``shard=s`` (hello
+field) receives only keys whose hash routes to s, so it hydrates and
+holds ~1/S of the corpus.  Every subscriber still receives every
+tick's (possibly empty) marker, so freshness tracking is
+shard-independent.  A subscription whose expected shard count
+disagrees with the writer's is refused at suback time (the torn
+shard-assignment-map guard; the boot-time twin lives in
+serving/router.py ``validate_shard_map``).
+
+Standby takeover + incarnation fencing: the suback carries the
+writer's ``PATHWAY_MESH_INCARNATION``.  A client remembers the highest
+incarnation it has ever seen and REJECTS any writer presenting a lower
+one (``fenced_count``) — after a standby takeover (parallel/standby.py
+bumps the incarnation and resumes publishing on the writer endpoint), a
+zombie primary that comes back can never feed replicas stale frames.
+Clients accept a list of endpoints (primary first, standby next) and
+rotate to the next endpoint on dial failure or fencing.
+
 Freshness: every frame carries the writer's newest published tick, and
 idle ticks still emit (empty) tick markers, so a replica always knows
 whether it is caught up; heartbeats keep that knowledge fresh on idle
@@ -71,13 +94,51 @@ from pathway_tpu.parallel.host_exchange import (
     _job_key,
 )
 
-_REPL_MAGIC = b"PWRP1"  # replication protocol v1 (sits beside the mesh's
+_REPL_MAGIC = b"PWRP2"  # replication protocol (sits beside the mesh's
 # PWHX7: a replica is NOT a mesh rank — it never joins barriers — so the
-# subscription stream gets its own handshake magic and version lane)
+# subscription stream gets its own handshake magic and version lane).
+# v2 widens the hello with the subscriber's shard + expected shard count
+# (Shard Harbor) and the suback with the writer's shard count +
+# incarnation fencing token — a v1 peer's hello is a different length,
+# so version skew fails the handshake instead of mis-parsing.
 _OK_TAG = b"PWRO"
+_HELLO_STRUCT = "<iqii"  # replica_id, from_tick, shard, expected shards
 
 REPL_CHANNEL = "repl:idx"  # delta frames' wire channel (Fault Forge
 # directives match it by prefix: drop/dup/delay=ch:repl)
+STANDBY_CHANNEL = "repl:standby"  # the writer→standby leg: a standby
+# subscriber's frames are re-tagged so Fault Forge directives can
+# target JUST this leg (drop/dup/delay=ch:repl:standby) without
+# touching the replica fan-out
+STANDBY_ID = -2  # reserved replica_id for standby-writer subscriptions
+
+
+def shards_env() -> int:
+    """Serving-plane shard count, PATHWAY_SERVING_SHARDS (default 1 =
+    every replica owns the full corpus — the pre-Shard-Harbor
+    topology)."""
+    raw = os.environ.get("PATHWAY_SERVING_SHARDS", "1") or "1"
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PATHWAY_SERVING_SHARDS={raw!r} is not an int"
+        ) from None
+    if n < 1:
+        raise ValueError(f"PATHWAY_SERVING_SHARDS={raw!r} must be >= 1")
+    return n
+
+
+def corpus_shard_of(keys, n_shards: int):
+    """Shard assignment for corpus row keys — the DCN router's jk-hash
+    partition (engine/sharded.py ``shard_of``: low 16 bits of the key
+    mod the shard count), reused so the serving plane, the device mesh
+    and the host mesh all agree on ownership."""
+    import numpy as np
+
+    from pathway_tpu.engine.sharded import shard_of
+
+    return shard_of(np.asarray(keys, dtype=np.uint64), n_shards)
 
 
 def ring_ticks_env() -> int:
@@ -139,6 +200,7 @@ class _Subscriber:
         "thread",
         "dead",
         "from_tick",
+        "shard",
     )
 
     def __init__(self, conn: socket.socket, replica_id: int, depth: int):
@@ -154,6 +216,7 @@ class _Subscriber:
         self.thread: threading.Thread | None = None
         self.dead = False
         self.from_tick = -1
+        self.shard = -1  # -1 = full corpus (unsharded replica / standby)
 
 
 class DeltaStreamServer:
@@ -173,6 +236,8 @@ class DeltaStreamServer:
         host: str = "127.0.0.1",
         ring_ticks: int | None = None,
         outbox_depth: int = 256,
+        n_shards: int | None = None,
+        incarnation: int | None = None,
     ):
         self.host = host
         self.port = port
@@ -180,20 +245,39 @@ class DeltaStreamServer:
         self.ring_ticks = (
             ring_ticks_env() if ring_ticks is None else max(int(ring_ticks), 1)
         )
+        self.n_shards = (
+            shards_env() if n_shards is None else max(int(n_shards), 1)
+        )
+        self.incarnation = (
+            int(os.environ.get("PATHWAY_MESH_INCARNATION", "0") or 0)
+            if incarnation is None
+            else int(incarnation)
+        )
         self._outbox_depth = max(int(outbox_depth), 8)
         self._lock = threading.Lock()
-        # (tick, [DiffBatch]) newest-last; floor = newest tick whose
-        # deltas are UNAVAILABLE (evicted from the ring, or covered only
-        # by the snapshot generation a restarted writer restored from —
-        # set_floor) — a subscription from below the floor must full-
-        # re-hydrate.  A fresh writer's floor stays -1: no ticks existed
-        # before its first publish, so the ring IS complete history and
-        # a from_tick=-1 subscriber replays it instead of resyncing.
-        self._ring: deque[tuple[int, list]] = deque()
+        # (tick, per_shard) newest-last — per_shard is one list of
+        # DiffBatch per shard (length n_shards; the unsharded plane is
+        # the 1-shard special case), split ONCE at publish so fan-out
+        # and ring replay pay no per-subscriber partitioning.  floor =
+        # newest tick whose deltas are UNAVAILABLE (evicted from the
+        # ring, or covered only by the snapshot generation a restarted
+        # writer restored from — set_floor) — a subscription from below
+        # the floor must full-re-hydrate.  A fresh writer's floor stays
+        # -1: no ticks existed before its first publish, so the ring IS
+        # complete history and a from_tick=-1 subscriber replays it
+        # instead of resyncing.
+        self._ring: deque[tuple[int, list[list]]] = deque()
         self._floor = -1
         self._newest = -1
+        self._ticks_published = 0  # deterministic counter the Fault
+        # Forge kill=writer directive fires on (distinct ticks, so a
+        # second index node merging into the same lockstep tick does
+        # not advance it)
         self._subs: list[_Subscriber] = []
         self._closed = False
+        from pathway_tpu.testing import faults
+
+        self._fault_plan = faults.active()
         hb_ms = float(
             os.environ.get("PATHWAY_REPL_HEARTBEAT_MS", "1000") or 1000
         )
@@ -239,10 +323,41 @@ class DeltaStreamServer:
 
     # --- writer-side API --------------------------------------------------
 
+    def _split_shards(self, batches: list) -> list[list]:
+        """Partition one tick's batches by corpus-key shard ownership
+        (jk-hash, engine/sharded.py shard_of).  1-shard planes skip the
+        hash entirely."""
+        if self.n_shards == 1:
+            return [list(batches)]
+        per: list[list] = [[] for _ in range(self.n_shards)]
+        for b in batches:
+            if not len(b):
+                continue
+            dest = corpus_shard_of(b.keys, self.n_shards)
+            for s in range(self.n_shards):
+                m = dest == s
+                if m.any():
+                    per[s].append(b.mask(m))
+        return per
+
+    @staticmethod
+    def _shard_batches(per_shard: list[list], shard: int) -> list:
+        """The batches a subscriber owning ``shard`` receives (-1 = the
+        full corpus: standby writers and unsharded replicas)."""
+        if shard < 0:
+            return [b for part in per_shard for b in part]
+        if shard >= len(per_shard):
+            return []  # mismatched map: suback fencing rejects the
+            # subscription; deliver markers only meanwhile
+        return list(per_shard[shard])
+
     def publish(self, tick: int, batches: list) -> None:
         """Append one tick's consolidated deltas (possibly empty) to the
-        ring and fan out.  Engine-thread hot path: O(subscribers) queue
-        puts, no I/O (sender threads own the sockets)."""
+        ring and fan out per shard.  Engine-thread hot path:
+        O(subscribers) queue puts, no I/O (sender threads own the
+        sockets)."""
+        per_shard = self._split_shards(batches)
+        fresh_tick = False
         with self._lock:
             if self._closed:
                 return
@@ -252,22 +367,39 @@ class DeltaStreamServer:
                 # one-entry-per-tick
                 for i in range(len(self._ring) - 1, -1, -1):
                     if self._ring[i][0] == tick:
-                        self._ring[i][1].extend(batches)
+                        for s, part in enumerate(per_shard):
+                            self._ring[i][1][s].extend(part)
                         break
             else:
-                self._ring.append((tick, list(batches)))
+                fresh_tick = True
+                self._ring.append((tick, per_shard))
                 self._newest = tick
+                self._ticks_published += 1
                 while len(self._ring) > self.ring_ticks:
                     evicted, _b = self._ring.popleft()
                     self._floor = max(self._floor, evicted)
             subs = list(self._subs)
+            n_published = self._ticks_published
         self._m_published.inc()
         rows = sum(len(b) for b in batches)
         if rows:
             self._m_delta_rows.inc(rows)
-        frame = ("data", 0, REPL_CHANNEL, tick, list(batches), None)
         for sub in subs:
-            self._offer(sub, frame)
+            self._offer(
+                sub,
+                (
+                    "data",
+                    0,
+                    REPL_CHANNEL,
+                    tick,
+                    self._shard_batches(per_shard, sub.shard),
+                    None,
+                ),
+            )
+        if fresh_tick and self._fault_plan is not None:
+            # kill=writer: fires AFTER the tick fanned out, so the
+            # replicas' last applied tick is deterministic too
+            self._fault_plan.on_writer_tick(n_published)
 
     def newest_tick(self) -> int:
         return self._newest
@@ -334,7 +466,10 @@ class DeltaStreamServer:
             nonce = os.urandom(_NONCE_LEN)
             conn.settimeout(30.0)
             conn.sendall(nonce)
-            hello = _read_exact(conn, len(_REPL_MAGIC) + 12 + _MAC_LEN)
+            hello = _read_exact(
+                conn,
+                len(_REPL_MAGIC) + struct.calcsize(_HELLO_STRUCT) + _MAC_LEN,
+            )
             if hello is None or hello[: len(_REPL_MAGIC)] != _REPL_MAGIC:
                 conn.close()
                 return
@@ -348,8 +483,8 @@ class DeltaStreamServer:
                     pass
                 conn.close()
                 return
-            replica_id, from_tick = struct.unpack(
-                "<iq", claimed[len(_REPL_MAGIC) :]
+            replica_id, from_tick, shard, _want_shards = struct.unpack(
+                _HELLO_STRUCT, claimed[len(_REPL_MAGIC) :]
             )
             conn.sendall(
                 hmac.new(
@@ -365,6 +500,7 @@ class DeltaStreamServer:
             return
         sub = _Subscriber(conn, replica_id, self._outbox_depth)
         sub.from_tick = from_tick
+        sub.shard = int(shard)
         with self._lock:
             if self._closed:
                 conn.close()
@@ -374,9 +510,14 @@ class DeltaStreamServer:
             # index node publishing the same lockstep tick merges into
             # the existing ring entry, and per-tick consolidated deltas
             # are idempotent state ops (last-op-per-key), so re-applying
-            # the boundary is safe and never loses the merged tail
+            # the boundary is safe and never loses the merged tail.
+            # A resync subscription gets the FULL ring: a replica that
+            # re-hydrates redials anyway (bounded waste), and one that
+            # CANNOT hydrate (no store — e.g. behind a takeover writer
+            # that republished its corpus as its first tick) accepts
+            # the gap and converges on everything the ring still holds
             backlog = (
-                []
+                list(self._ring)
                 if resync
                 else [e for e in self._ring if e[0] >= from_tick]
             )
@@ -385,14 +526,31 @@ class DeltaStreamServer:
             # sender drains only AFTER the backlog, so the replica sees
             # ticks in order
             self._subs.append(sub)
+            # suback carries the writer's shard count (the client
+            # fences a torn shard-assignment map) and incarnation (the
+            # zombie-primary fencing token)
             sub.backlog.append(
-                ("suback", self._newest, self._floor, bool(resync))
+                (
+                    "suback",
+                    self._newest,
+                    self._floor,
+                    bool(resync),
+                    self.n_shards,
+                    self.incarnation,
+                )
             )
             if resync:
                 self._m_resyncs.inc()
-            for tick, batches in backlog:
+            for tick, per_shard in backlog:
                 sub.backlog.append(
-                    ("data", 0, REPL_CHANNEL, tick, list(batches), None)
+                    (
+                        "data",
+                        0,
+                        REPL_CHANNEL,
+                        tick,
+                        self._shard_batches(per_shard, sub.shard),
+                        None,
+                    )
                 )
         sub.thread = threading.Thread(
             target=self._sender_loop,
@@ -417,6 +575,10 @@ class DeltaStreamServer:
 
         plan = faults.active()
         seq = 0
+        # the writer→standby leg carries its own channel tag so Fault
+        # Forge can target takeover determinism without touching the
+        # replica fan-out (drop/dup/delay=ch:repl:standby)
+        standby = sub.replica_id < 0
         backlog = sub.backlog
         sub.backlog = []
         while True:
@@ -428,6 +590,13 @@ class DeltaStreamServer:
                 return
             try:
                 repeats = 1
+                if frame[0] == "data" and standby:
+                    frame = (
+                        frame[0],
+                        frame[1],
+                        STANDBY_CHANNEL,
+                        *frame[3:],
+                    )
                 if plan is not None and frame[0] == "data":
                     action = plan.on_wire_send(str(frame[2]))
                     if action is not None:
@@ -551,11 +720,29 @@ class DeltaStreamClient:
         on_resync: Callable[[], int] | None = None,
         on_applied: Callable[[int, int], None] | None = None,
         connect_timeout: float = 60.0,
+        *,
+        shard: int = -1,
+        expect_shards: int = 0,
+        endpoints: list[tuple[str, int]] | None = None,
     ):
         self.host = host
         self.port = port
+        # endpoints: (host, port) list tried in order — the primary
+        # writer first, the standby's takeover endpoint next.  The
+        # single-endpoint form (host/port args) is the common same-port
+        # takeover deployment.
+        self.endpoints = (
+            [(host, int(port))] if not endpoints else list(endpoints)
+        )
+        self._ep_idx = 0
         self.replica_id = int(replica_id)
         self.from_tick = int(from_tick)
+        # shard ownership (Shard Harbor): subscribe to one shard's
+        # stream (-1 = the full corpus); expect_shards (when > 0) fences
+        # a writer whose shard count disagrees — a torn assignment map
+        # must never half-apply
+        self.shard = int(shard)
+        self.expect_shards = int(expect_shards)
         self.on_deltas = on_deltas
         self.on_resync = on_resync
         self.on_applied = on_applied
@@ -569,6 +756,14 @@ class DeltaStreamClient:
         self.newest_known = -1
         self.resyncs = 0
         self.connected = False
+        # incarnation fencing: the highest writer incarnation ever seen
+        # on this stream — any writer presenting a LOWER one is a
+        # zombie primary (the standby already took over) and its
+        # subscription is rejected before a single frame applies
+        self.writer_incarnation = -1
+        self.fenced_count = 0
+        self.config_error: str | None = None  # sticky shard-map
+        # mismatch diagnosis (kept across redials for health reporting)
         # caught_up: applied_tick has reached the stream head at least
         # once since the current subscription — the freshness bound a
         # replica must clear before the router re-admits it
@@ -626,17 +821,20 @@ class DeltaStreamClient:
         attempt = 0
         while not self._closed and time.monotonic() < deadline:
             s: socket.socket | None = None
+            ep = self.endpoints[self._ep_idx % len(self.endpoints)]
             try:
-                s = socket.create_connection(
-                    (self.host, self.port), timeout=5.0
-                )
+                s = socket.create_connection(ep, timeout=5.0)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 s.settimeout(10.0)
                 nonce = _read_exact(s, _NONCE_LEN)
                 if nonce is None:
                     raise OSError("writer closed during handshake")
                 hello = _REPL_MAGIC + struct.pack(
-                    "<iq", self.replica_id, self.from_tick
+                    _HELLO_STRUCT,
+                    self.replica_id,
+                    self.from_tick,
+                    self.shard,
+                    self.expect_shards,
                 )
                 s.sendall(
                     hello
@@ -666,13 +864,93 @@ class DeltaStreamClient:
                         s.close()
                     except OSError:
                         pass
+                # rotate to the next endpoint (standby takeover address)
+                # before backing off — a dead primary must not eat the
+                # whole connect budget when the standby is already up
+                self._ep_idx += 1
                 attempt += 1
                 backoff = min(2.0, 0.05 * (2 ** min(attempt, 6)))
                 time.sleep(backoff * (0.5 + _random.random()))
         return None
 
+    def _probe_incarnation(self, ep: tuple[str, int]) -> int | None:
+        """Handshake + read the suback + close: what incarnation does
+        this endpoint's writer publish under?  None when unreachable or
+        not speaking PWRP2."""
+        s: socket.socket | None = None
+        try:
+            s = socket.create_connection(ep, timeout=2.0)
+            s.settimeout(5.0)
+            nonce = _read_exact(s, _NONCE_LEN)
+            if nonce is None:
+                return None
+            hello = _REPL_MAGIC + struct.pack(
+                _HELLO_STRUCT,
+                self.replica_id,
+                self.applied_tick,
+                self.shard,
+                self.expect_shards,
+            )
+            s.sendall(
+                hello + hmac.new(self._key, hello + nonce, "sha256").digest()
+            )
+            ok = _read_exact(s, _MAC_LEN)
+            if ok is None or ok == _REJECT:
+                return None
+            if not hmac.compare_digest(
+                ok,
+                hmac.new(self._key, _OK_TAG + nonce + hello, "sha256").digest(),
+            ):
+                return None
+            head = _read_exact(s, 4 + _MAC_LEN)
+            if head is None:
+                return None
+            (length,) = struct.unpack("<I", head[:4])
+            body = _read_exact(s, length)
+            if body is None:
+                return None
+            if not hmac.compare_digest(
+                head[4:],
+                _frame_mac(self._key, 0, self.replica_id, 0, body),
+            ):
+                return None
+            frame = wire.decode_frame(body)
+            if frame[0] != "suback":
+                return None
+            return int(frame[5])
+        except Exception:
+            return None
+        finally:
+            if s is not None:
+                _shutdown_close(s)
+
+    def _probe_endpoints(self) -> None:
+        """Multi-endpoint fencing bootstrap: learn EVERY endpoint's
+        incarnation before subscribing and start with the highest — a
+        restarted replica (empty in-memory high-water) must not
+        re-adopt a zombie primary just because the zombie's endpoint
+        dials first."""
+        best_idx, best_inc = None, -1
+        for i, ep in enumerate(self.endpoints):
+            inc = self._probe_incarnation(ep)
+            if inc is not None and inc > best_inc:
+                best_idx, best_inc = i, inc
+        if best_idx is not None:
+            with self._lock:
+                self.writer_incarnation = max(
+                    self.writer_incarnation, best_inc
+                )
+            self._ep_idx = best_idx
+
     def _run(self) -> None:
         while not self._closed:
+            if len(self.endpoints) > 1 and self.writer_incarnation < 0:
+                # fencing bootstrap ONLY: once a high-water is known,
+                # suback-time fencing rejects zombies by itself — a
+                # probe per routine redial would cost every endpoint a
+                # wasted authenticated subscription (suback + ring
+                # backlog) each time
+                self._probe_endpoints()
             conn = self._dial()
             if conn is None:
                 if self._closed:
@@ -725,27 +1003,105 @@ class DeltaStreamClient:
                     self.newest_known = max(self.newest_known, frame[1])
                 self._note_progress()
             elif kind == "suback":
-                _k, newest, _floor, resync = frame
+                _k, newest, _floor, resync, srv_shards, srv_inc = frame
+                if srv_inc < self.writer_incarnation:
+                    # zombie primary: a standby with a HIGHER incarnation
+                    # already took over this stream — reject the whole
+                    # subscription (no frame from this writer may apply)
+                    # and rotate to the next endpoint
+                    self.fenced_count += 1
+                    import logging
+
+                    logging.getLogger("pathway_tpu").warning(
+                        "replica %d: fenced zombie writer (incarnation "
+                        "%d < %d) at %s",
+                        self.replica_id,
+                        srv_inc,
+                        self.writer_incarnation,
+                        self.endpoints[self._ep_idx % len(self.endpoints)],
+                    )
+                    self._ep_idx += 1
+                    time.sleep(0.2)  # a persistent zombie must not
+                    # hot-loop dial->fence->dial
+                    return
+                with self._lock:
+                    self.writer_incarnation = max(
+                        self.writer_incarnation, srv_inc
+                    )
+                torn = (
+                    self.expect_shards and srv_shards != self.expect_shards
+                ) or (
+                    # an UNSHARDED replica (no expectation at all)
+                    # against a sharded writer is torn too: it would
+                    # receive the FULL corpus while the router treats
+                    # it as one shard's owner — merged top-k would
+                    # carry duplicates and the 1/S memory win silently
+                    # vanishes.  Full-corpus subscriptions to a sharded
+                    # writer are reserved for negative ids (standby /
+                    # observers), which never sit behind the router.
+                    not self.expect_shards
+                    and self.shard < 0
+                    and self.replica_id >= 0
+                    and srv_shards > 1
+                ) or (
+                    # a shard index the writer does not split to would
+                    # receive an empty stream yet report caught-up
+                    self.shard >= 0
+                    and srv_shards > 0
+                    and self.shard >= srv_shards
+                )
+                if torn:
+                    # torn shard assignment: this replica's map and the
+                    # writer's split disagree — applying would
+                    # mis-partition the corpus silently
+                    self.config_error = (
+                        f"writer splits the corpus into {srv_shards} "
+                        f"shard(s) but this replica expected "
+                        f"{self.expect_shards or 1} (torn shard "
+                        "assignment map — fix PATHWAY_SERVING_SHARDS/"
+                        "PATHWAY_REPLICA_SHARD and restart)"
+                    )
+                    import logging
+
+                    logging.getLogger("pathway_tpu").error(
+                        "replica %d: %s", self.replica_id, self.config_error
+                    )
+                    time.sleep(0.5)
+                    return
+                self.config_error = None
                 with self._lock:
                     self.newest_known = max(self.newest_known, newest)
                 if resync:
                     self.resyncs += 1
                     if self.on_resync is None:
-                        # no re-hydrate path: accept the gap (at-least-
-                        # once corpus; the snapshotless caller asked for
-                        # whatever the ring still holds)
+                        # no hydrate path (store-less replica — e.g.
+                        # behind a takeover writer that republished its
+                        # corpus as its first tick): accept the gap
+                        # and converge on the FULL ring the server
+                        # replays for resync subscriptions —
+                        # consolidated per-tick deltas are idempotent,
+                        # and frames older than applied_tick skip below
                         self.from_tick = self.applied_tick
                         continue
                     new_tick = int(self.on_resync())
-                    if new_tick <= self.from_tick or new_tick < _floor:
-                        # the store has no newer generation yet (e.g.
-                        # the writer restarted and has not snapshotted
-                        # past its restore point): wait for one instead
-                        # of hot-looping dial->resync->dial
-                        time.sleep(0.5)
-                    self.from_tick = max(self.from_tick, new_tick)
-                    self.applied_tick = max(self.applied_tick, new_tick)
-                    return  # redial with the new subscription tick
+                    if new_tick > self.from_tick:
+                        # re-hydrated to a newer generation: the index
+                        # was replaced under us, so advance past it
+                        self.from_tick = new_tick
+                        self.applied_tick = max(
+                            self.applied_tick, new_tick
+                        )
+                        if new_tick >= _floor:
+                            return  # redial: normal ring-tail replay
+                            # from the fresh generation
+                    # the store has no generation reaching the ring
+                    # floor yet (writer restarted without a fresh
+                    # snapshot, or the newest generation is torn):
+                    # NEVER silently accept a gap a snapshot will
+                    # cover — wait for the writer to commit one
+                    # instead of hot-looping dial->resync->dial
+                    time.sleep(0.5)
+                    return
                 self._note_progress()
             elif kind == "data":
                 _k, _src, _channel, tick, batches, _tp = frame

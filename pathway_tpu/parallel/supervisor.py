@@ -70,11 +70,17 @@ class GroupSupervisor:
         backoff_s: float = 0.25,
         poll_s: float = 0.05,
         log_dir: str | None = None,
+        initial_incarnation: int = 0,
     ):
         self.argv = list(argv)
         self.n = int(n)
         self.env = dict(env or {})
         self.rank_env = rank_env
+        # a standby-writer takeover spawns the writer role starting at
+        # the FENCED incarnation (one past everything the plane has
+        # seen) so the PWRP2 handshake token outranks any zombie; the
+        # restart budget still counts from zero
+        self.initial_incarnation = int(initial_incarnation)
         self.max_restarts = (
             max_restarts_env() if max_restarts is None else int(max_restarts)
         )
@@ -152,7 +158,7 @@ class GroupSupervisor:
                 p.wait()
 
     def run(self) -> int:
-        incarnation = 0
+        incarnation = self.initial_incarnation
         while True:
             procs = self._spawn_group(incarnation)
             failed: int | None = None
@@ -191,6 +197,14 @@ class GroupSupervisor:
                     f"{failed} last exit "
                     f"{self.last_codes[failed] if failed is not None else '?'}",
                 )
+                # propagate the code of the rank that CAUSED the
+                # give-up — a survivor we ourselves SIGTERMed would
+                # otherwise mask it with -15
+                if (
+                    failed is not None
+                    and self.last_codes[failed] not in (0, None)
+                ):
+                    return self.last_codes[failed]
                 return next(
                     (c for c in self.last_codes if c not in (0, None)), 1
                 )

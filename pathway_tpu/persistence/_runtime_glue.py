@@ -97,6 +97,30 @@ def _metrics() -> dict:
     return _M
 
 
+def resume_point(store: Any) -> dict:
+    """Where a takeover writer resumes from this store (Shard Harbor
+    standby handoff): ``state_time`` = the newest committed operator
+    -state generation's time (what :meth:`PersistenceDriver.replay`
+    restores and floors the delta ring at), ``group_commit_time`` = the
+    last durable phase-2 gen-commit barrier agreement (Phoenix Mesh
+    audit record), ``last_time`` = the durable input-log frontier the
+    connector-log replay walks to.  All -1 when absent — a fresh store
+    means the takeover rebuilds from the log alone."""
+    out = {"state_time": -1, "group_commit_time": -1, "last_time": -1}
+    raw = store.get(_META_KEY)
+    if raw is not None:
+        meta = json.loads(raw.decode())
+        out["last_time"] = int(meta.get("last_time", -1))
+        if meta.get("state"):
+            out["state_time"] = int(meta["state"].get("time", -1))
+    marker = store.get(_GROUP_COMMIT_KEY)
+    if marker is not None:
+        out["group_commit_time"] = int(
+            json.loads(marker.decode()).get("time", -1)
+        )
+    return out
+
+
 def effective_persistent_id(node: InputNode, ordinal: int) -> str:
     """Stable id for an input across restarts (reference:
     src/engine/dataflow/persist.rs:37 effective_persistent_id): explicit

@@ -192,6 +192,7 @@ class ReplicaServer:
         store_root: str | None = None,
         writer_host: str = "127.0.0.1",
         writer_port: int | None = None,
+        writer_endpoints: list[tuple[str, int]] | None = None,
         http_host: str = "127.0.0.1",
         http_port: int = 0,
         route: str = "/query",
@@ -199,22 +200,43 @@ class ReplicaServer:
         qos: Any = None,
         dim: int = 32,
         stale_after_ms: float | None = None,
+        shard: int = -1,
+        n_shards: int = 1,
     ):
         self.replica_id = int(replica_id)
         self.index_factory = index_factory
         self.store_root = store_root
         self.writer_host = writer_host
         self.writer_port = writer_port
+        self.writer_endpoints = writer_endpoints
         self.http_host = http_host
         self.http_port = http_port
         self.route = route
         self.responder = responder or default_knn_responder
         self.dim = dim
+        # Shard Harbor: this member owns one key range (jk-hash shard)
+        # of the corpus; the writer fans it only that shard's deltas and
+        # hydration drops foreign keys, so resident memory is ~1/S.  A
+        # torn assignment (shard outside [0, n_shards)) is rejected at
+        # BOOT, not discovered as silently-wrong answers.
+        self.n_shards = max(int(n_shards), 1)
+        self.shard = int(shard)
+        if self.n_shards > 1 and not (0 <= self.shard < self.n_shards):
+            raise ValueError(
+                f"replica {replica_id}: shard {self.shard} is outside "
+                f"the {self.n_shards}-shard assignment map (torn shard "
+                "configuration rejected at boot)"
+            )
+        if self.n_shards == 1:
+            self.shard = -1  # unsharded plane: full corpus
         if stale_after_ms is None:
             stale_after_ms = float(
                 os.environ.get(_STALE_AFTER_MS_ENV, "3000") or 3000
             )
         self.stale_after_s = max(stale_after_ms, 0.0) / 1000.0
+        self._has_stream = bool(
+            writer_port is not None or writer_endpoints
+        )
         self.index = index_factory()
         self.hydrated_tick = -1
         self.hydrated_gen = -1
@@ -262,7 +284,7 @@ class ReplicaServer:
         replica) readiness is just successful hydration."""
         c = self._client
         if c is None:
-            return self.hydrated_tick >= 0 or self.writer_port is None
+            return self.hydrated_tick >= 0 or not self._has_stream
         return bool(c.caught_up)
 
     def staleness_seconds(self) -> float | None:
@@ -277,7 +299,7 @@ class ReplicaServer:
         partitioned), or the stream is behind."""
         c = self._client
         if c is None:
-            return self.writer_port is not None
+            return self._has_stream
         s = c.staleness_seconds()
         if s is None:
             return True
@@ -287,17 +309,26 @@ class ReplicaServer:
 
     def start(self) -> "ReplicaServer":
         self.hydrate()
-        if self.writer_port is not None:
+        if self.writer_port is not None or self.writer_endpoints:
             from pathway_tpu.parallel.replicate import DeltaStreamClient
 
+            eps = self.writer_endpoints or [
+                (self.writer_host, int(self.writer_port))
+            ]
             self._client = DeltaStreamClient(
-                self.writer_host,
-                self.writer_port,
+                eps[0][0],
+                eps[0][1],
                 self.replica_id,
                 from_tick=self.hydrated_tick,
                 on_deltas=self._apply_deltas,
-                on_resync=self._resync,
+                # store-less replicas have no hydrate path: accept-the-
+                # gap semantics (client converges on the writer's ring)
+                # instead of waiting for a snapshot that can never come
+                on_resync=self._resync if self.store_root else None,
                 on_applied=self._on_applied,
+                shard=self.shard,
+                expect_shards=self.n_shards if self.n_shards > 1 else 0,
+                endpoints=eps,
             )
             self._client.start()
         self._http.start()
@@ -320,7 +351,9 @@ class ReplicaServer:
     def hydrate(self) -> int:
         """(Re-)hydrate the index from the newest committed generation;
         returns the hydrated tick (-1 when no store/snapshot exists —
-        the replica then builds purely from the delta stream)."""
+        the replica then builds purely from the delta stream).  A
+        sharded member drops every key outside its shard right after
+        the load, so resident memory is ~1/S of the writer's corpus."""
         if self.store_root is None:
             return self.hydrated_tick
         got = hydrate_index_state(self._open_store())
@@ -333,11 +366,47 @@ class ReplicaServer:
             fresh.load_state(payload)
         else:
             fresh = payload
+        if self.shard >= 0:
+            self._filter_to_shard(fresh)
         with self._index_lock:
             self.index = fresh
             self.hydrated_tick = tick
             self.hydrated_gen = gen
         return tick
+
+    def _filter_to_shard(self, index: Any) -> None:
+        """Drop hydrated keys this member does not own (the writer's
+        snapshot holds the FULL corpus; the delta stream is already
+        shard-filtered).  Prefers the index's compacting
+        ``filter_keys`` (releases the backing buffers — the ~1/S
+        memory claim); falls back to per-key ``remove``."""
+        from pathway_tpu.parallel.replicate import corpus_shard_of
+
+        keys_fn = getattr(index, "keys", None)
+        if not callable(keys_fn):
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "replica %d: index %s exposes no keys(); serving the "
+                "FULL hydrated corpus on a sharded plane",
+                self.replica_id,
+                type(index).__name__,
+            )
+            return
+        keys = list(keys_fn())
+        if not keys:
+            return
+        dest = corpus_shard_of(keys, self.n_shards)
+        owned = {
+            k for k, s in zip(keys, dest) if int(s) == self.shard
+        }
+        filt = getattr(index, "filter_keys", None)
+        if callable(filt):
+            filt(lambda k: k in owned)
+            return
+        for k in keys:
+            if k not in owned:
+                index.remove(k)
 
     def _resync(self) -> int:
         """Delta-stream callback: the subscription tick fell off the
@@ -368,9 +437,28 @@ class ReplicaServer:
 
     # --- serving ----------------------------------------------------------
 
+    def corpus_stats(self) -> tuple[int, int]:
+        """(resident docs, resident corpus bytes) — the per-member
+        memory evidence the shard×replica sweep records (~1/S per
+        member on a sharded plane)."""
+        with self._index_lock:
+            idx = self.index
+            try:
+                # O(1) — health is polled every PATHWAY_ROUTER_HEALTH_MS
+                # under the same lock the query path takes, so never
+                # materialize the key set here
+                docs = len(idx)
+            except TypeError:
+                keys_fn = getattr(idx, "keys", None)
+                docs = len(keys_fn()) if callable(keys_fn) else -1
+            bytes_fn = getattr(idx, "resident_bytes", None)
+            nbytes = int(bytes_fn()) if callable(bytes_fn) else -1
+        return docs, nbytes
+
     def health(self) -> dict:
         c = self._client
         s = self.staleness_seconds()
+        docs, nbytes = self.corpus_stats()
         return {
             "replica": self.replica_id,
             "incarnation": self.incarnation,
@@ -385,6 +473,15 @@ class ReplicaServer:
             else self.admission.inflight,
             "resyncs": c.resyncs if c is not None else 0,
             "hydrated_gen": self.hydrated_gen,
+            "shard": self.shard,
+            "n_shards": self.n_shards,
+            "writer_incarnation": (
+                c.writer_incarnation if c is not None else -1
+            ),
+            "fenced_writers": c.fenced_count if c is not None else 0,
+            "config_error": c.config_error if c is not None else None,
+            "corpus_docs": docs,
+            "corpus_bytes": nbytes,
         }
 
     def _count(self, status: int) -> None:
@@ -579,9 +676,14 @@ def main() -> int:
     PATHWAY_REPLICA_STORE     writer's persistence root (hydration)
     PATHWAY_REPL_PORT         writer's delta-stream port
     PATHWAY_REPL_WRITER_HOST  writer host (default 127.0.0.1)
+    PATHWAY_REPL_STANDBY      optional standby endpoint "host:port"
+                              appended to the dial list (takeover)
     PATHWAY_REPLICA_HTTP_PORT HTTP port (default 0 = ephemeral)
     PATHWAY_REPLICA_DIM       vector dimensionality (default 32)
     PATHWAY_REPLICA_ROUTE     read route (default /query)
+    PATHWAY_SERVING_SHARDS    total corpus shards (default 1)
+    PATHWAY_REPLICA_SHARD     the shard this member owns (required
+                              when PATHWAY_SERVING_SHARDS > 1)
 
     Prints ``REPLICA-READY <http_port>`` once serving, then runs until
     SIGTERM.  Exit code 0 on clean termination; Fault-Forge kills exit
@@ -609,20 +711,34 @@ def main() -> int:
     qos = QoSConfig.from_env() if serving_enabled_via_env() else None
     dim = int(os.environ.get("PATHWAY_REPLICA_DIM", "32") or 32)
     writer_port_raw = os.environ.get("PATHWAY_REPL_PORT", "")
+    writer_host = os.environ.get("PATHWAY_REPL_WRITER_HOST", "127.0.0.1")
+    endpoints: list[tuple[str, int]] | None = None
+    standby_raw = os.environ.get("PATHWAY_REPL_STANDBY", "")
+    if writer_port_raw and standby_raw:
+        host, _, port = standby_raw.rpartition(":")
+        endpoints = [
+            (writer_host, int(writer_port_raw)),
+            (host or writer_host, int(port)),
+        ]
+    from pathway_tpu.parallel.replicate import shards_env
+
+    n_shards = shards_env()
+    shard_raw = os.environ.get("PATHWAY_REPLICA_SHARD", "")
     server = ReplicaServer(
         replica_id=int(os.environ.get("PATHWAY_REPLICA_ID", "0") or 0),
         index_factory=lambda: TpuDenseKnnIndex(dimensions=dim),
         store_root=os.environ.get("PATHWAY_REPLICA_STORE") or None,
-        writer_host=os.environ.get(
-            "PATHWAY_REPL_WRITER_HOST", "127.0.0.1"
-        ),
+        writer_host=writer_host,
         writer_port=int(writer_port_raw) if writer_port_raw else None,
+        writer_endpoints=endpoints,
         http_port=int(
             os.environ.get("PATHWAY_REPLICA_HTTP_PORT", "0") or 0
         ),
         route=os.environ.get("PATHWAY_REPLICA_ROUTE", "/query"),
         qos=qos,
         dim=dim,
+        shard=int(shard_raw) if shard_raw else -1,
+        n_shards=n_shards,
     )
     server.start()
     stop = threading.Event()

@@ -43,6 +43,23 @@ once they report ``ready`` again.
 Deadlines: ``x-pathway-deadline-ms`` propagates with the REMAINING
 budget per attempt, so a retried request never outlives its original
 deadline, and the trace context rides ``traceparent`` end to end.
+
+Shard Harbor (scatter-gather): with a shard map (``shards=[[urls...],
+...]`` or ``PATHWAY_SERVING_SHARD_MAP`` — ``|``-separated shards of
+``,``-separated member URLs), each replica owns ONE jk-hash key range
+of the corpus, and a read fans out to one qualified member per shard
+(the same occupancy-weighted pick WITHIN the shard), merging the
+per-shard top-k into the global top-k (:func:`merge_topk` — per-shard
+key sets are disjoint, so the union of per-shard top-k always contains
+the global top-k).  Per-shard attempts are ``router.attempt`` child
+spans carrying a ``shard`` attribute.  Partial-shard outage follows
+the established degrade ladder PER SHARD: fresh member first, stale
+member for unbounded reads; when a shard has NOBODY to answer, the
+whole read sheds with an explicit 503 + ``Retry-After`` NAMING the
+missing shards (``x-pathway-missing-shards``) — a partial corpus is
+never silently served as if it were complete.  A torn shard map
+(empty shard, member listed in two shards) is rejected at construction
+(:func:`validate_shard_map`), not discovered as wrong answers.
 """
 
 from __future__ import annotations
@@ -77,6 +94,59 @@ def replicas_from_env() -> list[str]:
     return [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
 
 
+def shard_map_from_env() -> list[list[str]] | None:
+    """PATHWAY_SERVING_SHARD_MAP: ``|``-separated shards (position =
+    shard id) of ``,``-separated member URLs, e.g.
+    ``http://h:9101,http://h:9102|http://h:9103,http://h:9104`` for a
+    2-shard × 2-member plane.  None when unset."""
+    raw = os.environ.get("PATHWAY_SERVING_SHARD_MAP", "")
+    if not raw.strip():
+        return None
+    shards = [
+        [u.strip().rstrip("/") for u in part.split(",") if u.strip()]
+        for part in raw.split("|")
+    ]
+    validate_shard_map(shards)
+    return shards
+
+
+def validate_shard_map(shards: list[list[str]]) -> None:
+    """Reject a torn shard assignment map at BOOT: every shard needs at
+    least one member, and no member may appear in two shards (it would
+    be fed two different key ranges and answer both wrongly)."""
+    if not shards:
+        raise ValueError("shard map is empty")
+    seen: dict[str, int] = {}
+    for s, members in enumerate(shards):
+        if not members:
+            raise ValueError(
+                f"torn shard map: shard {s} has no members — every key "
+                "range needs at least one owner"
+            )
+        for url in members:
+            if url in seen:
+                raise ValueError(
+                    f"torn shard map: {url} is listed in shard "
+                    f"{seen[url]} AND shard {s} — a member owns exactly "
+                    "one key range"
+                )
+            seen[url] = s
+
+
+def merge_topk(
+    per_shard_matches: list[list], k: int
+) -> list[list]:
+    """Merge per-shard top-k ``[key, score]`` lists into the global
+    top-k: shards own disjoint key ranges, so the union of per-shard
+    top-k (each ≥ k deep or exhausted) always contains the global
+    top-k.  Ordering is (score desc, key asc) — the deterministic
+    tie-break that makes the merge bit-equal to an unsharded index
+    using the same rule, regardless of how the corpus was split."""
+    merged = [m for shard in per_shard_matches for m in shard]
+    merged.sort(key=lambda m: (-float(m[1]), m[0]))
+    return [list(m) for m in merged[: max(int(k), 0)]]
+
+
 def hedge_ms_env() -> float:
     raw = os.environ.get("PATHWAY_SERVING_HEDGE_MS", "") or "0"
     try:
@@ -94,9 +164,10 @@ class _Transport(Exception):
 class ReplicaEndpoint:
     """Router-side view of one replica: URL + health + occupancy."""
 
-    def __init__(self, name: str, url: str):
+    def __init__(self, name: str, url: str, shard: int = 0):
         self.name = name
         self.url = url.rstrip("/")
+        self.shard = shard  # the jk-hash key range this member owns
         self.inflight = 0  # router-side in-flight (attempts)
         self.reported_inflight = 0  # replica's admission occupancy
         self.ewma_ms = 0.0
@@ -133,6 +204,7 @@ class FailoverRouter:
         self,
         replicas: list[str] | None = None,
         *,
+        shards: list[list[str]] | None = None,
         host: str = "127.0.0.1",
         port: int = 0,
         retries: int | None = None,
@@ -142,15 +214,28 @@ class FailoverRouter:
         default_deadline_ms: float = 30_000.0,
         max_deadline_ms: float = 120_000.0,
     ):
-        urls = replicas if replicas is not None else replicas_from_env()
-        if not urls:
-            raise ValueError(
-                "FailoverRouter needs at least one replica URL (pass "
-                "replicas=[...] or set PATHWAY_SERVING_REPLICAS)"
-            )
-        self.endpoints = [
-            ReplicaEndpoint(f"replica{i}", u) for i, u in enumerate(urls)
-        ]
+        if shards is None and replicas is None:
+            shards = shard_map_from_env()
+        if shards is not None:
+            validate_shard_map(shards)
+            self.n_shards = len(shards)
+            self.endpoints = [
+                ReplicaEndpoint(f"s{s}.replica{i}", u, shard=s)
+                for s, members in enumerate(shards)
+                for i, u in enumerate(members)
+            ]
+        else:
+            urls = replicas if replicas is not None else replicas_from_env()
+            if not urls:
+                raise ValueError(
+                    "FailoverRouter needs at least one replica URL (pass "
+                    "replicas=[...] / shards=[[...]], or set "
+                    "PATHWAY_SERVING_REPLICAS / PATHWAY_SERVING_SHARD_MAP)"
+                )
+            self.n_shards = 1
+            self.endpoints = [
+                ReplicaEndpoint(f"replica{i}", u) for i, u in enumerate(urls)
+            ]
         self.host = host
         self.port = port
         if retries is None:
@@ -341,13 +426,52 @@ class FailoverRouter:
                     s = h.get("staleness_seconds")
                     ep.staleness_s = None if s is None else float(s)
                     ep.reported_inflight = int(h.get("inflight", 0))
-                    was_ready = ep.ready
                     ep.ready = bool(h.get("ready", False))
-                    if ep.ejected and ep.ready:
+                    # Shard Harbor: a member whose REPORTED ownership
+                    # disagrees with its slot in the map would serve the
+                    # wrong key range with healthy-looking 200s —
+                    # merged top-k silently drops its slot's range (and
+                    # duplicates another's).  The health payload names
+                    # what the member actually owns; trust it over the
+                    # map and refuse to route there.
+                    mismatch = None
+                    try:
+                        rep_shard = int(h.get("shard", -1))
+                        rep_n = int(h.get("n_shards", 0))
+                    except (TypeError, ValueError):
+                        rep_shard, rep_n = -1, 0
+                    if self.n_shards > 1:
+                        if rep_n > 0 and rep_n != self.n_shards:
+                            mismatch = (
+                                f"shard-mismatch: member splits the "
+                                f"corpus {rep_n} way(s), the map has "
+                                f"{self.n_shards}"
+                            )
+                        elif rep_shard >= 0 and rep_shard != ep.shard:
+                            mismatch = (
+                                f"shard-mismatch: member owns shard "
+                                f"{rep_shard}, the map lists it under "
+                                f"shard {ep.shard}"
+                            )
+                    elif rep_n > 1:
+                        # the inverse misconfig: a shard-owning member
+                        # behind a PLAIN replicas-list router would
+                        # answer every routed read from 1/S of the
+                        # corpus with healthy-looking 200s
+                        mismatch = (
+                            f"shard-mismatch: member owns 1/{rep_n} of "
+                            "the corpus but this router is unsharded "
+                            "(use PATHWAY_SERVING_SHARD_MAP)"
+                        )
+                    if mismatch is not None:
+                        ep.ready = False
+                        if not ep.ejected:
+                            self._eject(ep, mismatch)
+                    elif ep.ejected and ep.ready:
                         # the freshness bound for re-admission: the
-                        # replica reports caught-up again
+                        # replica reports caught-up again (and, on a
+                        # sharded plane, its ownership matches its slot)
                         self._readmit(ep)
-                    del was_ready
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -394,11 +518,19 @@ class FailoverRouter:
         return v if math.isfinite(v) else None
 
     def _candidates(
-        self, max_staleness_ms: float | None, tried: set
+        self,
+        max_staleness_ms: float | None,
+        tried: set,
+        shard: int | None = None,
     ) -> list[ReplicaEndpoint]:
+        pool = (
+            self.endpoints
+            if shard is None
+            else [ep for ep in self.endpoints if ep.shard == shard]
+        )
         fresh = [
             ep
-            for ep in self.endpoints
+            for ep in pool
             if ep.name not in tried and ep.qualifies(max_staleness_ms)
         ]
         if fresh:
@@ -408,7 +540,7 @@ class FailoverRouter:
             # answer (explicit x-pathway-stale headers) over a 503
             stale = [
                 ep
-                for ep in self.endpoints
+                for ep in pool
                 if ep.name not in tried and ep.serves_stale()
             ]
             return sorted(stale, key=ReplicaEndpoint.score)
@@ -430,7 +562,7 @@ class FailoverRouter:
         }
         headers["x-pathway-deadline-ms"] = f"{remaining * 1000.0:.1f}"
         span = tracing.get_tracer().span(
-            "router.attempt", replica=ep.name
+            "router.attempt", replica=ep.name, shard=str(ep.shard)
         )
         ep.inflight += 1
         t0 = time.perf_counter()
@@ -482,9 +614,14 @@ class FailoverRouter:
             route=request.path,
         )
         with span:
-            status, payload, headers, outcome, replica = (
-                await self._route(request, body, deadline, max_st)
-            )
+            if self.n_shards > 1:
+                status, payload, headers, outcome, replica = (
+                    await self._route_scatter(request, body, deadline, max_st)
+                )
+            else:
+                status, payload, headers, outcome, replica = (
+                    await self._route(request, body, deadline, max_st)
+                )
             span.set_attribute("status", status)
             span.set_attribute("outcome", outcome)
         self._m_requests.labels(replica, outcome).inc()
@@ -559,6 +696,189 @@ class FailoverRouter:
             },
             "no_replica",
             "none",
+        )
+
+    # --- scatter-gather (Shard Harbor) ------------------------------------
+
+    @staticmethod
+    def _request_k(body: bytes) -> int:
+        import json as _json
+
+        try:
+            v = _json.loads(body or b"{}")
+            return max(int(v.get("k", 3)), 0)
+        except (ValueError, TypeError, AttributeError):
+            return 3
+
+    async def _shard_fetch(
+        self,
+        shard: int,
+        request,
+        body: bytes,
+        deadline: float,
+        max_st: float | None,
+    ):
+        """One shard's leg of the scatter: same qualify/degrade/retry
+        ladder as the single-shard route, restricted to the shard's
+        members.  Returns (status, payload, headers, replica) on an
+        answer, None when the shard is unavailable (every member tried,
+        ejected, or over the staleness bound)."""
+        tried: set[str] = set()
+        failure_retries = 0
+        while True:
+            cands = self._candidates(max_st, tried, shard=shard)
+            if not cands:
+                return None
+            ep = cands[0]
+            tried.add(ep.name)
+            try:
+                status, payload, headers = await self._attempt_hedged(
+                    ep, cands[1:], tried, request, body, deadline
+                )
+            except asyncio.TimeoutError:
+                raise  # the ORIGINAL deadline is spent: the gather
+                # surfaces one 504 for the whole read
+            except _Transport as e:
+                self._eject(ep, f"transport: {e}")
+                if failure_retries >= self.retries:
+                    return None
+                failure_retries += 1
+                self._m_retries.inc()
+                continue
+            if status in (429, 503) or status >= 500:
+                # shed or member error: steer to a shard sibling —
+                # bounded by the tried set
+                continue
+            # 200 AND non-shed client errors (400/404/...) return: a
+            # permanently-bad request must surface as its real status,
+            # not burn every member and masquerade as a health outage
+            return status, payload, headers, ep.name
+
+    async def _route_scatter(
+        self, request, body: bytes, deadline: float, max_st: float | None
+    ) -> tuple[int, bytes, dict, str, str]:
+        """Fan the read out to one qualified member per shard and merge
+        per-shard top-k into global top-k.  Missing shards are NAMED
+        (503 + Retry-After + x-pathway-missing-shards) — a partial
+        corpus never masquerades as the whole one."""
+        import json as _json
+
+        k = self._request_k(body)
+        # return_exceptions: every per-shard task runs to completion —
+        # a bare gather would propagate the first TimeoutError and
+        # leave the other shards' fetches running as orphans, retrying
+        # members against a spent deadline after the 504 already went
+        # out
+        results = await asyncio.gather(
+            *(
+                self._shard_fetch(s, request, body, deadline, max_st)
+                for s in range(self.n_shards)
+            ),
+            return_exceptions=True,
+        )
+        for r in results:
+            if isinstance(r, BaseException) and not isinstance(
+                r, asyncio.TimeoutError
+            ):
+                raise r
+        if any(isinstance(r, asyncio.TimeoutError) for r in results):
+            return (
+                504,
+                _json_err("deadline exceeded at router"),
+                {"content-type": "application/json"},
+                "deadline",
+                "scatter",
+            )
+        missing = [s for s, r in enumerate(results) if r is None]
+        if missing:
+            names = ",".join(str(s) for s in missing)
+            return (
+                503,
+                _json_err(
+                    f"shard(s) {names} unavailable"
+                    + (
+                        f" within x-pathway-max-staleness-ms={max_st:g}"
+                        if max_st is not None
+                        else " (all members ejected or unreachable)"
+                    )
+                ),
+                {
+                    "Retry-After": "1.0",
+                    "x-pathway-missing-shards": names,
+                    "content-type": "application/json",
+                },
+                "shard_unavailable",
+                "scatter",
+            )
+        per_shard = []
+        applied_ticks: list[int] = []
+        staleness: list[float] = []
+        any_stale = False
+        replicas = []
+        for status, payload, headers, replica in results:
+            if status != 200:
+                # a client error (400/404/...) from any shard: the
+                # request itself is bad — surface it unmerged
+                return (
+                    status,
+                    payload,
+                    headers,
+                    f"status_{status}",
+                    replica,
+                )
+            replicas.append(replica)
+            try:
+                matches = _json.loads(payload).get("matches", [])
+            except ValueError:
+                matches = None
+            if matches is None:
+                return (
+                    502,
+                    _json_err(
+                        f"replica {replica} returned a non-KNN payload "
+                        "on a sharded plane (scatter-gather needs the "
+                        "matches contract)"
+                    ),
+                    {"content-type": "application/json"},
+                    "bad_shard_payload",
+                    replica,
+                )
+            per_shard.append(matches)
+            tick = headers.get("x-pathway-applied-tick")
+            if tick is not None:
+                try:
+                    applied_ticks.append(int(tick))
+                except ValueError:
+                    pass
+            st = headers.get("x-pathway-staleness-seconds")
+            if st is not None:
+                try:
+                    staleness.append(float(st))
+                except ValueError:
+                    pass
+            if headers.get("x-pathway-stale"):
+                any_stale = True
+        merged = merge_topk(per_shard, k)
+        out_headers = {
+            "content-type": "application/json",
+            "x-pathway-shards": str(self.n_shards),
+            "x-pathway-replica": ",".join(replicas),
+        }
+        if applied_ticks:
+            # the plane is only as fresh as its LEAST caught-up shard
+            out_headers["x-pathway-applied-tick"] = str(min(applied_ticks))
+        if staleness:
+            out_headers["x-pathway-staleness-seconds"] = (
+                f"{max(staleness):.3f}"
+            )
+        if any_stale:
+            out_headers["x-pathway-stale"] = "true"
+        return (
+            200,
+            _json.dumps({"matches": merged}).encode(),
+            out_headers,
+            "ok",
+            "scatter",
         )
 
     async def _attempt_hedged(

@@ -98,6 +98,48 @@ class TpuDenseKnnIndex:
             self.corpus.remove(key)
         self.metadata.pop(key, None)
 
+    # --- shard-ownership support (Shard Harbor, serving/replica.py) -------
+
+    def __len__(self) -> int:
+        return 0 if self.corpus is None else len(self.corpus)
+
+    def keys(self) -> list[int]:
+        """Resident corpus row keys."""
+        c = self.corpus
+        return [] if c is None else list(c.slot_of.keys())
+
+    def filter_keys(self, pred) -> None:
+        """Keep only keys matching ``pred`` and COMPACT the backing
+        buffers to the kept count — ``remove()`` frees slots but keeps
+        the host/device arrays at their old capacity, which would erase
+        the ~1/S per-member memory win a sharded replica hydrates for."""
+        c = self.corpus
+        if c is None:
+            self.metadata = {k: v for k, v in self.metadata.items() if pred(k)}
+            return
+        kept = [(k, s) for k, s in c.slot_of.items() if pred(k)]
+        from pathway_tpu.ops.knn import DeviceCorpus
+
+        fresh = DeviceCorpus(
+            c.dim,
+            max(len(kept), 1),
+            sharding=c.sharding,
+            valid_sharding=c.valid_sharding,
+        )
+        for key, slot in kept:
+            fresh.upsert(key, c.host[slot])
+        self.corpus = fresh
+        self.metadata = {k: v for k, v in self.metadata.items() if pred(k)}
+
+    def resident_bytes(self) -> int:
+        """Host-side resident corpus bytes (the device mirror is the
+        same shape) — the per-member memory evidence the shard×replica
+        sweep records."""
+        c = self.corpus
+        if c is None:
+            return 0
+        return int(c.host.nbytes + c.valid_host.nbytes)
+
     # --- operator-snapshot support (reference: operator_snapshot.rs) ------
     # host-side content only; device arrays are re-uploaded lazily
 

@@ -31,7 +31,17 @@ Directives:
     run.  Same incarnation gating as engine kills: a supervised restart
     of the replica runs fault-free by default.  The delta stream itself
     is targeted with the wire directives below via its channel prefix
-    (``ch:repl`` — e.g. ``delay=ch:repl,nth:3,ms:200``).
+    (``ch:repl`` — e.g. ``delay=ch:repl,nth:3,ms:200``; the
+    writer→standby leg alone via ``ch:repl:standby``).
+``kill=writer:1[,tick:<T>][,inc:<I>]``
+    Writer-scoped kill (Shard Harbor, symmetric with ``kill=replica``):
+    ``os._exit(FAULT_EXIT)`` on the replication WRITER when it has
+    PUBLISHED its T-th distinct delta-stream tick (default 1) — the
+    deterministic counter is the delta publisher's distinct-tick count,
+    so standby takeover lands at the same stream position every run.
+    Fires only on a process that IS a publisher (PATHWAY_REPL_PORT
+    armed); incarnation-gated like every kill, so the standby's
+    takeover writer (bumped incarnation) runs fault-free by default.
 ``drop=ch:<prefix>,nth:<K>[,pid:<P>][,inc:<I>]``
     Silently drop the K-th wire frame sent on channels whose name starts
     with ``<prefix>`` (``bar`` = barrier frames, ``hb`` = heartbeats).
@@ -182,6 +192,19 @@ class FaultPlan:
                             "scoped kills (replicas have no tick "
                             "head/tail)"
                         )
+                elif args.get("writer") is not None:
+                    # writer-scoped kill: counts distinct PUBLISHED
+                    # delta ticks; `at` is meaningless (the publish
+                    # point is the deterministic clock)
+                    d.arg_int("writer")
+                    if args.get("tick") is not None:
+                        d.arg_int("tick")
+                    if args.get("at") is not None:
+                        raise FaultSpecError(
+                            "kill: `at` does not apply to writer-"
+                            "scoped kills (the publish point is the "
+                            "clock)"
+                        )
                 else:
                     d.arg_int("tick")
                     if args.get("at", "head") not in ("head", "tail"):
@@ -232,8 +255,12 @@ class FaultPlan:
         for d in self.directives:
             if d.name != "kill" or d.fired:
                 continue
-            if d.args.get("replica") is not None:
-                continue  # replica-scoped kills fire in on_replica_tick
+            if (
+                d.args.get("replica") is not None
+                or d.args.get("writer") is not None
+            ):
+                continue  # replica-/writer-scoped kills fire in their
+                # own hooks (on_replica_tick / on_writer_tick)
             if not d.matches_process(self.pid, self.incarnation):
                 continue
             if d.args.get("at", "head") != phase:
@@ -260,6 +287,24 @@ class FaultPlan:
                 self._exit(
                     f"kill replica {replica_id} after applied tick "
                     f"{n_applied}"
+                )
+
+    def on_writer_tick(self, n_published: int) -> None:
+        """Called by the replication writer's delta publisher
+        (parallel/replicate.py) after fanning out each DISTINCT tick;
+        ``n_published`` is the deterministic per-process published-tick
+        counter ``kill=writer:1,tick:T`` fires on."""
+        for d in self.directives:
+            if d.name != "kill" or d.fired:
+                continue
+            if d.args.get("writer") is None:
+                continue
+            if not d.matches_process(self.pid, self.incarnation):
+                continue
+            if n_published >= (d.arg_int("tick", 1) or 1):
+                d.fired += 1
+                self._exit(
+                    f"kill writer after published tick {n_published}"
                 )
 
     def on_wire_send(self, channel: str) -> tuple[str, float] | None:

@@ -649,3 +649,171 @@ def test_delta_stream_wire_faults_deterministic(monkeypatch):
             srv.close()
     finally:
         faults.reset()
+
+
+# --- Shard Harbor fault specs (writer-scoped kills + standby leg) ----------
+
+
+def test_writer_kill_spec_parses_fires_on_published_tick():
+    p = _plan("kill=writer:1,tick:3")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    p.on_writer_tick(1)
+    p.on_writer_tick(2)
+    assert not exits
+    p.on_writer_tick(3)
+    assert exits and "writer" in exits[0]
+    # fired once, never again
+    p.on_writer_tick(4)
+    assert len(exits) == 1
+
+
+def test_writer_kill_defaults_to_first_published_tick():
+    p = _plan("kill=writer:1")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    p.on_writer_tick(1)
+    assert len(exits) == 1
+
+
+def test_writer_kill_ignored_by_other_hooks():
+    p = _plan("kill=writer:1,tick:1")
+    exits: list[str] = []
+    p._exit = lambda what: exits.append(what)
+    for t in range(1, 6):
+        p.on_tick(t, "head")
+        p.on_tick(t, "tail")
+        p.on_replica_tick(0, t)
+    assert not exits  # writer-scoped kills never fire elsewhere
+    p.on_writer_tick(1)
+    assert len(exits) == 1
+    # and conversely: engine/replica kills never fire on writer ticks
+    p2 = _plan("kill=tick:1;kill=replica:0,tick:1")
+    exits2: list[str] = []
+    p2._exit = lambda what: exits2.append(what)
+    for n in range(1, 6):
+        p2.on_writer_tick(n)
+    assert not exits2
+
+
+def test_writer_kill_incarnation_scoped():
+    # default inc:0 — the standby's takeover writer runs fault-free
+    p1 = _plan("kill=writer:1,tick:1", inc=1)
+    exits: list[str] = []
+    p1._exit = lambda what: exits.append(what)
+    for n in range(1, 6):
+        p1.on_writer_tick(n)
+    assert not exits
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "kill=writer:notanint",
+        "kill=writer:1,at:head",  # `at` is meaningless for writers
+        "kill=writer:1,tick:x",
+    ],
+)
+def test_writer_kill_spec_validation(bad):
+    with pytest.raises(faults.FaultSpecError):
+        _plan(bad)
+
+
+def test_publisher_fires_writer_kill_deterministically(monkeypatch):
+    """The delta publisher drives on_writer_tick with its distinct-tick
+    counter: a same-tick merge (second index node) does not advance
+    it."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "fault-test-secret")
+    monkeypatch.setenv("PATHWAY_FAULTS", "kill=writer:1,tick:3")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.delenv("PATHWAY_MESH_INCARNATION", raising=False)
+    faults.reset()
+    try:
+        from pathway_tpu.parallel.replicate import DeltaStreamServer
+
+        srv = DeltaStreamServer(0)
+        exits: list[str] = []
+        srv._fault_plan._exit = lambda what: exits.append(what)
+        try:
+            srv.publish(0, [])
+            srv.publish(1, [])
+            srv.publish(1, [])  # same-tick merge: not a new tick
+            assert not exits
+            srv.publish(2, [])
+            assert exits and "published tick 3" in exits[0]
+        finally:
+            srv.close()
+    finally:
+        faults.reset()
+
+
+def test_standby_leg_wire_faults_target_only_standby(monkeypatch):
+    """drop=ch:repl:standby drops frames on the writer→standby leg
+    ONLY — the replica fan-out (channel repl:idx) is untouched, so
+    takeover determinism is testable without perturbing the read
+    plane."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "fault-test-secret")
+    monkeypatch.setenv("PATHWAY_FAULTS", "drop=ch:repl:standby,nth:2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.delenv("PATHWAY_MESH_INCARNATION", raising=False)
+    faults.reset()
+    try:
+        from pathway_tpu.engine.batch import DiffBatch
+        from pathway_tpu.parallel.replicate import (
+            STANDBY_ID,
+            DeltaStreamClient,
+            DeltaStreamServer,
+        )
+
+        srv = DeltaStreamServer(0)
+        replica_applied: list[int] = []
+        standby_applied: list[int] = []
+        cl = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            0,
+            from_tick=-1,
+            on_deltas=lambda t, bs: replica_applied.append(t),
+        )
+        sb = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            STANDBY_ID,
+            from_tick=-1,
+            on_deltas=lambda t, bs: standby_applied.append(t),
+        )
+        cl.start()
+        sb.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not (
+                cl.connected and sb.connected
+            ):
+                time.sleep(0.05)
+            for t in range(4):
+                srv.publish(
+                    t,
+                    [
+                        DiffBatch.from_rows(
+                            [(t, 1, ("x", None))], ("_data", "_meta")
+                        )
+                    ],
+                )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and (
+                not replica_applied
+                or replica_applied[-1] < 3
+                or not standby_applied
+                or standby_applied[-1] < 3
+            ):
+                time.sleep(0.05)
+            # the standby missed exactly its 2nd frame; the replica saw
+            # every tick
+            assert replica_applied == [0, 1, 2, 3], replica_applied
+            assert standby_applied == [0, 2, 3], standby_applied
+        finally:
+            cl.close()
+            sb.close()
+            srv.close()
+    finally:
+        faults.reset()

@@ -717,6 +717,8 @@ def test_unreplicated_serving_info_when_staleness_unbounded(monkeypatch):
         "http://127.0.0.1:9101,http://127.0.0.1:9102",
     )
     monkeypatch.delenv("PATHWAY_SERVING_MAX_STALENESS_MS", raising=False)
+    # a standby writer is configured: the ingest-SPOF facet stays quiet
+    monkeypatch.setenv("PATHWAY_REPL_STANDBY", "127.0.0.1:9200")
     _gated_index_graph(tmp_port=18101)
     found = run_doctor().by_rule("unreplicated-serving")
     assert len(found) == 1
@@ -724,6 +726,75 @@ def test_unreplicated_serving_info_when_staleness_unbounded(monkeypatch):
     assert "max-staleness" in found[0].message
     # bounding staleness clears the finding
     monkeypatch.setenv("PATHWAY_SERVING_MAX_STALENESS_MS", "2000")
+    assert not run_doctor().by_rule("unreplicated-serving")
+
+
+def test_unreplicated_serving_warns_missing_standby_writer(monkeypatch):
+    """Shard Harbor facet: a replicated read plane whose single ingest
+    writer has no standby is still an SPOF — kill the writer and every
+    replica serves permanently stale data."""
+    from pathway_tpu.serving import degrade
+
+    degrade.reset()
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS",
+        "http://127.0.0.1:9101,http://127.0.0.1:9102",
+    )
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_STALENESS_MS", "2000")
+    monkeypatch.delenv("PATHWAY_REPL_STANDBY", raising=False)
+    _gated_index_graph(tmp_port=18103)
+    found = run_doctor().by_rule("unreplicated-serving")
+    assert len(found) == 1
+    assert found[0].severity == Severity.WARNING
+    assert "standby" in found[0].message
+    # configuring the standby clears it
+    monkeypatch.setenv("PATHWAY_REPL_STANDBY", "127.0.0.1:9200")
+    assert not run_doctor().by_rule("unreplicated-serving")
+
+
+def test_unreplicated_serving_info_single_owner_shard(monkeypatch):
+    """Shard Harbor facet: a shard with one owner turns any member
+    death into a partial-corpus outage (503 naming the shard)."""
+    from pathway_tpu.serving import degrade
+
+    degrade.reset()
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS",
+        "http://127.0.0.1:9101,http://127.0.0.1:9102",
+    )
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_STALENESS_MS", "2000")
+    monkeypatch.setenv("PATHWAY_REPL_STANDBY", "127.0.0.1:9200")
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_SHARD_MAP",
+        "http://127.0.0.1:9101,http://127.0.0.1:9102|http://127.0.0.1:9103",
+    )
+    _gated_index_graph(tmp_port=18104)
+    found = run_doctor().by_rule("unreplicated-serving")
+    assert len(found) == 1
+    assert found[0].severity == Severity.INFO
+    assert "single owner" in found[0].message
+    assert found[0].data["single_owner_shards"] == [1]
+    # two members per shard clears it
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_SHARD_MAP",
+        "http://127.0.0.1:9101,http://127.0.0.1:9102"
+        "|http://127.0.0.1:9103,http://127.0.0.1:9104",
+    )
+    assert not run_doctor().by_rule("unreplicated-serving")
+    # shard-count form (no map): 2 replicas over 3 shards pigeonholes
+    # at least one single-owner shard — the finding names the counts,
+    # not invented shard ids
+    monkeypatch.delenv("PATHWAY_SERVING_SHARD_MAP", raising=False)
+    monkeypatch.setenv("PATHWAY_SERVING_SHARDS", "3")
+    found = run_doctor().by_rule("unreplicated-serving")
+    assert [f.severity for f in found] == [Severity.INFO]
+    assert "at least one shard" in found[0].message
+    assert found[0].data == {"shards": 3, "replicas": 2}
+    # 6 replicas over 3 shards CAN give every shard two owners: quiet
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS",
+        ",".join(f"http://127.0.0.1:91{i:02d}" for i in range(6)),
+    )
     assert not run_doctor().by_rule("unreplicated-serving")
 
 

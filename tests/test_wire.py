@@ -573,3 +573,73 @@ def test_bad_wire_knob_rejected(monkeypatch):
     monkeypatch.setenv("PATHWAY_DCN_QUANT", "fp4")
     with pytest.raises(hx.HostMeshError, match="PATHWAY_DCN_QUANT"):
         hx.HostMesh(2, 0, _free_port_pair())
+
+
+# --- receive-side decode pool (wide fan-in long tail) ----------------------
+
+
+def test_decode_pool_roundtrip_many_channels(monkeypatch):
+    """With the decode pool forced on, data frames and barriers still
+    deliver completely and correctly: delivery slots are keyed
+    (channel, tick, src), so unordered pool decode cannot corrupt a
+    gather."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-decode-pool")
+    monkeypatch.setenv("PATHWAY_DCN_DECODE_POOL", "3")
+    m0, m1 = _mesh_pair(_free_port_pair())
+    try:
+        assert m0._decode_pool is not None
+        rng = np.random.default_rng(21)
+        sent = {}
+        for t in range(30):
+            ch = f"ch{t % 3}"
+            b = _rand_batch(rng, 40, with_obj=False)
+            sent[(ch, t)] = b
+            m0.send(1, ch, t, [b])
+        for (ch, t), b in sent.items():
+            got = m1.gather(ch, t, timeout=30)
+            assert wire.batches_equal(got[0], [b])
+        # barriers ride the pool too
+        import threading as _threading
+
+        res = {}
+
+        def bar(m, key):
+            res[key] = m.barrier(key, timeout=30)
+
+        ts = [
+            _threading.Thread(target=bar, args=(m, k))
+            for m, k in ((m0, "a"), (m1, "b"))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert res["a"] == {0: "a", 1: "b"}
+        assert res["b"] == {0: "a", 1: "b"}
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_decode_pool_auto_off_for_narrow_fanin(monkeypatch):
+    """Default auto mode keeps a 2-process mesh on inline decode (each
+    peer already has a dedicated reader; the pool only pays off on
+    wide fan-ins)."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-decode-auto")
+    monkeypatch.delenv("PATHWAY_DCN_DECODE_POOL", raising=False)
+    m0, m1 = _mesh_pair(_free_port_pair())
+    try:
+        assert m0._decode_pool is None
+        assert m1._decode_pool is None
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_decode_pool_bad_knob_rejected(monkeypatch):
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "wire-decode-bad")
+    monkeypatch.setenv("PATHWAY_DCN_DECODE_POOL", "many")
+    with pytest.raises(hx.HostMeshError, match="PATHWAY_DCN_DECODE_POOL"):
+        hx.HostMesh(2, 0, _free_port_pair())
