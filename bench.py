@@ -639,6 +639,219 @@ def _bench_embed(np, on_accel):
     return float(reps * batch / dt), round(tflops, 2), mfu
 
 
+def _bench_compiled_tick(np):
+    """Tick Forge tier (ISSUE 12): the escape-hatch interpreter
+    (PATHWAY_COMPILED_TICK=0 — the pre-Forge engine: object-column
+    connector ingest, one kernel dispatch per operator per tick) vs the
+    compiled tick (typed ingest + fused, shape-bucketed XLA segment
+    programs) on three 1M-row pipelines.  Every tick is 32768 rows so
+    the whole run lands on ONE pad-ladder bucket — the steady-state
+    serving shape — and the warm pass must hit the program cache on
+    every dispatch (cache_hit_rate_warm is measured from the registry
+    counters across the timed run)."""
+    import gc
+
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.expression_eval import InternalColRef
+    from pathway_tpu.engine.nodes import (
+        FilterNode,
+        GroupByNode,
+        InputNode,
+        OutputNode,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.reducers import ReducerSpec
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+    from pathway_tpu.observability import REGISTRY
+
+    # 2**20 rows in 32 equal 32768-row ticks: every tick lands on ONE
+    # pad-ladder bucket, so the steady-state cache hit rate is visible
+    # (a 1e6 row count leaves a ragged final tick on a second bucket)
+    n_rows, tick_rows = 1_048_576, 32_768
+
+    def ref(name):
+        return InternalColRef(0, name)
+
+    def obj_col(values):
+        out = np.empty(len(values), dtype=object)
+        out[:] = values
+        return out
+
+    class _Src(StaticSource):
+        def __init__(self, names, ticks):
+            super().__init__(names)
+            self._ticks = ticks
+
+        def events(self):
+            for i, b in enumerate(self._ticks):
+                yield i, b
+
+    rng = np.random.default_rng(12)
+    a_all = [int(v) for v in rng.integers(-1000, 1000, n_rows)]
+    b_all = [float(v) for v in rng.normal(size=n_rows)]
+    words = [f"word{i % 1000}" for i in rng.integers(0, 1000, n_rows)]
+
+    def numeric_ticks():
+        # connector-realistic object columns: exactly what from_rows /
+        # the jsonlines reader hand the engine before typed ingest
+        ticks = []
+        for lo in range(0, n_rows, tick_rows):
+            hi = min(n_rows, lo + tick_rows)
+            ticks.append(
+                DiffBatch(
+                    np.arange(lo, hi, dtype=np.uint64),
+                    np.ones(hi - lo, np.int64),
+                    {
+                        "a": obj_col(a_all[lo:hi]),
+                        "b": obj_col(b_all[lo:hi]),
+                    },
+                )
+            )
+        return ticks
+
+    def wordcount_graph(sink):
+        ticks = []
+        for lo in range(0, n_rows, tick_rows):
+            hi = min(n_rows, lo + tick_rows)
+            ticks.append(
+                DiffBatch(
+                    np.arange(lo, hi, dtype=np.uint64),
+                    np.ones(hi - lo, np.int64),
+                    {"word": obj_col(words[lo:hi])},
+                )
+            )
+        inp = InputNode(_Src(["word"], ticks), ["word"])
+        gb = GroupByNode(
+            inp, ["word"], {"count": ReducerSpec(kind="count")}
+        )
+        return OutputNode(gb, sink)
+
+    def groupby_chain_graph(sink):
+        inp = InputNode(_Src(["a", "b"], numeric_ticks()), ["a", "b"])
+        m = RowwiseNode(
+            [inp],
+            {
+                "g": ref("a") & 255,
+                "v": ref("a") * 2 + 1,
+                "w": ref("b") * 0.5,
+            },
+        )
+        f = FilterNode(m, ref("v") > -1950)
+        gb = GroupByNode(
+            f,
+            ["g"],
+            {
+                "cnt": ReducerSpec(kind="count"),
+                "tot": ReducerSpec(kind="sum", arg_cols=("v",)),
+                "mean": ReducerSpec(kind="avg", arg_cols=("w",)),
+            },
+        )
+        return OutputNode(gb, sink)
+
+    def filter_chain_graph(sink):
+        inp = InputNode(_Src(["a", "b"], numeric_ticks()), ["a", "b"])
+        m1 = RowwiseNode(
+            [inp],
+            {
+                "x": ref("a") * 2 + 1,
+                "y": ref("b") * 0.5 - ref("a"),
+                "a": ref("a"),
+                "b": ref("b"),
+            },
+        )
+        f1 = FilterNode(m1, (ref("x") > -1900) & (ref("y") <= 2000.0))
+        m2 = RowwiseNode(
+            [f1],
+            {"z": ref("x") * 3 - ref("a"), "u": ref("y") * ref("y") + ref("b")},
+        )
+        f2 = FilterNode(m2, ref("z") != 0)
+        return OutputNode(f2, sink)
+
+    def counter_value(name):
+        c = REGISTRY.get(name)
+        return c._unlabeled().value if c is not None else 0.0
+
+    def run_once(graph, compiled):
+        os.environ["PATHWAY_COMPILED_TICK"] = "1" if compiled else "0"
+        try:
+            rows = [0]
+
+            def sink(t, b):
+                rows[0] += len(b)
+
+            rt = Runtime([graph(sink)])
+            gc.disable()
+            try:
+                h0 = counter_value(
+                    "pathway_engine_compile_cache_hits_total"
+                )
+                m0 = counter_value(
+                    "pathway_engine_compile_cache_misses_total"
+                )
+                t0 = time.perf_counter()
+                rt.run()
+                dt = time.perf_counter() - t0
+                hits = (
+                    counter_value("pathway_engine_compile_cache_hits_total")
+                    - h0
+                )
+                misses = (
+                    counter_value(
+                        "pathway_engine_compile_cache_misses_total"
+                    )
+                    - m0
+                )
+            finally:
+                gc.enable()
+            compiled_ticks = fallback_ticks = 0
+            if rt.compiled_plan is not None:
+                compiled_ticks = sum(
+                    s.compiled_ticks for s in rt.compiled_plan.segments
+                )
+                fallback_ticks = sum(
+                    s.fallback_ticks for s in rt.compiled_plan.segments
+                )
+            return {
+                "rows_per_sec": float(n_rows / dt),
+                "out_rows": rows[0],
+                "cache_hits": hits,
+                "cache_misses": misses,
+                "compiled_ticks": compiled_ticks,
+                "fallback_ticks": fallback_ticks,
+            }
+        finally:
+            os.environ.pop("PATHWAY_COMPILED_TICK", None)
+
+    tiers = {}
+    for name, graph in (
+        ("wordcount", wordcount_graph),
+        ("groupby_chain", groupby_chain_graph),
+        ("filter_chain", filter_chain_graph),
+    ):
+        interp = run_once(graph, compiled=False)
+        cold = run_once(graph, compiled=True)  # traces + compiles
+        warm = run_once(graph, compiled=True)  # jit caches are process-wide
+        total = warm["cache_hits"] + warm["cache_misses"]
+        hit_rate = warm["cache_hits"] / total if total else None
+        tiers[name] = {
+            "rows": n_rows,
+            "tick_rows": tick_rows,
+            "interpreter_rows_per_sec": round(interp["rows_per_sec"]),
+            "compiled_cold_rows_per_sec": round(cold["rows_per_sec"]),
+            "compiled_warm_rows_per_sec": round(warm["rows_per_sec"]),
+            "speedup_warm": round(
+                warm["rows_per_sec"] / interp["rows_per_sec"], 2
+            ),
+            "cache_hit_rate_warm": (
+                round(hit_rate, 4) if hit_rate is not None else None
+            ),
+            "compiled_ticks_warm": warm["compiled_ticks"],
+            "fallback_ticks_warm": warm["fallback_ticks"],
+            "out_rows_match": interp["out_rows"] == warm["out_rows"],
+        }
+    return tiers
+
+
 def _bench_groupby(np):
     """Wordcount-style streaming groupby-reduce rows/s through the engine
     (BASELINE.md config #1, reference integration_tests/wordcount)."""
@@ -2934,6 +3147,20 @@ if __name__ == "__main__":
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "SERVE_r11.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["compiled_tick"]:
+        # standalone tier run; also records the BENCH_rNN.json artifact
+        # (interpreter vs fused-XLA tick, ISSUE 12 acceptance)
+        import numpy as _np
+
+        _ct = _bench_compiled_tick(_np)
+        _doc = {"tier": "compiled_tick", "platform": "cpu", **_ct}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_r12.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

@@ -712,6 +712,53 @@ _STATE_ESTIMATES = {
 }
 
 
+def _compile_member(node: Node) -> bool:
+    from pathway_tpu.engine.compile import classify_node
+
+    try:
+        return classify_node(node)[0]
+    except Exception:
+        return False
+
+
+@rule("compile-boundary")
+def compile_boundary(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Tick Forge visibility: every exec that FORCES a stateless chain
+    back to the per-operator interpreter — a node adjacent (producer or
+    consumer) to compilable operators that is itself not lowerable — is
+    named with its reason (object-valued expression, UDF, stateful
+    state, ...), so a user wondering why /debug/graph shows a segment
+    boundary can see the exact expression/operator that drew it.  INFO
+    severity: boundaries are normal; the diagnostic is a map, not a
+    complaint."""
+    from pathway_tpu.engine.compile import classify_node
+
+    for node in facts.order:
+        try:
+            ok, reason = classify_node(node)
+        except Exception:
+            continue
+        if ok or reason == "__io__":
+            continue
+        # only boundaries that actually cut a chain are interesting:
+        # the node must touch at least one compilable neighbor
+        if not (
+            any(_compile_member(i) for i in node.inputs)
+            or any(
+                _compile_member(c) for c in facts.consumers.get(node.id, [])
+            )
+        ):
+            continue
+        yield Diagnostic(
+            "compile-boundary",
+            Severity.INFO,
+            f"compiled-tick chain boundary: this operator runs on the "
+            f"interpreter ({reason}); the adjacent stateless chain is "
+            f"fused up to here",
+            node,
+        )
+
+
 @rule("graph-stats")
 def graph_stats(facts: GraphFacts) -> Iterable[Diagnostic]:
     """One INFO report: node counts per type, exchange edges, estimated

@@ -82,8 +82,25 @@ def _full(n: int, value: Any) -> np.ndarray:
     return out
 
 
+def _unbox_rows(arrays) -> list:
+    """Per-row Python boundaries (UDFs, scalar method fns, elementwise
+    operators) must see plain Python scalars: with typed ingest a column
+    may be int64/float64/bool, and numpy SCALAR semantics differ from
+    Python's exactly where the poison contract bites (np.int64 // 0
+    warns and yields 0 instead of raising ZeroDivisionError;
+    isinstance(v, int) is False for np.int64).  tolist() unboxes at C
+    speed; object columns pass through untouched."""
+    return [
+        a.tolist()
+        if isinstance(a, np.ndarray) and a.dtype != object
+        else a
+        for a in arrays
+    ]
+
+
 def _elementwise(fn: Callable, *arrays: np.ndarray) -> np.ndarray:
     n = len(arrays[0])
+    arrays = _unbox_rows(arrays)
     out = np.empty(n, dtype=object)
     for i in range(n):
         args = [a[i] for a in arrays]
@@ -115,9 +132,12 @@ def _tighten(out: np.ndarray) -> np.ndarray:
             or not isinstance(v, (int, np.integer))
         ):
             all_int = False
-        if isinstance(v, (bool, np.bool_)) or not isinstance(
-            v, (int, float, np.integer, np.floating)
+        if (
+            isinstance(v, (bool, np.bool_, Pointer))
+            or not isinstance(v, (int, float, np.integer, np.floating))
         ):
+            # Pointer subclasses int: letting it through would round-trip
+            # row keys through float64 and corrupt them past 2**53
             all_float = False
         if not (all_bool or all_int or all_float):
             return out
@@ -131,6 +151,36 @@ def _tighten(out: np.ndarray) -> np.ndarray:
     except (ValueError, TypeError, OverflowError):
         return out
     return out
+
+
+def tighten_batch(batch) -> Any:
+    """Typed ingest (Tick Forge): apply the SAME strict object->typed
+    conversion the expression evaluator already uses on its results to a
+    batch's ingest columns, so stateless chains start from dense numeric
+    arrays instead of boxed rows.  Acceptance rules are exactly
+    ``_tighten``'s — a column converts only when EVERY element is a plain
+    bool / int64-range int / float (Pointer, None, Error, big ints, and
+    mixed bool+int columns all stay object) — so no value the interpreter
+    would keep boxed ever changes representation silently."""
+    from pathway_tpu.engine.batch import DiffBatch
+
+    obj = {
+        name: col
+        for name, col in batch.columns.items()
+        if col.dtype == object and len(col)
+    }
+    if not obj:
+        return batch
+    cols = dict(batch.columns)
+    changed = False
+    for name, col in obj.items():
+        tight = _tighten(col)
+        if tight is not col:
+            cols[name] = tight
+            changed = True
+    if not changed:
+        return batch
+    return DiffBatch(batch.keys, batch.diffs, cols)
 
 
 _CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
@@ -348,7 +398,10 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
         a = eval_expr(e._expr, ctx)
         return _elementwise(_to_string, a)
     if isinstance(e, expr.MakeTupleExpression):
-        arrays = [eval_expr(a, ctx) for a in e._args]
+        # unbox typed columns first: tuple VALUES keep the engine-wide
+        # python-scalar representation (the sharded exchange packers and
+        # value hashing key off exact element types)
+        arrays = _unbox_rows([eval_expr(a, ctx) for a in e._args])
         out = np.empty(n, dtype=object)
         for i in range(n):
             out[i] = tuple(a[i] for a in arrays)
@@ -409,8 +462,11 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
     if isinstance(e, expr.BatchApplyExpression):
         from pathway_tpu.internals.errors import record_error
 
-        arrays = [eval_expr(a, ctx) for a in e._args]
-        kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+        arrays = _unbox_rows([eval_expr(a, ctx) for a in e._args])
+        kw_arrays = {
+            k: _unbox_rows([eval_expr(v, ctx)])[0]
+            for k, v in e._kwargs.items()
+        }
         out = np.empty(n, dtype=object)
         # rows with None (propagate_none) or ERROR inputs bypass the fn,
         # matching the scalar/async apply semantics
@@ -447,8 +503,11 @@ def eval_expr(e: expr.ColumnExpression, ctx: EvalContext) -> np.ndarray:
             pos += max_bs
         return _coerce_to_dtype(out, e._return_type)
     if isinstance(e, expr.ApplyExpression):
-        arrays = [eval_expr(a, ctx) for a in e._args]
-        kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+        arrays = _unbox_rows([eval_expr(a, ctx) for a in e._args])
+        kw_arrays = {
+            k: _unbox_rows([eval_expr(v, ctx)])[0]
+            for k, v in e._kwargs.items()
+        }
         out = np.empty(n, dtype=object)
         for i in range(n):
             args = [a[i] for a in arrays]
@@ -491,8 +550,11 @@ def _eval_async_apply(e: expr.AsyncApplyExpression, ctx: EvalContext) -> np.ndar
 
     _scope = _err._active_scope()
     n = ctx.n
-    arrays = [eval_expr(a, ctx) for a in e._args]
-    kw_arrays = {k: eval_expr(v, ctx) for k, v in e._kwargs.items()}
+    arrays = _unbox_rows([eval_expr(a, ctx) for a in e._args])
+    kw_arrays = {
+        k: _unbox_rows([eval_expr(v, ctx)])[0]
+        for k, v in e._kwargs.items()
+    }
 
     async def run_all():
         async def one(i):

@@ -206,8 +206,19 @@ class InputExec(NodeExec):
     def __init__(self, node: InputNode):
         super().__init__(node)
         self.pending: list[DiffBatch] = []
+        # Tick Forge typed ingest: resolved once per exec (the flag is
+        # per-run like the compiled plan itself)
+        self._tighten: bool | None = None
 
     def inject(self, batch: DiffBatch) -> None:
+        if self._tighten is None:
+            from pathway_tpu.engine.compile import compiled_tick_enabled
+
+            self._tighten = compiled_tick_enabled()
+        if self._tighten:
+            from pathway_tpu.engine.expression_eval import tighten_batch
+
+            batch = tighten_batch(batch)
         self.pending.append(batch)
 
     def process(self, t, inputs):
@@ -472,6 +483,11 @@ class GroupByExec(NodeExec):
         self.ledger = Arrangement(1)
         self._ledgered: set[int] = set()
         self._ledger_enabled = False
+        # Tick Forge: the semigroup partial-aggregation pass
+        # (dcounts/sums) can run as one jitted segment_sum program —
+        # opt-in/auto per backend (compile.compiled_groupby_enabled);
+        # None = not yet resolved, False after any device failure
+        self._compiled_semigroup: bool | None = None
 
     def enable_state_ledger(self) -> None:
         self._ledger_enabled = True
@@ -640,6 +656,59 @@ class GroupByExec(NodeExec):
         first_idx[codes[::-1]] = np.arange(n - 1, -1, -1, dtype=np.int64)
         return codes, nu, first_idx
 
+    def _semigroup_partials(self, codes, diffs, arg_arrays, nu):
+        """Per-group (diff counts, weighted sums) for the semigroup
+        reducers.  Host scatter (np.add.at) by default; the jitted
+        segment_sum twin rides the compiled-tick cache when the backend
+        makes device scatter a win (PATHWAY_COMPILED_GROUPBY — see
+        engine/compile.py for the measured CPU numbers)."""
+        if self._compiled_semigroup is None:
+            from pathway_tpu.engine.compile import (
+                compiled_groupby_enabled,
+                compiled_tick_enabled,
+            )
+
+            self._compiled_semigroup = (
+                compiled_tick_enabled() and compiled_groupby_enabled()
+            )
+        if self._compiled_semigroup:
+            from pathway_tpu.engine.compile import (
+                NotCompilable,
+                semigroup_partials,
+            )
+
+            sem_args = [
+                a if (s.kind in ("sum", "avg")) else None
+                for s, a in zip(self.specs, arg_arrays)
+            ]
+            try:
+                return semigroup_partials(codes, diffs, sem_args, nu)
+            except NotCompilable:
+                pass  # unsupported dtype this batch: host path below
+            except Exception:
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "compiled groupby partials failed for %s; using the "
+                    "host scatter path from now on",
+                    self.node,
+                    exc_info=True,
+                )
+                self._compiled_semigroup = False
+        dcounts = np.zeros(nu, dtype=np.int64)
+        np.add.at(dcounts, codes, diffs)
+        partials: list[np.ndarray | None] = []
+        for spec, arr in zip(self.specs, arg_arrays):
+            if arr is None:
+                partials.append(None)
+            else:
+                part = np.zeros(
+                    nu, dtype=arr.dtype if arr.dtype.kind == "i" else np.float64
+                )
+                np.add.at(part, codes, arr * diffs)
+                partials.append(part)
+        return dcounts, partials
+
     def _try_bulk(self, b, touched, t) -> bool:
         """Columnar groupby path (the microbatch analog of differential's
         batched reduce, reference src/engine/reduce.rs:40): factorize the
@@ -690,18 +759,9 @@ class GroupByExec(NodeExec):
         gks_u = ref_scalars_columns(
             [cols[j][first_idx] for j in self.g_idx], nu
         )
-        dcounts = np.zeros(nu, dtype=np.int64)
-        np.add.at(dcounts, codes, diffs)
-        partials: list[np.ndarray | None] = []
-        for spec, arr in zip(self.specs, arg_arrays):
-            if arr is None:
-                partials.append(None)
-            else:
-                part = np.zeros(
-                    nu, dtype=arr.dtype if arr.dtype.kind == "i" else np.float64
-                )
-                np.add.at(part, codes, arr * diffs)
-                partials.append(part)
+        dcounts, partials = self._semigroup_partials(
+            codes, diffs, arg_arrays, nu
+        )
         # group the batch's row positions by code for multiset bulk updates
         any_multiset = any(s.kind in self._BULK_MULTISET for s in self.specs)
         if any_multiset:

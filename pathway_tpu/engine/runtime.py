@@ -360,6 +360,23 @@ class Runtime:
         self.execs: dict[int, NodeExec] = {
             node.id: node.make_exec() for node in self.order
         }
+        # Tick Forge: fuse stateless operator chains into jitted XLA
+        # programs (engine/compile.py). Planning failures are never
+        # fatal — the interpreter path below is always complete.
+        # PATHWAY_COMPILED_TICK=0 skips planning entirely (byte-
+        # identical interpreter).
+        self.compiled_plan = None
+        try:
+            from pathway_tpu.engine.compile import plan_segments
+
+            self.compiled_plan = plan_segments(self.order, self.execs)
+        except Exception:
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "compiled-tick planning failed; running interpreted",
+                exc_info=True,
+            )
         self.autocommit_ms = autocommit_ms
         self.on_tick = on_tick
         self.current_time = 0
@@ -482,6 +499,14 @@ class Runtime:
     # --- core tick ------------------------------------------------------------
 
     def _process_node(self, node, t, produced, injected, final, stats):
+        runner = None
+        if self.compiled_plan is not None:
+            if node.id in self.compiled_plan.member_ids:
+                # produced inside its segment; the tail emits for it
+                # (members are stateless with no on_end work)
+                produced[node.id] = []
+                return
+            runner = self.compiled_plan.by_tail.get(node.id)
         ex = self.execs[node.id]
         has_injected = (
             isinstance(ex, InputExec) and injected and node.id in injected
@@ -489,7 +514,11 @@ class Runtime:
         if has_injected:
             for b in injected[node.id]:
                 ex.inject(b)
-        inputs = [produced.get(inp.id, []) for inp in node.inputs]
+        inputs = (
+            runner.gather(produced)
+            if runner is not None
+            else [produced.get(inp.id, []) for inp in node.inputs]
+        )
         t0 = _time.perf_counter_ns()
         from pathway_tpu.internals.errors import set_exec_scope
 
@@ -507,14 +536,22 @@ class Runtime:
         try:
             if span is not None:
                 with span:
-                    out = ex.process(t, inputs)
+                    out = (
+                        runner.process(t, inputs)
+                        if runner is not None
+                        else ex.process(t, inputs)
+                    )
                     if final:
                         out = list(out) + list(ex.on_end())
                     span.set_attribute(
                         "rows", sum(len(b) for b in out)
                     )
             else:
-                out = ex.process(t, inputs)
+                out = (
+                    runner.process(t, inputs)
+                    if runner is not None
+                    else ex.process(t, inputs)
+                )
                 if final:
                     out = list(out) + list(ex.on_end())
         finally:

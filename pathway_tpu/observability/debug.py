@@ -46,6 +46,12 @@ def graph_table(runtime: Any) -> list[dict]:
     from pathway_tpu.engine.runtime import StreamingSource
 
     stats = runtime.stats
+    plan = getattr(runtime, "compiled_plan", None)
+    seg_of: dict[int, object] = {}
+    if plan is not None:
+        for seg in plan.segments:
+            for n in seg.nodes:
+                seg_of[n.id] = seg
     rows = []
     for node in runtime.order:
         backlog = 0
@@ -55,18 +61,29 @@ def graph_table(runtime: Any) -> list[dict]:
             session = node.source.session
             with session._lock:
                 backlog = len(session._rows) + len(session._upserts)
-        rows.append(
-            {
-                "id": node.id,
-                "name": f"{node.name}_{node.id}",
-                "type": type(node).__name__,
-                "rows": stats.node_rows.get(node.id, 0),
-                "ns": stats.node_ns.get(node.id, 0),
-                "rows_in": stats.rows_in.get(node.id, 0),
-                "rows_out": stats.rows_out.get(node.id, 0),
-                "backlog": backlog,
-            }
-        )
+        row = {
+            "id": node.id,
+            "name": f"{node.name}_{node.id}",
+            "type": type(node).__name__,
+            "rows": stats.node_rows.get(node.id, 0),
+            "ns": stats.node_ns.get(node.id, 0),
+            "rows_in": stats.rows_in.get(node.id, 0),
+            "rows_out": stats.rows_out.get(node.id, 0),
+            "backlog": backlog,
+        }
+        # Tick Forge: which fused segment (if any) this node rides, and
+        # how often the segment actually dispatched compiled vs fell
+        # back to the interpreter (tail carries the counters; member
+        # rows/ns are attributed to the tail)
+        seg = seg_of.get(node.id)
+        row["compiled"] = seg is not None and not seg.broken
+        if seg is not None:
+            row["segment"] = seg.seg_id
+            if node.id == seg.tail.id:
+                row["segment_tail"] = True
+                row["compiled_ticks"] = seg.compiled_ticks
+                row["fallback_ticks"] = seg.fallback_ticks
+        rows.append(row)
     return rows
 
 

@@ -149,6 +149,12 @@ def test_supervisor_restarts_group_once_then_succeeds():
 
 
 def test_supervisor_budget_exhausted_propagates_failure():
+    """Drive run() on a worker thread and poll the event log with a
+    deadline: on a loaded box the two incarnations (4 interpreter
+    spawns + jittered backoff) can take arbitrarily long, so a direct
+    synchronous assert is a timing lottery — the event log reaching
+    "gave-up" IS the completion signal, and the deadline turns a hang
+    into a diagnosable failure instead of a suite timeout."""
     from pathway_tpu.parallel.supervisor import GroupSupervisor
 
     sup = GroupSupervisor(
@@ -158,9 +164,27 @@ def test_supervisor_budget_exhausted_propagates_failure():
         backoff_s=0.05,
         poll_s=0.02,
     )
-    assert sup.run() == 23
+    rc: list[int] = []
+    runner = threading.Thread(target=lambda: rc.append(sup.run()))
+    runner.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if any(k == "gave-up" for _ts, k, _d in sup.events):
+            break
+        time.sleep(0.05)
+    else:
+        sup.stop()  # unwedge before failing so the thread dies
+        runner.join(10)
+        raise AssertionError(
+            f"no gave-up event within deadline; events={sup.events}"
+        )
+    runner.join(30)
+    assert not runner.is_alive(), "run() did not return after gave-up"
+    assert rc == [23]
     assert sup.restarts_used == 1
-    assert [k for _ts, k, _d in sup.events][-1] == "gave-up"
+    kinds = [k for _ts, k, _d in sup.events]
+    assert kinds[-1] == "gave-up"
+    assert kinds.count("rank-died") == 2  # one per incarnation
 
 
 def test_supervisor_env_budget(monkeypatch):

@@ -81,6 +81,12 @@ def test_pallas_padded_k10_interpret_matches_xla():
 
     n, d, k = 2048, 32, 10
     assert pt._kpad(k) == 128 and pt._kpad(k) != k  # genuinely padded
+    # lane-boundary pins: exactly-aligned k pads to itself, one past the
+    # boundary jumps a full lane width (the BENCH_r02 crash was k=10
+    # emitted UNpadded — these keep the ladder honest at its edges)
+    assert pt._kpad(1) == 128
+    assert pt._kpad(128) == 128
+    assert pt._kpad(129) == 256
     corpus, valid = _random_corpus(n, d, seed=5)
     queries = np.random.default_rng(6).normal(size=(3, d)).astype(np.float32)
     prep, c2 = prepare_corpus(jnp.asarray(corpus), "cosine")
@@ -145,17 +151,23 @@ def test_pallas_compiled_on_tpu():
     from pathway_tpu.ops import pallas_topk as pt
     from pathway_tpu.ops.knn import dense_topk_prepared, prepare_corpus
 
-    n, d, k = 2048, 128, 5
-    corpus, valid = _random_corpus(n, d)
-    queries = np.random.default_rng(3).normal(size=(4, d)).astype(np.float32)
-    prep, c2 = prepare_corpus(jnp.asarray(corpus), "cosine")
-    s_ref, i_ref = dense_topk_prepared(
-        jnp.asarray(queries), prep, c2, jnp.asarray(valid), k, metric="cosine"
-    )
-    s_pl, i_pl = pt.pallas_dense_topk(
-        jnp.asarray(queries), prep, jnp.asarray(valid), k, metric="cosine"
-    )
-    assert (np.asarray(i_ref) == np.asarray(i_pl)).all()
+    # k=5 (generic) and k=10 (the exact BENCH_r02 crash shape): both must
+    # COMPILE on hardware now that the output tiles are lane-padded
+    for n, d, k in ((2048, 128, 5), (2048, 32, 10)):
+        corpus, valid = _random_corpus(n, d)
+        queries = np.random.default_rng(3).normal(
+            size=(4, d)
+        ).astype(np.float32)
+        prep, c2 = prepare_corpus(jnp.asarray(corpus), "cosine")
+        s_ref, i_ref = dense_topk_prepared(
+            jnp.asarray(queries), prep, c2, jnp.asarray(valid), k,
+            metric="cosine",
+        )
+        s_pl, i_pl = pt.pallas_dense_topk(
+            jnp.asarray(queries), prep, jnp.asarray(valid), k,
+            metric="cosine",
+        )
+        assert (np.asarray(i_ref) == np.asarray(i_pl)).all()
 
 
 def test_kernel_env_var_and_validation(monkeypatch):

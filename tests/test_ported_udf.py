@@ -20,6 +20,30 @@ def _fresh_graph():
     pw.internals.parse_graph.G.clear()
 
 
+class _AsyncBarrier:
+    """Single-use stand-in for asyncio.Barrier (3.11+) so the suite runs
+    on the box's 3.10: all parties block in wait() until the last one
+    arrives.  Event-based, so it needs no running-loop handshake."""
+
+    def __init__(self, parties: int):
+        self._parties = parties
+        self._arrived = 0
+        self._release = asyncio.Event()
+
+    async def wait(self) -> int:
+        self._arrived += 1
+        n = self._arrived
+        if n >= self._parties:
+            self._release.set()
+        await self._release.wait()
+        return n
+
+
+def _async_barrier(parties: int):
+    barrier_cls = getattr(asyncio, "Barrier", None)
+    return barrier_cls(parties) if barrier_cls else _AsyncBarrier(parties)
+
+
 def test_udf():
     @pw.udf
     def inc(a: int) -> int:
@@ -80,7 +104,7 @@ def test_udf_class():
 
 
 def test_udf_async():
-    barrier = asyncio.Barrier(3)
+    barrier = _async_barrier(3)
 
     @pw.udf
     async def inc(a: int) -> int:
