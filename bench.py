@@ -2248,6 +2248,108 @@ def _serve_chaos_load_phase(
     }
 
 
+def _serve_noisy_phase(
+    np, router_port, workers, hot_workers, duration_s, n_docs
+):
+    """Noisy-neighbor closed loop (Tenant Weave): ``hot_workers``
+    threads hammer ONE tenant with a 32-query repeat working set —
+    offered load far past its fair share — while the rest model the
+    zipf tail (1M tenant population, mostly one query per tenant).
+    Identity rides the ``x-pathway-tenant`` header; per-group QPS,
+    latency percentiles, shed mix, and result-cache hits are recorded
+    separately so starvation (and its absence) is visible per group."""
+    import threading
+
+    import requests
+
+    url = "http://127.0.0.1:%d/query" % router_port
+    lock = threading.Lock()
+    stats = {
+        g: {"served": [], "statuses": {}, "cache_hits": 0}
+        for g in ("hot", "tail")
+    }
+    t_start = time.perf_counter()
+    stop_at = t_start + duration_s
+
+    def worker(wid: int) -> None:
+        rng = np.random.default_rng(5000 + wid)
+        sess = requests.Session()
+        hot = wid < hot_workers
+        g = stats["hot" if hot else "tail"]
+        while time.perf_counter() < stop_at:
+            if hot:
+                tenant = "hot-0"
+                # a repeat working set: exactly what the router result
+                # cache exists for (identical body => identical key)
+                q = "doc %d" % int(rng.integers(0, 32))
+            else:
+                tenant = "tail-%d" % (int(rng.zipf(1.2)) % 1_000_000)
+                q = "doc %d" % int(rng.integers(0, n_docs))
+            t0 = time.perf_counter()
+            cache_hit = False
+            try:
+                r = sess.post(
+                    url,
+                    json={"query": q, "k": 8},
+                    headers={
+                        "x-pathway-deadline-ms": "8000",
+                        "x-pathway-tenant": tenant,
+                    },
+                    timeout=10,
+                )
+                code = r.status_code
+                cache_hit = r.headers.get("x-pathway-cache") == "hit"
+            except Exception:
+                code = 0
+            dt_ms = (time.perf_counter() - t0) * 1000
+            with lock:
+                g["statuses"][code] = g["statuses"].get(code, 0) + 1
+                if code == 200:
+                    g["served"].append(dt_ms)
+                    if cache_hit:
+                        g["cache_hits"] += 1
+            if code in (429, 503):
+                time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    out = {"duration_s": round(elapsed, 2)}
+    error_served = 0
+    for name, g in stats.items():
+        served, statuses = g["served"], g["statuses"]
+        total = sum(statuses.values())
+        shed = sum(statuses.get(c, 0) for c in (429, 503))
+        errors = total - shed - len(served)
+        error_served += errors
+        out[name] = {
+            "workers": hot_workers if name == "hot" else workers - hot_workers,
+            "qps": round(len(served) / elapsed, 1) if elapsed else 0.0,
+            "p50_ms": round(float(np.percentile(served, 50)), 3)
+            if served
+            else None,
+            "p99_ms": round(float(np.percentile(served, 99)), 3)
+            if served
+            else None,
+            "shed_rate": round(shed / total, 4) if total else 0.0,
+            "cache_hits": g["cache_hits"],
+            "cache_hit_rate": round(g["cache_hits"] / len(served), 4)
+            if served
+            else 0.0,
+            "error_served": errors,
+            "status_counts": {
+                str(k): v for k, v in sorted(statuses.items())
+            },
+        }
+    out["error_served"] = error_served
+    return out
+
+
 def _bench_serve_chaos(np):
     """Replica Shield tier: the million-user serving simulation (CPU
     smoke scale).  One writer pipeline streams consolidated index
@@ -2257,7 +2359,11 @@ def _bench_serve_chaos(np):
     router balances a zipf-tenant, diurnal-surge closed loop over
     them, with the offered load sized well beyond one gate's capacity.
     Phases: `single` = router over ONE gated replica (the gate sheds
-    the excess explicitly); `replicated` = three gated replicas
+    the excess explicitly); `noisy_neighbor` (Tenant Weave) = one hot
+    tenant at many times its fair share vs the zipf tail, tenant-blind
+    vs PATHWAY_TENANT_QOS=1 vs fairness + the delta-invalidated router
+    result cache (per-group QPS/p99/shed + cache hits — a hit is a
+    read with ZERO replica hops); `replicated` = three gated replicas
     absorbing the same offered load, with a Fault-Forge kill of
     replica 1 mid-run and a Phoenix-Mesh supervised restart —
     reporting sustained QPS, p50/p99, shed rate, error-served (must be
@@ -2374,12 +2480,21 @@ def _bench_serve_chaos(np):
             )
         out["writer_boot_s"] = round(time.monotonic() - t_boot, 2)
 
-        def start_replica(rid: int, fault: str | None = None):
+        def start_replica(
+            rid: int,
+            fault: str | None = None,
+            http_port: int | None = None,
+            extra_env: dict | None = None,
+        ):
             renv = dict(env_common)
             renv["PATHWAY_REPLICA_ID"] = str(rid)
             renv["PATHWAY_REPLICA_STORE"] = str(base / "pstorage")
             renv["PATHWAY_REPL_PORT"] = str(repl_port)
-            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(http_ports[rid])
+            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(
+                http_ports[rid] if http_port is None else http_port
+            )
+            if extra_env:
+                renv.update(extra_env)
             # the replica's Surge-Gate capacity envelope (per-instance
             # rate protection): the offered load exceeds ONE gate, so
             # horizontal capacity is the thing being measured
@@ -2462,6 +2577,94 @@ def _bench_serve_chaos(np):
             np, router1.port, workers, phase_s, N_DOCS
         )
         router1.stop()
+
+        # --- phase 1b: noisy neighbor (Tenant Weave) --------------------
+        # One hot tenant hammering a 32-query repeat set from half the
+        # fleet, far past its fair share, vs the 1M-population zipf
+        # tail on the other half.  Three legs against the SAME 25-rps
+        # gate envelope: (a) tenant-blind = the starvation baseline
+        # (the shed falls on whoever arrives next, i.e. mostly the
+        # tail); (b) PATHWAY_TENANT_QOS=1 = per-tenant fair admission
+        # (the hot tenant absorbs the 429s, the tail's p99 stays
+        # within its gate); (c) fairness + the router result cache fed
+        # by the writer's delta stream (repeat hot-tenant queries
+        # answered with ZERO replica hops on hits).
+        hot_workers = max(workers // 2, 1)
+        nn: dict = {}
+        router_nf = FailoverRouter(
+            ["http://127.0.0.1:%d" % http_ports[0]],
+            health_interval_ms=200,
+        ).start()
+        routers.append(router_nf)
+        nn["fairness_off"] = _serve_noisy_phase(
+            np, router_nf.port, workers, hot_workers, phase_s, N_DOCS
+        )
+        router_nf.stop()
+        # a tenant-aware twin of replica 0: same gate envelope, fair
+        # admission armed
+        qos_http_port = free_dcn_port(1)
+        sup_qos = start_replica(
+            9,
+            http_port=qos_http_port,
+            extra_env={"PATHWAY_TENANT_QOS": "1"},
+        )
+        th_qos = sup_threads[-1]
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            try:
+                if requests.get(
+                    "http://127.0.0.1:%d/replica/health" % qos_http_port,
+                    timeout=2,
+                ).json().get("ready"):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("tenant-QoS replica never became ready")
+        qos_url = ["http://127.0.0.1:%d" % qos_http_port]
+        router_f = FailoverRouter(
+            qos_url, health_interval_ms=200
+        ).start()
+        routers.append(router_f)
+        nn["fairness_on"] = _serve_noisy_phase(
+            np, router_f.port, workers, hot_workers, phase_s, N_DOCS
+        )
+        router_f.stop()
+        from pathway_tpu.serving.result_cache import ResultCache
+
+        nn_cache = ResultCache(dim=DIM)
+        nn_cache.attach_stream("127.0.0.1", repl_port)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            lag = nn_cache.stream_staleness_s()
+            if lag is not None and lag <= 1.0:
+                break
+            time.sleep(0.2)
+        router_fc = FailoverRouter(
+            qos_url, health_interval_ms=200, cache=nn_cache
+        ).start()
+        routers.append(router_fc)
+        nn["fairness_on_cache"] = _serve_noisy_phase(
+            np, router_fc.port, workers, hot_workers, phase_s, N_DOCS
+        )
+        nn["cache_entries"] = len(nn_cache)
+        router_fc.stop()  # closes the cache + its stream subscription
+        nn["tail_shed_off_vs_on"] = [
+            nn["fairness_off"]["tail"]["shed_rate"],
+            nn["fairness_on"]["tail"]["shed_rate"],
+        ]
+        nn["hot_shed_off_vs_on"] = [
+            nn["fairness_off"]["hot"]["shed_rate"],
+            nn["fairness_on"]["hot"]["shed_rate"],
+        ]
+        out["noisy_neighbor"] = nn
+        # the tenant-aware twin must not sit behind phase 2's routers
+        # (sups[1] below must be replica 1's supervisor)
+        sup_qos.stop()
+        th_qos.join(timeout=30)
+        sups.remove(sup_qos)
+        sup_threads.remove(th_qos)
 
         # --- phase 2: three replicas + mid-run kill of replica 1 -------
         # replica 1 exits (FAULT_EXIT) after applying its 10th delta
@@ -2798,6 +3001,14 @@ def _bench_serve_chaos(np):
 
         out["error_served_total"] = (
             out["single"]["error_served"]
+            + sum(
+                nn[leg]["error_served"]
+                for leg in (
+                    "fairness_off",
+                    "fairness_on",
+                    "fairness_on_cache",
+                )
+            )
             + load_result.get("error_served", 1)
             + to_load.get("error_served", 1)
             + sum(leg["error_served"] for leg in sweep)
@@ -3146,7 +3357,7 @@ if __name__ == "__main__":
         _doc = {"tier": "serve_chaos", **_serve}
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "SERVE_r11.json"),
+                         "SERVE_r13.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

@@ -600,6 +600,88 @@ def unreplicated_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# 5c. tenant fairness (Tenant Weave)
+
+
+@rule("tenant-fairness")
+def tenant_fairness(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """A replicated serving plane with tenant-blind admission: the
+    Surge Gate bounds TOTAL load, but one hot tenant can fill the
+    admission queue (and drain the endpoint token bucket) so the shed
+    lands on whoever arrives next — usually a tail tenant that sent one
+    request all day.  WARNING when a gated REST ingress fronts a
+    replicated plane without per-tenant fair admission
+    (``PATHWAY_TENANT_QOS``); INFO when the router's result cache is
+    armed without a delta-stream invalidation source, because a
+    TTL-only cache can serve answers up to a full TTL staler than the
+    corpus (time-based staleness only)."""
+    import os
+
+    from pathway_tpu.serving.result_cache import cache_enabled_via_env
+    from pathway_tpu.serving.router import shard_map_from_env
+    from pathway_tpu.serving.tenancy import tenancy_enabled_via_env
+
+    replicas = [
+        u
+        for u in os.environ.get("PATHWAY_SERVING_REPLICAS", "").split(",")
+        if u.strip()
+    ]
+    try:
+        shard_map = shard_map_from_env()
+    except ValueError:
+        shard_map = None
+    replicated = bool(
+        replicas or shard_map or os.environ.get("PATHWAY_REPL_PORT", "")
+    )
+    first_gated = None
+    for node in facts.order:
+        if not isinstance(node, InputNode):
+            continue
+        subject = getattr(getattr(node, "source", None), "subject", None)
+        if subject is None or type(subject).__name__ != "RestServerSubject":
+            continue
+        if getattr(subject, "_qos", None) is None:
+            continue  # ungated ingress is serving-admission's finding
+        if first_gated is None:
+            first_gated = node
+        route = getattr(subject, "_route", "/")
+        if replicated and not tenancy_enabled_via_env():
+            yield Diagnostic(
+                "tenant-fairness",
+                Severity.WARNING,
+                f"gated REST ingress {route!r} on a replicated serving "
+                "plane has tenant-blind admission: one hot tenant can "
+                "fill the admission queue and the shed lands on the "
+                "queue tail, starving every other tenant",
+                node,
+                fix_hint="set PATHWAY_TENANT_QOS=1 (per-tenant "
+                "fair-share buckets + weighted-fair EDF ordering, "
+                "identity from the x-pathway-tenant header; weight "
+                "classes via PATHWAY_TENANT_WEIGHTS)",
+                data={"route": route, "replicas": len(replicas)},
+            )
+    if first_gated is None:
+        return
+    if cache_enabled_via_env() and not os.environ.get(
+        "PATHWAY_ROUTER_CACHE_WRITER", ""
+    ):
+        yield Diagnostic(
+            "tenant-fairness",
+            Severity.INFO,
+            "the router result cache is enabled "
+            "(PATHWAY_ROUTER_CACHE=1) without a delta-stream "
+            "invalidation source: entries expire by TTL only "
+            "(PATHWAY_ROUTER_CACHE_TTL_MS), so a hit can be up to a "
+            "full TTL staler than the corpus instead of provably "
+            "current as of the stream's applied tick",
+            first_gated,
+            fix_hint="point PATHWAY_ROUTER_CACHE_WRITER=host:port at "
+            "the writer's delta endpoint (PATHWAY_REPL_PORT) so each "
+            "tick's changed keys evict exactly the affected entries",
+        )
+
+
+# ---------------------------------------------------------------------------
 # 5b. recoverability (Phoenix Mesh)
 
 

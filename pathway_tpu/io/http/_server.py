@@ -449,7 +449,15 @@ class RestServerSubject(ConnectorSubject):
         dispatched: asyncio.Future = loop.create_future()
         deadline = self._deadline_for(request)
         req = PendingRequest(
-            key, vals, deadline, loop=loop, dispatched=dispatched
+            key,
+            vals,
+            deadline,
+            loop=loop,
+            dispatched=dispatched,
+            # Tenant Weave identity: consumed only when the gate's
+            # ledger is armed (PATHWAY_TENANT_QOS=1); inert otherwise
+            tenant=request.headers.get("x-pathway-tenant"),
+            tenant_class=request.headers.get("x-pathway-tenant-class"),
         )
         with self._futures_lock:
             self._futures[key] = future

@@ -111,6 +111,13 @@ STANDBY_CHANNEL = "repl:standby"  # the writer→standby leg: a standby
 # target JUST this leg (drop/dup/delay=ch:repl:standby) without
 # touching the replica fan-out
 STANDBY_ID = -2  # reserved replica_id for standby-writer subscriptions
+OBSERVER_CHANNEL = "repl:observe"  # non-replica full-corpus observers
+# (the router result cache's invalidation feed) — a distinct channel so
+# Fault Forge can delay/drop the invalidation stream without touching
+# the replica fan-out or the standby leg
+OBSERVER_ID = -3  # reserved replica_id for observer subscriptions:
+# negative ids may subscribe to the FULL corpus of a sharded writer
+# (they never sit behind the router, so the torn-map guard passes)
 
 
 def shards_env() -> int:
@@ -594,7 +601,9 @@ class DeltaStreamServer:
                     frame = (
                         frame[0],
                         frame[1],
-                        STANDBY_CHANNEL,
+                        STANDBY_CHANNEL
+                        if sub.replica_id == STANDBY_ID
+                        else OBSERVER_CHANNEL,
                         *frame[3:],
                     )
                 if plan is not None and frame[0] == "data":

@@ -30,6 +30,13 @@ from pathway_tpu.serving.gate import (
     gates,
 )
 from pathway_tpu.serving import degrade
+from pathway_tpu.serving.tenancy import (
+    TenancyConfig,
+    TenantLabeler,
+    TenantLedger,
+    parse_weight_classes,
+    tenancy_enabled_via_env,
+)
 
 # Replica Shield (serving/replica.py, serving/router.py) is NOT eagerly
 # imported: the replica/router roles pull aiohttp and the replication
@@ -38,6 +45,7 @@ from pathway_tpu.serving import degrade
 _LAZY = {
     "ReplicaServer": ("pathway_tpu.serving.replica", "ReplicaServer"),
     "FailoverRouter": ("pathway_tpu.serving.router", "FailoverRouter"),
+    "ResultCache": ("pathway_tpu.serving.result_cache", "ResultCache"),
 }
 
 
@@ -53,6 +61,12 @@ def __getattr__(name: str):
 __all__ = [
     "FailoverRouter",
     "ReplicaServer",
+    "ResultCache",
+    "TenancyConfig",
+    "TenantLabeler",
+    "TenantLedger",
+    "parse_weight_classes",
+    "tenancy_enabled_via_env",
     "AdmissionController",
     "DeadlineExceeded",
     "MicroBatcher",

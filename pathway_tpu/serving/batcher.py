@@ -33,10 +33,15 @@ class MicroBatcher:
         reject: Callable[[Any, BaseException], None],
         capacity: Callable[[], int] | None = None,
         name: str = "surge-gate",
+        order: Callable[[Any], Any] | None = None,
     ):
         self.config = config
         self._dispatch = dispatch
         self._reject = reject
+        # heap ordering key; default = plain EDF (request deadline).
+        # Tenant Weave passes a weighted-fair key (vfinish, deadline) so
+        # a hot tenant's backlog drains behind the tail's fresh requests
+        self._order = order if order is not None else (lambda r: r.deadline)
         # dispatch-window backpressure: how many more requests may be
         # released right now (gate: dispatch_window - dispatched_pending).
         # None = unbounded. Bounded capacity is what makes the ADMISSION
@@ -67,7 +72,7 @@ class MicroBatcher:
             if self._closing:
                 raise RuntimeError("micro-batcher is closed")
             self._seq += 1
-            heapq.heappush(self._heap, (req.deadline, self._seq, req))
+            heapq.heappush(self._heap, (self._order(req), self._seq, req))
             if self._oldest_at is None:
                 self._oldest_at = now
             self._cond.notify()
@@ -144,13 +149,33 @@ class MicroBatcher:
             else:
                 self._cond.wait()
 
+    def steal(self, selector: Callable[[list], Any]) -> Any:
+        """Remove and return ONE queued request chosen by ``selector``
+        (called under the lock with the queued requests; returns a
+        request or None).  Tenant Weave's queue-full eviction: the gate
+        rejects the stolen request itself, charging the shed to the
+        over-share tenant instead of the arriving tail request."""
+        with self._cond:
+            if not self._heap:
+                return None
+            victim = selector([r for _k, _s, r in self._heap])
+            if victim is None:
+                return None
+            self._heap = [e for e in self._heap if e[2] is not victim]
+            heapq.heapify(self._heap)
+            if not self._heap:
+                self._oldest_at = None
+            return victim
+
     def _drop_expired_locked(self) -> None:
         now = time.monotonic()
-        if not any(d < now for d, _s, _r in self._heap):
+        # expiry always reads the request's DEADLINE — the heap key may
+        # be a weighted-fair tag, not the deadline itself
+        if not any(r.deadline < now for _d, _s, r in self._heap):
             return
         keep, dead = [], []
         for d, s, r in self._heap:
-            (dead if d < now else keep).append((d, s, r))
+            (dead if r.deadline < now else keep).append((d, s, r))
         self._heap = keep
         heapq.heapify(self._heap)
         if not self._heap:

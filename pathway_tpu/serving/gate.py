@@ -56,6 +56,9 @@ class PendingRequest:
         "enqueued_at",
         "loop",
         "dispatched",
+        "tenant",
+        "tenant_class",
+        "order",
         "_state",
         "_state_lock",
     )
@@ -67,11 +70,19 @@ class PendingRequest:
         deadline: float,
         loop: Any = None,
         dispatched: Any = None,
+        tenant: str | None = None,
+        tenant_class: str | None = None,
     ):
         self.key = key
         self.vals = vals
         self.deadline = float(deadline)
         self.enqueued_at = time.monotonic()
+        # Tenant Weave: identity from the x-pathway-tenant header and
+        # the batcher's order key — plain EDF (the deadline) unless the
+        # route's ledger stamps a weighted-fair (vfinish, deadline) tag
+        self.tenant = tenant
+        self.tenant_class = tenant_class
+        self.order: Any = self.deadline
         # asyncio plumbing: `dispatched` resolves (with the batch size)
         # when the micro-batcher releases the request into the engine,
         # or errors with DeadlineExceeded/ShedError when it is dropped
@@ -150,6 +161,13 @@ class SurgeGate:
         self._m_occupancy = _metrics.occupancy_histogram()
         self._m_expired = _metrics.expired_counter().labels("gate")
         self._closed = False
+        # Tenant Weave (PATHWAY_TENANT_QOS=1): per-tenant fair-share
+        # buckets + weighted-fair EDF ordering + queue-full eviction
+        # that charges the hot tenant.  None = tenant-blind plane,
+        # byte-identical to the pre-tenancy gate.
+        from pathway_tpu.serving import tenancy as _tenancy
+
+        self.ledger = _tenancy.ledger_for(config, route)
         # dispatch window: requests released into the engine but whose
         # response has not gone out yet; the batcher holds further
         # releases while the window is full so overload accumulates in
@@ -181,7 +199,53 @@ class SurgeGate:
         if req.deadline <= now:
             self._m_expired.inc()
             raise DeadlineExceeded()
-        self.admission.admit(now)
+        if self.ledger is not None:
+            # per-tenant fair share (shed charged to the hot tenant)
+            # + the weighted-fair EDF tag the batcher orders on
+            tag = self.ledger.admit(
+                req.tenant,
+                req.tenant_class,
+                now,
+                pressure=self.admission.under_pressure(now),
+            )
+            req.order = (tag, req.deadline)
+            if (
+                self.admission.queued >= self.config.max_queue
+                # only when the queue is the SOLE binding constraint:
+                # evicting a queued request for an arrival the bucket
+                # or concurrency cap would shed anyway loses both
+                and self.admission.headroom_besides_queue(now)
+            ):
+                # full queue: evict the MOST over-share tenant's queued
+                # request instead of shedding this arrival — the shed
+                # lands on the noisy neighbor, never the queue tail.
+                # (If the arrival itself is the hottest, pick_victim
+                # returns None and the normal queue_full shed applies.)
+                victim = self.batcher.steal(
+                    lambda queued: self.ledger.pick_victim(queued, tag)
+                )
+                if victim is not None:
+                    self.ledger.count_evicted(victim.tenant)
+                    self._reject(
+                        victim,
+                        ShedError(
+                            429,
+                            "tenant_evict",
+                            max(self.config.max_wait_ms / 1000.0, 0.05),
+                        ),
+                    )
+        try:
+            self.admission.admit(now)
+        except ShedError:
+            if self.ledger is not None:
+                # shed on the SHARED path: the request never entered
+                # the queue, so the tenant's fair-share charge comes
+                # back — retrying into a full queue must not drain the
+                # tenant's own budget (see TenantLedger.refund)
+                self.ledger.refund(req.tenant, req.tenant_class, tag)
+            raise
+        if self.ledger is not None:
+            self.ledger.commit(req.tenant)
         req.enqueued_at = now
         _deadline.register(req.key, req.deadline)
         try:
@@ -243,8 +307,13 @@ class SurgeGate:
             self._m_occupancy.labels("gate", str(bucket)).observe(
                 min(1.0, n / bucket)
             )
+            ledger = self.ledger
             for r in live:
-                self._m_wait.observe(max(0.0, now - r.enqueued_at))
+                wait = max(0.0, now - r.enqueued_at)
+                self._m_wait.observe(wait)
+                if ledger is not None:
+                    ledger.observe_wait(r.tenant, wait)
+                    ledger.note_dispatched(r.order)
                 r.resolve_dispatched(n)
         # counted LAST: if anything above raised, the batcher's
         # catch-all _rejects every request and _reject does its own
@@ -318,6 +387,9 @@ def _make_batcher(gate: SurgeGate) -> MicroBatcher:
         reject=reject,
         capacity=capacity,
         name=f"surge-gate{gate.route.replace('/', '-')}",
+        # weighted-fair EDF only when a tenant ledger stamped the order
+        # tag; None keeps the batcher's plain-EDF default path
+        order=(None if gate.ledger is None else (lambda r: r.order)),
     )
     weakref.finalize(gate, batcher.close)
     return batcher

@@ -257,9 +257,24 @@ class ReplicaServer:
             lambda: float(self.applied_tick)
         )
         from pathway_tpu.serving.admission import AdmissionController
+        from pathway_tpu.serving.tenancy import ledger_for
 
+        # Tenant Weave: PATHWAY_TENANT_QOS=1 makes this replica's
+        # admission tenant-aware (per-tenant fair-share buckets inside
+        # the gate's capacity envelope) — the router forwards the
+        # x-pathway-tenant header, so the shed lands on the hot tenant
+        # at every member it is steered to
+        self.tenant_ledger = (
+            ledger_for(qos, route=f"replica{self.replica_id}")
+            if qos is not None
+            else None
+        )
         self.admission = (
-            AdmissionController(qos, route=f"replica{self.replica_id}")
+            AdmissionController(
+                qos,
+                route=f"replica{self.replica_id}",
+                ledger=self.tenant_ledger,
+            )
             if qos is not None
             else None
         )
@@ -616,9 +631,13 @@ class _ReplicaHttp:
                         },
                         {"Retry-After": "1.0", **headers},
                     )
+        tenant = request.headers.get("x-pathway-tenant")
+        tenant_class = request.headers.get("x-pathway-tenant-class")
         if srv.admission is not None:
             try:
-                srv.admission.admit()
+                srv.admission.admit(
+                    tenant=tenant, tenant_class=tenant_class
+                )
             except ShedError as e:
                 return (
                     e.status,
@@ -637,6 +656,8 @@ class _ReplicaHttp:
             payload = await loop.run_in_executor(
                 None, srv.responder, srv, values
             )
+            if srv.tenant_ledger is not None:
+                srv.tenant_ledger.observe_staleness(tenant, staleness)
             return 200, payload, headers
         except Exception as exc:
             return (

@@ -75,6 +75,11 @@ _FWD_HEADERS = (
     # request headers forwarded to the replica verbatim
     "x-pathway-max-staleness-ms",
     "content-type",
+    # Tenant Weave identity: replicas run their own tenant ledgers, so
+    # the shed lands on the hot tenant at every member a request is
+    # steered to
+    "x-pathway-tenant",
+    "x-pathway-tenant-class",
 )
 _BACK_HEADERS = (
     # response headers surfaced back to the client
@@ -213,6 +218,7 @@ class FailoverRouter:
         liveness_misses: int = 3,
         default_deadline_ms: float = 30_000.0,
         max_deadline_ms: float = 120_000.0,
+        cache: Any = None,
     ):
         if shards is None and replicas is None:
             shards = shard_map_from_env()
@@ -252,6 +258,15 @@ class FailoverRouter:
         self.liveness_misses = max(int(liveness_misses), 1)
         self.default_deadline_ms = float(default_deadline_ms)
         self.max_deadline_ms = float(max_deadline_ms)
+        # Tenant Weave result cache (serving/result_cache.py): answer
+        # repeat reads without a replica hop, invalidated precisely by
+        # the writer's delta stream.  None (PATHWAY_ROUTER_CACHE unset)
+        # keeps the request path byte-identical to the cache-less plane.
+        if cache is None:
+            from pathway_tpu.serving.result_cache import cache_from_env
+
+            cache = cache_from_env()
+        self.cache = cache
         self._lock = threading.Lock()
         self._failure_listeners: list[Callable[[str, str], None]] = []
         self._past_failures: list[tuple[str, str]] = []
@@ -361,6 +376,8 @@ class FailoverRouter:
         if not self._started or self._stopped:
             return
         self._stopped = True
+        if self.cache is not None:
+            self.cache.close()
         self._loop_ready.wait(timeout)
         stop_async = self._stop_async
         if stop_async is not None:
@@ -604,6 +621,7 @@ class FailoverRouter:
         body = await request.read()
         deadline = time.monotonic() + self._deadline_budget_s(request)
         max_st = self._max_staleness_ms(request)
+        tenant = request.headers.get("x-pathway-tenant")
         span = tracing.get_tracer().span(
             "router.request",
             parent=tracing.parse_traceparent(
@@ -614,6 +632,24 @@ class FailoverRouter:
             route=request.path,
         )
         with span:
+            hit = (
+                self.cache.lookup(tenant, body, max_st, path=request.path)
+                if self.cache is not None and request.method == "POST"
+                else None
+            )
+            if hit is not None:
+                # answered with ZERO replica hops; the hit headers
+                # carry the degrade contract (applied tick + the
+                # invalidation stream's staleness) plus x-pathway-cache
+                status, payload, headers = hit
+                span.set_attribute("status", status)
+                span.set_attribute("outcome", "cache_hit")
+                self._m_requests.labels("cache", "cache_hit").inc()
+                if span.context is not None:
+                    headers["traceparent"] = span.context.traceparent()
+                return web.Response(
+                    body=payload, status=status, headers=headers
+                )
             if self.n_shards > 1:
                 status, payload, headers, outcome, replica = (
                     await self._route_scatter(request, body, deadline, max_st)
@@ -624,6 +660,16 @@ class FailoverRouter:
                 )
             span.set_attribute("status", status)
             span.set_attribute("outcome", outcome)
+            if self.cache is not None and request.method == "POST":
+                self.cache.store(
+                    tenant,
+                    body,
+                    max_st,
+                    status,
+                    payload,
+                    headers,
+                    path=request.path,
+                )
         self._m_requests.labels(replica, outcome).inc()
         if span.context is not None:
             headers["traceparent"] = span.context.traceparent()

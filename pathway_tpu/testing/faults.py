@@ -59,6 +59,15 @@ Directives:
 ``slow_store=ms:<D>``
     Sleep D ms on every persistence-store put/get/get_buffer (I/O
     degradation, including the mmap segment-recovery reads).
+``flood=tenant:<T>,rps:<R>[,ticks:<N>][,class:<C>][,inc:<I>]``
+    Deterministic noisy neighbor (Tenant Weave): charge R synthetic
+    requests to tenant T (weight class C) through the tenant ledger for
+    every REAL admission processed — the ledger's admission counter is
+    the clock, like ``kill=`` uses tick counters, so fairness tests
+    need no wall-clock load generators.  ``ticks:N`` bounds the flood
+    to the first N real admissions (default: the whole run); ``at:`` is
+    rejected (admissions have no head/tail).  Incarnation-gated like
+    every directive.
 
 The incarnation comes from ``PATHWAY_MESH_INCARNATION`` (set by the
 group supervisor, ``parallel/supervisor.py``); kill-like directives
@@ -159,7 +168,7 @@ class FaultPlan:
                     )
                 k, _, v = kv.partition(":")
                 args[k.strip()] = v.strip()
-            known = ("kill", "torn", "slow_store") + _WIRE_DIRECTIVES
+            known = ("kill", "torn", "slow_store", "flood") + _WIRE_DIRECTIVES
             if name not in known:
                 raise FaultSpecError(
                     f"unknown fault directive {name!r} (known: "
@@ -211,6 +220,17 @@ class FaultPlan:
                         raise FaultSpecError(
                             "kill: `at` must be head or tail"
                         )
+            elif name == "flood":
+                if not args.get("tenant"):
+                    raise FaultSpecError("flood: needs `tenant:<id>`")
+                d.arg_int("rps")
+                if args.get("ticks") is not None:
+                    d.arg_int("ticks")
+                if args.get("at") is not None:
+                    raise FaultSpecError(
+                        "flood: `at` does not apply (the admission "
+                        "counter is the clock)"
+                    )
             elif name == "torn":
                 d.arg_int("nth")
             elif name == "slow_store":
@@ -306,6 +326,33 @@ class FaultPlan:
                 self._exit(
                     f"kill writer after published tick {n_published}"
                 )
+
+    def flood_charges(
+        self, admission_n: int
+    ) -> list[tuple[str, str | None, int]]:
+        """Tenant Weave hook, called by the tenant ledger per REAL
+        admission (``admission_n`` = the ledger's deterministic 1-based
+        admission counter).  Returns ``(tenant, weight_class, rps)``
+        synthetic-charge triples for every live ``flood=`` directive —
+        R charges per real admission, for the first ``ticks`` (default:
+        unlimited) admissions."""
+        charges: list[tuple[str, str | None, int]] = []
+        for d in self.directives:
+            if d.name != "flood":
+                continue
+            if not d.matches_process(self.pid, self.incarnation):
+                continue
+            ticks = d.arg_int("ticks", 0) or 0  # 0 = unlimited
+            if ticks and admission_n > ticks:
+                continue
+            charges.append(
+                (
+                    d.args["tenant"],
+                    d.args.get("class"),
+                    d.arg_int("rps") or 0,
+                )
+            )
+        return charges
 
     def on_wire_send(self, channel: str) -> tuple[str, float] | None:
         """Called by the mesh sender thread per outgoing frame. Returns

@@ -1,0 +1,683 @@
+"""Tenant Weave result cache (pathway_tpu/serving/result_cache.py)
+tests — the cache-invalidation PRECISION property plus the unit
+contract.
+
+The acceptance bar: after a tick whose consolidated delta stream names
+keys K, exactly the cached entries covering K are evicted (covering =
+the result set contains a changed key, or an upsert lands against an
+under-filled result set / a query that would admit the new doc into its
+top-k) and every SURVIVING entry still equals a fresh replica answer —
+randomized corpora with deletions, on sharded and unsharded planes, and
+never a full flush on an ordinary tick.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pathway_tpu.serving.result_cache import (
+    CACHE_HEADER,
+    ResultCache,
+    cache_enabled_via_env,
+    cache_from_env,
+    fingerprint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "result-cache-test-secret")
+    monkeypatch.delenv("PATHWAY_ROUTER_CACHE", raising=False)
+    monkeypatch.delenv("PATHWAY_ROUTER_CACHE_WRITER", raising=False)
+    yield
+    from pathway_tpu.parallel import replicate
+
+    replicate.reset_publisher()
+
+
+def _wait(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _batch(rows):
+    from pathway_tpu.engine.batch import DiffBatch
+
+    return DiffBatch.from_rows(rows, ("_data", "_meta"))
+
+
+def _norm(v):
+    v = np.asarray(v, dtype=np.float32).reshape(-1)
+    n = float(np.linalg.norm(v))
+    return v / n if n > 0 else v
+
+
+def _fresh_answer(corpus, qvec, k):
+    """The model replica: brute-force cosine top-k with the (score
+    desc, key asc) tie-break merge_topk and the toy indexes use."""
+    q = _norm(qvec)
+    scored = [
+        (int(key), float(np.dot(q, _norm(vec))))
+        for key, vec in corpus.items()
+    ]
+    scored.sort(key=lambda m: (-m[1], m[0]))
+    return {"matches": [[key, score] for key, score in scored[: int(k)]]}
+
+
+def _body(qvec, k):
+    return json.dumps(
+        {"vec": [float(x) for x in np.asarray(qvec).reshape(-1)], "k": k}
+    ).encode()
+
+
+def _store(cache, tenant, corpus, qvec, k, tick=0, max_st=None, headers=()):
+    body = _body(qvec, k)
+    payload = json.dumps(_fresh_answer(corpus, qvec, k)).encode()
+    hdrs = {
+        "content-type": "application/json",
+        "x-pathway-applied-tick": str(tick),
+        **dict(headers),
+    }
+    ok = cache.store(tenant, body, max_st, 200, payload, hdrs)
+    return body, payload, ok
+
+
+class _FakeStream:
+    """Stands in for a DeltaStreamClient in unit tests: freshness,
+    applied tick and incarnation are directly settable."""
+
+    def __init__(self, staleness=0.0, applied_tick=0, incarnation=0):
+        self.staleness = staleness
+        self.applied_tick = applied_tick
+        self.writer_incarnation = incarnation
+        self.newest_known = applied_tick
+        self.closed = False
+
+    def staleness_seconds(self):
+        return self.staleness
+
+    def close(self):
+        self.closed = True
+
+
+# ---------------------------------------------------------------------------
+# the precision property (the PR's acceptance bar)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_invalidation_precision_property(n_shards):
+    """Randomized corpora + deletions + upserts: eviction is EXACT per
+    the documented contract (changed-key containment, under-filled
+    entries on upsert, would-enter-the-top-k score test) and every
+    survivor still equals a fresh replica answer.  The sharded variant
+    delivers each tick as per-shard batches, the shape the full-corpus
+    observer subscription receives from a sharded writer."""
+    rng = np.random.default_rng(7 + n_shards)
+    dim = 8
+    cache = ResultCache(capacity=4096, dim=dim, ttl_ms=1e9)
+    corpus = {k: rng.standard_normal(dim) for k in range(1, 41)}
+    next_key = 1000
+    queries = []
+    for i in range(18):
+        # k=60 > corpus size: deliberately under-filled entries
+        queries.append(
+            (f"t{i % 3}", rng.standard_normal(dim), int(rng.choice([3, 5, 60])))
+        )
+
+    def store_all(tick):
+        for tenant, qvec, k in queries:
+            _store(cache, tenant, corpus, qvec, k, tick=tick)
+
+    store_all(0)
+    assert len(cache) == len(queries)
+    survivors_seen = 0
+    for tick in range(1, 13):
+        ops = []
+        live = sorted(corpus)
+        for key in rng.choice(
+            live, size=min(int(rng.integers(0, 3)), len(live)), replace=False
+        ):
+            del corpus[int(key)]
+            ops.append((int(key), -1, None))
+        for _ in range(int(rng.integers(0, 3))):
+            if rng.random() < 0.5 and corpus:
+                key = int(rng.choice(sorted(corpus)))
+            else:
+                next_key += 1
+                key = next_key
+            vec = rng.standard_normal(dim).astype(np.float32)
+            corpus[key] = vec
+            ops.append((key, 1, vec))
+        if not ops:
+            continue
+        changed = {k for k, _d, _v in ops}
+        upserts = [(k, v) for k, d, v in ops if d > 0]
+        # the documented eviction contract, computed against the live
+        # entries BEFORE the tick applies
+        expected_evict = set()
+        with cache._lock:
+            entries = {
+                ck: (set(e.keys), e.worst_score, e.full, e.qvec)
+                for ck, e in cache._entries.items()
+            }
+        for ck, (keys, worst, full, qv) in entries.items():
+            if keys & changed:
+                expected_evict.add(ck)
+                continue
+            for _ukey, uvec in upserts:
+                if not full:
+                    expected_evict.add(ck)
+                    break
+                s = float(np.dot(qv, _norm(uvec)))
+                if s >= worst - 1e-6 * max(1.0, abs(worst)):
+                    expected_evict.add(ck)
+                    break
+        before = set(cache.entry_keys())
+        if n_shards > 1:
+            per_shard: dict[int, list] = {}
+            for key, d, v in ops:
+                per_shard.setdefault(key % n_shards, []).append(
+                    (key, d, (v, None))
+                )
+            batches = [_batch(rows) for rows in per_shard.values()]
+        else:
+            batches = [_batch([(key, d, (v, None)) for key, d, v in ops])]
+        cache.ingest(tick, batches)
+        after = set(cache.entry_keys())
+        # eviction is EXACT: precisely the covered entries left, no
+        # full flush on an ordinary tick
+        assert before - after == expected_evict
+        assert after == before - expected_evict
+        survivors_seen += len(after)
+        # every survivor still equals a fresh replica answer
+        for tenant, qvec, k in queries:
+            hit = cache.lookup(tenant, _body(qvec, k), None)
+            if hit is None:
+                continue
+            _status, payload, headers = hit
+            assert headers[CACHE_HEADER] == "hit"
+            got = json.loads(payload)["matches"]
+            want = _fresh_answer(corpus, qvec, k)["matches"]
+            assert [m[0] for m in got] == [m[0] for m in want]
+            np.testing.assert_allclose(
+                [m[1] for m in got],
+                [m[1] for m in want],
+                rtol=1e-5,
+                atol=1e-6,
+            )
+        store_all(tick)
+    assert survivors_seen > 0, "every tick flushed the whole cache"
+
+
+# ---------------------------------------------------------------------------
+# keying + request path units
+
+
+def test_fingerprint_canonicalizes_key_order():
+    a = fingerprint(b'{"query": "x", "k": 3}')
+    b = fingerprint(b'{"k": 3, "query": "x"}')
+    assert a is not None and b is not None
+    assert a[0] == b[0]
+    assert fingerprint(b'{"k": 4, "query": "x"}')[0] != a[0]
+
+
+def test_fingerprint_rejects_non_object_bodies():
+    assert fingerprint(b"not json") is None
+    assert fingerprint(b"[1,2,3]") is None
+    assert fingerprint(b'"str"') is None
+    # empty body canonicalizes to the empty query object
+    assert fingerprint(b"")[0] == fingerprint(b"{}")[0]
+
+
+def test_store_lookup_roundtrip_and_isolation():
+    rng = np.random.default_rng(1)
+    corpus = {k: rng.standard_normal(4) for k in range(6)}
+    cache = ResultCache(capacity=16, dim=4, ttl_ms=1e9)
+    q = rng.standard_normal(4)
+    body, payload, ok = _store(cache, "tenant-a", corpus, q, 3, tick=5)
+    assert ok
+    hit = cache.lookup("tenant-a", body, None)
+    assert hit is not None
+    status, got, headers = hit
+    assert status == 200 and got == payload
+    assert headers[CACHE_HEADER] == "hit"
+    # TTL mode: the entry's stored tick + its age are the freshness
+    assert headers["x-pathway-applied-tick"] == "5"
+    assert float(headers["x-pathway-staleness-seconds"]) >= 0.0
+    # tenant isolation: another tenant NEVER shares an entry
+    assert cache.lookup("tenant-b", body, None) is None
+    # k and the staleness bound are part of the key
+    assert cache.lookup("tenant-a", _body(q, 5), None) is None
+    assert cache.lookup("tenant-a", body, 1000.0) is None
+
+
+def test_ttl_mode_expires_entries():
+    rng = np.random.default_rng(2)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=30.0)
+    body, _payload, ok = _store(cache, "t", corpus, rng.standard_normal(4), 2)
+    assert ok
+    assert cache.lookup("t", body, None) is not None
+    time.sleep(0.06)
+    assert cache.lookup("t", body, None) is None
+
+
+def test_degraded_and_malformed_responses_never_cached():
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=1e9)
+    body = _body(np.ones(4), 3)
+    good = json.dumps({"matches": [[1, 0.5]]}).encode()
+    assert not cache.store("t", body, None, 503, good, {})
+    assert not cache.store(
+        "t", body, None, 200, good, {"x-pathway-stale": "1"}
+    )
+    assert not cache.store("t", body, None, 200, b"not json", {})
+    assert not cache.store(
+        "t", body, None, 200, json.dumps({"error": "x"}).encode(), {}
+    )
+    assert len(cache) == 0
+
+
+def test_non_object_json_payload_never_cached_or_crashes():
+    # a 200 whose JSON body is not an object (custom responder
+    # returning a bare list/string) must pass through uncached — not
+    # blow up the router handler with AttributeError
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=1e9)
+    body = _body(np.ones(4), 3)
+    assert not cache.store("t", body, None, 200, b"[1, 2, 3]", {})
+    assert not cache.store("t", body, None, 200, b'"ok"', {})
+    assert not cache.store("t", body, None, 200, b"42", {})
+    assert len(cache) == 0
+
+
+def test_non_numeric_k_bypasses_cache_not_crashes():
+    # a malformed k must reach the replica (whose structured error
+    # beats a router-side ValueError), never crash lookup/store
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=1e9)
+    good = json.dumps({"matches": [[1, 0.5]]}).encode()
+    for bad_k in ("abc", None, [3], -1, 0):
+        body = json.dumps({"vec": [1.0, 0, 0, 0], "k": bad_k}).encode()
+        assert cache.lookup("t", body, None) is None
+        assert not cache.store("t", body, None, 200, good, {})
+    assert len(cache) == 0
+
+
+def test_cache_key_includes_route_path():
+    # same tenant + identical body POSTed to a different route must
+    # never hit another route's cached answer
+    rng = np.random.default_rng(7)
+    corpus = {k: rng.standard_normal(4) for k in range(6)}
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    q = rng.standard_normal(4)
+    body = _body(q, 2)
+    payload = json.dumps(_fresh_answer(corpus, q, 2)).encode()
+    assert cache.store(
+        "t", body, None, 200, payload, {}, path="/query"
+    )
+    assert cache.lookup("t", body, None, path="/other") is None
+    hit = cache.lookup("t", body, None, path="/query")
+    assert hit is not None and hit[1] == payload
+
+
+def test_lru_bound_evicts_oldest():
+    rng = np.random.default_rng(3)
+    corpus = {k: rng.standard_normal(4) for k in range(8)}
+    cache = ResultCache(capacity=2, dim=4, ttl_ms=1e9)
+    bodies = []
+    for i in range(3):
+        body, _p, ok = _store(cache, "t", corpus, rng.standard_normal(4), 2)
+        assert ok
+        bodies.append(body)
+    assert len(cache) == 2
+    assert cache.lookup("t", bodies[0], None) is None
+    assert cache.lookup("t", bodies[2], None) is not None
+
+
+# ---------------------------------------------------------------------------
+# targeted invalidation units
+
+
+def test_delete_evicts_only_containing_entries():
+    """A deletion evicts exactly the entries whose result set contains
+    the key — removing a non-member only removes competition BELOW the
+    k-th match, so disjoint entries survive untouched (the no-full-
+    flush guarantee in its smallest form)."""
+    e1 = np.eye(4)[0]
+    e2 = np.eye(4)[1]
+    corpus = {1: e1, 2: e1 * 0.9, 3: e2, 4: e2 * 0.9}
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    b1, _p, _ = _store(cache, "t", corpus, e1, 2)  # result set {1, 2}
+    b2, _p, _ = _store(cache, "t", corpus, e2, 2)  # result set {3, 4}
+    cache.ingest(1, [_batch([(1, -1, (None, None))])])
+    assert cache.lookup("t", b1, None) is None
+    assert cache.lookup("t", b2, None) is not None
+
+
+def test_upsert_score_test_spares_provably_unaffected_entries():
+    e1 = np.eye(4)[0]
+    e2 = np.eye(4)[1]
+    corpus = {1: e1, 2: e1 * 0.9, 3: e2, 4: e2 * 0.9}
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    b1, _p, _ = _store(cache, "t", corpus, e1, 2)
+    b2, _p, _ = _store(cache, "t", corpus, e2, 2)
+    # a new doc orthogonal to q1 but aligned with q2: scores 0 against
+    # entry 1 (below its worst kept 0.9 -> survives) and 1.0 against
+    # entry 2 (would enter its top-k -> evicted)
+    new = np.eye(4)[1].astype(np.float32)
+    cache.ingest(1, [_batch([(99, 1, (new, None))])])
+    assert cache.lookup("t", b1, None) is not None
+    assert cache.lookup("t", b2, None) is None
+
+
+def test_underfilled_entry_evicts_on_any_upsert():
+    e1 = np.eye(4)[0]
+    corpus = {1: e1}
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    body, _p, _ = _store(cache, "t", corpus, e1, 5)  # 1 match < k=5
+    far = (-np.eye(4)[0]).astype(np.float32)  # scores -1 against q
+    cache.ingest(1, [_batch([(99, 1, (far, None))])])
+    assert cache.lookup("t", body, None) is None
+
+
+def test_unscoreable_metric_evicts_on_any_upsert():
+    e1 = np.eye(4)[0]
+    corpus = {1: e1, 2: e1 * 0.9}
+    cache = ResultCache(capacity=8, dim=4, metric="l2", ttl_ms=1e9)
+    body, _p, ok = _store(cache, "t", corpus, e1, 2)
+    assert ok
+    far = (-np.eye(4)[0]).astype(np.float32)
+    cache.ingest(1, [_batch([(99, 1, (far, None))])])
+    assert cache.lookup("t", body, None) is None
+
+
+def test_query_text_entries_are_scoreable():
+    """``query`` text reads re-derive the vector via the deterministic
+    text_vector, so the score test applies to them too."""
+    from pathway_tpu.serving.replica import text_vector
+
+    dim = 16
+    qtext = "hello world"
+    qv = text_vector(qtext, dim)
+    corpus = {1: qv, 2: qv * 0.9}
+    cache = ResultCache(capacity=8, dim=dim, ttl_ms=1e9)
+    body = json.dumps({"query": qtext, "k": 2}).encode()
+    payload = json.dumps(_fresh_answer(corpus, qv, 2)).encode()
+    assert cache.store("t", body, None, 200, payload, {})
+    # orthogonal-ish doc scoring far below the worst kept match
+    rng = np.random.default_rng(9)
+    far = rng.standard_normal(dim).astype(np.float32)
+    far -= qv * float(np.dot(_norm(far), _norm(qv)))  # de-correlate
+    cache.ingest(1, [_batch([(99, 1, (far, None))])])
+    assert cache.lookup("t", body, None) is not None
+
+
+# ---------------------------------------------------------------------------
+# freshness contract with an invalidation stream
+
+
+def test_lag_bypasses_cache():
+    cache = ResultCache(capacity=4, dim=4, max_lag_ms=100.0, ttl_ms=1e9)
+    cache._client = _FakeStream(staleness=0.0)
+    rng = np.random.default_rng(4)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    body, _p, ok = _store(cache, "t", corpus, rng.standard_normal(4), 2)
+    assert ok
+    assert cache.lookup("t", body, None) is not None
+    # the invalidation feed lags past the bound: BYPASS, never a
+    # silently-stale hit
+    cache._client.staleness = 0.5
+    assert cache.lookup("t", body, None) is None
+    # a tighter per-request bound bypasses even a within-bound lag
+    cache._client.staleness = 0.05
+    assert cache.lookup("t", body, 10.0) is None
+    assert cache.lookup("t", body, None) is not None
+    # disconnected stream (no staleness clock) bypasses too
+    cache._client.staleness = None
+    assert cache.lookup("t", body, None) is None
+
+
+def test_hit_headers_carry_stream_freshness():
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=1e9)
+    cache._client = _FakeStream(staleness=0.25, applied_tick=12)
+    rng = np.random.default_rng(5)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    body, _p, ok = _store(cache, "t", corpus, rng.standard_normal(4), 2, tick=12)
+    assert ok
+    cache.max_lag_s = 10.0
+    hit = cache.lookup("t", body, None)
+    assert hit is not None
+    headers = hit[2]
+    assert headers[CACHE_HEADER] == "hit"
+    assert headers["x-pathway-applied-tick"] == "12"
+    assert headers["x-pathway-staleness-seconds"] == "0.250"
+
+
+def test_store_ordering_guard_rejects_outrun_answers():
+    """If the invalidation stream has advanced PAST the answering
+    replica's applied tick, a delta the cache already processed could
+    never evict the entry — the store must be skipped."""
+    cache = ResultCache(capacity=4, dim=4, ttl_ms=1e9)
+    cache._client = _FakeStream(applied_tick=10)
+    rng = np.random.default_rng(6)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    q = rng.standard_normal(4)
+    _b, _p, ok = _store(cache, "t", corpus, q, 2, tick=5)
+    assert not ok
+    # a replica answer with no applied-tick header is never cacheable
+    # behind a stream (its position is unknowable)
+    body = _body(q, 2)
+    payload = json.dumps(_fresh_answer(corpus, q, 2)).encode()
+    assert not cache.store("t", body, None, 200, payload, {})
+    _b, _p, ok = _store(cache, "t", corpus, q, 2, tick=10)
+    assert ok
+
+
+def test_incarnation_bump_flushes_wholesale():
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    fake = _FakeStream(incarnation=0)
+    cache._client = fake
+    rng = np.random.default_rng(7)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    _store(cache, "t", corpus, rng.standard_normal(4), 2, tick=0)
+    cache.ingest(1, [])  # adopts incarnation 0, no flush
+    assert len(cache) == 1
+    # writer takeover: the new incarnation's history may not extend
+    # the old one's — nothing cached is trustworthy
+    fake.writer_incarnation = 1
+    cache.ingest(2, [])
+    assert len(cache) == 0
+
+
+def test_resync_flushes_and_resubscribes_from_newest():
+    cache = ResultCache(capacity=8, dim=4, ttl_ms=1e9)
+    fake = _FakeStream(applied_tick=0)
+    fake.newest_known = 7
+    cache._client = fake
+    rng = np.random.default_rng(8)
+    corpus = {k: rng.standard_normal(4) for k in range(4)}
+    _store(cache, "t", corpus, rng.standard_normal(4), 2, tick=0)
+    assert cache._on_resync() == 7
+    assert len(cache) == 0
+
+
+# ---------------------------------------------------------------------------
+# live delta stream end-to-end (unsharded AND sharded writers)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2])
+def test_attach_stream_evicts_from_live_writer(n_shards):
+    """The cache's observer subscription passes the sharded writer's
+    torn-map guard (negative observer id = full-corpus stream) and a
+    published delta evicts the covering entry on every plane shape."""
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+
+    srv = DeltaStreamServer(0, n_shards=n_shards)
+    cache = ResultCache(capacity=8, dim=4, max_lag_ms=60_000.0)
+    try:
+        cache.attach_stream("127.0.0.1", srv.port)
+        e1 = np.eye(4)[0].astype(np.float32)
+        rows = [(1, 1, (e1, None)), (2, 1, (e1 * 0.9, None))]
+        srv.publish(1, [_batch(rows)])
+        assert _wait(lambda: cache.applied_tick >= 1)
+        corpus = {1: e1, 2: e1 * 0.9}
+        body, _p, ok = _store(
+            cache, "t", corpus, e1, 2, tick=cache.applied_tick
+        )
+        assert ok
+        hit = cache.lookup("t", body, None)
+        assert hit is not None
+        assert hit[2][CACHE_HEADER] == "hit"
+        # key 1 sits in the result set: its deletion must evict, on the
+        # sharded plane too (the observer receives EVERY shard's keys)
+        srv.publish(2, [_batch([(1, -1, (None, None))])])
+        assert _wait(lambda: len(cache) == 0)
+        assert cache.lookup("t", body, None) is None
+    finally:
+        cache.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end: hit = ZERO replica hops, delta evicts, miss refreshes
+
+
+class _ToyVecIndex:
+    """Brute-force vector index with the deterministic (score desc,
+    key asc) tie-break the serving plane's merge uses."""
+
+    def __init__(self):
+        self.d: dict[int, np.ndarray] = {}
+
+    def keys(self):
+        return list(self.d.keys())
+
+    def upsert(self, key, data, meta):
+        self.d[int(key)] = np.asarray(data, dtype=np.float32)
+
+    def remove(self, key):
+        self.d.pop(int(key), None)
+
+    def search(self, triples):
+        out = []
+        for q, k, _f in triples:
+            qv = np.asarray(q, dtype=np.float32)
+            scored = [
+                (key, float(qv @ vec)) for key, vec in self.d.items()
+            ]
+            scored.sort(key=lambda m: (-m[1], m[0]))
+            out.append(tuple(scored[: int(k)]))
+        return out
+
+
+def test_router_cache_end_to_end_zero_replica_hops():
+    """Through the real writer→replica→router path: the first read
+    pays a replica hop and primes the cache, the repeat is answered
+    with ``x-pathway-cache: hit`` and ZERO replica hops, a published
+    delta evicts exactly the covering entry, and the next read pays
+    one hop for the FRESH answer."""
+    import requests
+
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+    from pathway_tpu.serving.router import FailoverRouter
+
+    srv = DeltaStreamServer(0)
+    hops = [0]
+
+    def responder(server, values):
+        hops[0] += 1
+        q = np.asarray(values["vec"], dtype=np.float32)
+        res = server.search([(q, int(values.get("k", 3)), None)])[0]
+        return {"matches": [[int(k), float(s)] for k, s in res]}
+
+    rep = ReplicaServer(
+        replica_id=0,
+        index_factory=_ToyVecIndex,
+        writer_port=srv.port,
+        responder=responder,
+    ).start()
+    cache = ResultCache(capacity=16, dim=4, metric="dot")
+    cache.attach_stream("127.0.0.1", srv.port)
+    router = FailoverRouter(
+        [f"http://127.0.0.1:{rep.http_port}"],
+        health_interval_ms=100,
+        cache=cache,
+    ).start()
+    try:
+        e1 = np.eye(4)[0].astype(np.float32)
+        srv.publish(
+            0, [_batch([(1, 1, (e1, None)), (2, 1, (e1 * 0.5, None))])]
+        )
+        assert _wait(lambda: rep.ready and cache.applied_tick >= 0)
+        url = f"http://127.0.0.1:{router.port}/query"
+        body = {"vec": [1.0, 0.0, 0.0, 0.0], "k": 2}
+        hdrs = {"x-pathway-tenant": "hot"}
+        # the router's health loop needs a poll or two to admit the
+        # fresh replica before reads stop shedding 503
+        assert _wait(
+            lambda: requests.post(
+                url, json=body, headers=hdrs, timeout=10
+            ).status_code
+            == 200
+        )
+        cache.flush("test-reset")  # the admission probe primed it
+        hops[0] = 0
+        r1 = requests.post(url, json=body, headers=hdrs, timeout=10)
+        assert r1.status_code == 200
+        assert r1.headers.get("x-pathway-cache") != "hit"
+        hops_after_prime = hops[0]
+        assert hops_after_prime >= 1
+        r2 = requests.post(url, json=body, headers=hdrs, timeout=10)
+        assert r2.status_code == 200
+        assert r2.headers.get("x-pathway-cache") == "hit"
+        assert r2.headers.get("x-pathway-applied-tick") == "0"
+        assert float(r2.headers["x-pathway-staleness-seconds"]) < 60.0
+        assert r2.json() == r1.json()
+        assert hops[0] == hops_after_prime  # ZERO replica hops on the hit
+        # another tenant never shares the entry: its read pays a hop
+        r3 = requests.post(
+            url, json=body, headers={"x-pathway-tenant": "other"}, timeout=10
+        )
+        assert r3.status_code == 200
+        assert r3.headers.get("x-pathway-cache") != "hit"
+        # a delta naming result-set key 1 evicts the entry; the next
+        # read is answered FRESH by the replica (key 1 gone)
+        srv.publish(1, [_batch([(1, -1, (None, None))])])
+        assert _wait(lambda: len(cache) == 0)
+        assert _wait(lambda: rep.applied_tick >= 1)
+        r4 = requests.post(url, json=body, headers=hdrs, timeout=10)
+        assert r4.status_code == 200
+        assert r4.headers.get("x-pathway-cache") != "hit"
+        assert [m[0] for m in r4.json()["matches"]] == [2]
+    finally:
+        router.stop()
+        rep.stop()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# escape hatches
+
+
+def test_cache_from_env_escape_hatch(monkeypatch):
+    assert not cache_enabled_via_env()
+    assert cache_from_env() is None
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE", "1")
+    c = cache_from_env()
+    assert c is not None and c._client is None
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE_WRITER", "not-a-hostport")
+    with pytest.raises(ValueError):
+        cache_from_env()
+
+
+def test_router_builds_no_cache_by_default(monkeypatch):
+    monkeypatch.delenv("PATHWAY_ROUTER_CACHE", raising=False)
+    from pathway_tpu.serving.router import FailoverRouter
+
+    r = FailoverRouter(["http://127.0.0.1:9"])
+    assert r.cache is None

@@ -1,0 +1,518 @@
+"""Tenant Weave (pathway_tpu/serving/tenancy.py) tests: weight-class
+parsing, bounded-cardinality labeling, fair-share buckets, WFQ
+ordering, queue-full eviction charged to the hot tenant, the flood=
+fault directive, the tenant-fairness doctor rule, and the total
+PATHWAY_TENANT_QOS=0 escape hatch."""
+
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.serving import (
+    QoSConfig,
+    ShedError,
+    TenancyConfig,
+    TenantLabeler,
+    TenantLedger,
+    parse_weight_classes,
+    tenancy_enabled_via_env,
+)
+from pathway_tpu.serving.tenancy import ledger_for
+from pathway_tpu.testing import faults
+
+
+def _config(**kw) -> TenancyConfig:
+    kw.setdefault("weights", {"default": 1.0})
+    kw.setdefault("metric_topn", 32)
+    kw.setdefault("state_cap", 1024)
+    kw.setdefault("burst", 4.0)
+    return TenancyConfig(**kw)
+
+
+# --- weight classes --------------------------------------------------------
+
+
+def test_weight_classes_parse():
+    w = parse_weight_classes("premium:4,default:1,batch:0.25")
+    assert w == {"premium": 4.0, "default": 1.0, "batch": 0.25}
+    # default class added when absent; empty spec is just the default
+    assert parse_weight_classes("premium:2") == {
+        "premium": 2.0,
+        "default": 1.0,
+    }
+    assert parse_weight_classes("") == {"default": 1.0}
+
+
+def test_weight_classes_validation():
+    with pytest.raises(ValueError):
+        parse_weight_classes("premium")  # no weight
+    with pytest.raises(ValueError):
+        parse_weight_classes("premium:fast")  # not a number
+    with pytest.raises(ValueError):
+        parse_weight_classes("premium:0")  # must be > 0
+    with pytest.raises(ValueError):
+        parse_weight_classes(":3")  # no class name
+
+
+def test_weight_of_unknown_class_falls_back_to_default():
+    cfg = _config(weights={"premium": 4.0, "default": 1.0})
+    assert cfg.weight_of("premium") == 4.0
+    assert cfg.weight_of("bronze") == 1.0
+    assert cfg.weight_of(None) == 1.0
+
+
+# --- escape hatch ----------------------------------------------------------
+
+
+def test_escape_hatch_builds_no_ledger(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TENANT_QOS", raising=False)
+    assert not tenancy_enabled_via_env()
+    assert ledger_for(QoSConfig()) is None
+    # the gate path stays byte-identical: no ledger, plain-EDF batcher
+    from pathway_tpu.serving.gate import SurgeGate
+
+    class _Sess:
+        def insert_batch(self, rows):
+            pass
+
+    gate = SurgeGate(QoSConfig(), _Sess(), route="/plain")
+    try:
+        assert gate.ledger is None
+        r = _req(1, time.monotonic() + 5)
+        assert gate.batcher._order(r) == r.deadline
+    finally:
+        gate.close()
+
+
+def _req(key, deadline, tenant=None, tenant_class=None):
+    from pathway_tpu.serving.gate import PendingRequest
+
+    return PendingRequest(
+        key, (key,), deadline, tenant=tenant, tenant_class=tenant_class
+    )
+
+
+# --- bounded-cardinality labeling ------------------------------------------
+
+
+def test_labeler_topn_fold_and_sticky():
+    lab = TenantLabeler(topn=2)
+    assert lab.label("a") == "a"
+    assert lab.label("b") == "b"
+    # slots are full: everyone else folds, labels stay sticky
+    assert lab.label("c") == "__other__"
+    for _ in range(100):
+        assert lab.label("c") == "__other__"
+    assert lab.label("a") == "a"
+    assert lab.labeled() == {"a", "b"}
+
+
+def test_labeler_summary_stays_bounded():
+    lab = TenantLabeler(topn=4)
+    for i in range(10_000):
+        lab.label(f"t{i}")
+    assert len(lab._counts) <= 8 * 4
+    assert len(lab.labeled()) == 4
+
+
+# --- fair-share admission --------------------------------------------------
+
+
+def test_ledger_work_conserving_without_pressure():
+    led = TenantLedger(_config(), route="/t", capacity_rps=10.0)
+    now = time.monotonic()
+    # a lone hot tenant on an idle endpoint keeps its full throughput:
+    # way past its fair share, but pressure=False never sheds
+    for i in range(100):
+        led.admit("hot", None, now + i * 0.001, pressure=False)
+
+
+def test_ledger_sheds_hot_tenant_under_pressure():
+    led = TenantLedger(
+        _config(burst=2.0), route="/t", capacity_rps=10.0
+    )
+    now = time.monotonic()
+    with pytest.raises(ShedError) as ei:
+        for i in range(50):
+            led.admit("hot", None, now + i * 1e-4, pressure=True)
+    assert ei.value.status == 429
+    assert ei.value.reason == "tenant_rate"
+    # the tail tenant is untouched: its own bucket is full
+    led.admit("tail", None, now + 0.01, pressure=True)
+
+
+def test_fair_share_splits_by_active_weight():
+    cfg = _config(weights={"premium": 3.0, "default": 1.0})
+    led = TenantLedger(cfg, route="/t", capacity_rps=8.0)
+    now = time.monotonic()
+    led.admit("p", "premium", now, pressure=False)
+    led.admit("d", None, now, pressure=False)
+    # W_active = 4.0: premium gets 3/4 of capacity, default 1/4
+    assert led.fair_rate(3.0) == pytest.approx(6.0)
+    assert led.fair_rate(1.0) == pytest.approx(2.0)
+
+
+def test_explicit_tenant_rps_beats_derived_share():
+    cfg = _config(tenant_rps=5.0)
+    led = TenantLedger(cfg, route="/t", capacity_rps=1000.0)
+    assert led.fair_rate(1.0) == pytest.approx(5.0)
+    assert led.fair_rate(2.0) == pytest.approx(10.0)
+
+
+def test_state_cap_bounds_tracked_tenants():
+    led = TenantLedger(
+        _config(state_cap=8), route="/t", capacity_rps=None
+    )
+    now = time.monotonic()
+    for i in range(1000):
+        led.admit(f"t{i}", None, now + i * 1e-6, pressure=False)
+    assert led.tracked_tenants <= 8
+
+
+def test_active_weight_window_prunes_idle_tenants():
+    from pathway_tpu.serving import tenancy
+
+    led = TenantLedger(_config(), route="/t", capacity_rps=10.0)
+    now = time.monotonic()
+    led.admit("a", None, now, pressure=False)
+    led.admit("b", None, now, pressure=False)
+    assert led.active_weight() == pytest.approx(2.0)
+    # b goes idle past the window; the prune (>=1s apart) drops it
+    led.admit("a", None, now + tenancy.ACTIVE_WINDOW_S + 2.0, pressure=False)
+    assert led.active_weight() == pytest.approx(1.0)
+
+
+# --- WFQ ordering ----------------------------------------------------------
+
+
+def test_wfq_tags_order_hot_backlog_behind_fresh_tail():
+    led = TenantLedger(_config(), route="/t", capacity_rps=None)
+    now = time.monotonic()
+    hot_tags = [
+        led.admit("hot", None, now, pressure=False) for _ in range(5)
+    ]
+    tail_tag = led.admit("tail", None, now, pressure=False)
+    # the hot tenant's 5th request finishes (virtually) after the
+    # tail's 1st: the batcher's (tag, deadline) heap drains tail first
+    assert hot_tags == sorted(hot_tags)
+    assert tail_tag < hot_tags[-1]
+
+
+def test_wfq_weight_scales_virtual_cost():
+    cfg = _config(weights={"premium": 4.0, "default": 1.0})
+    led = TenantLedger(cfg, route="/t", capacity_rps=None)
+    now = time.monotonic()
+    p = [led.admit("p", "premium", now, pressure=False) for _ in range(4)]
+    d = [led.admit("d", None, now, pressure=False) for _ in range(1)]
+    # 4 premium requests cost the same virtual time as 1 default one
+    assert p[-1] == pytest.approx(d[-1], rel=1e-9)
+
+
+def test_batcher_orders_by_wfq_tag_not_deadline():
+    from pathway_tpu.serving.batcher import MicroBatcher
+
+    cfg = QoSConfig(max_batch_size=2, max_wait_ms=10_000.0)
+    dispatched: list = []
+    b = MicroBatcher(
+        cfg,
+        dispatch=lambda reqs: dispatched.append([r.key for r in reqs]),
+        reject=lambda r, e: None,
+        order=lambda r: r.order,
+    )
+    try:
+        now = time.monotonic()
+        # hot request has the EARLIER deadline but the LATER vfinish:
+        # weighted fairness must beat EDF
+        hot = _req(1, now + 1.0, tenant="hot")
+        hot.order = (5.0, hot.deadline)
+        tail = _req(2, now + 9.0, tenant="tail")
+        tail.order = (1.0, tail.deadline)
+        b.put(hot)
+        b.put(tail)
+        deadline = time.monotonic() + 5
+        while not dispatched and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert dispatched and dispatched[0] == [2, 1]
+    finally:
+        b.close()
+
+
+def test_batcher_expiry_reads_deadline_not_order_tag():
+    from pathway_tpu.serving import DeadlineExceeded
+    from pathway_tpu.serving.batcher import MicroBatcher
+
+    cfg = QoSConfig(max_batch_size=64, max_wait_ms=5.0)
+    rejected: list = []
+    b = MicroBatcher(
+        cfg,
+        dispatch=lambda reqs: None,
+        reject=lambda r, e: rejected.append((r.key, type(e).__name__)),
+        order=lambda r: r.order,
+    )
+    try:
+        expired = _req(1, time.monotonic() - 0.01)
+        # a huge order tag must not shield the expired request
+        expired.order = (1e9, expired.deadline)
+        b.put(expired)
+        deadline = time.monotonic() + 5
+        while not rejected and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rejected == [(1, DeadlineExceeded.__name__)]
+    finally:
+        b.close()
+
+
+# --- queue-full eviction ----------------------------------------------------
+
+
+def test_pick_victim_selects_most_over_share():
+    led = TenantLedger(_config(), route="/t")
+    reqs = [_req(i, time.monotonic() + 5) for i in range(3)]
+    reqs[0].order = (2.0, reqs[0].deadline)
+    reqs[1].order = (9.0, reqs[1].deadline)
+    reqs[2].order = (4.0, reqs[2].deadline)
+    assert led.pick_victim(reqs, arriving_tag=3.0) is reqs[1]
+    # the arrival itself is the hottest: no victim, normal shed applies
+    assert led.pick_victim(reqs, arriving_tag=99.0) is None
+
+
+def test_gate_queue_full_evicts_hot_tenant_not_tail(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TENANT_QOS", "1")
+    from pathway_tpu.serving.gate import SurgeGate
+
+    class _Sess:
+        def insert_batch(self, rows):
+            pass
+
+    # max_wait huge + window 1 so nothing flushes while we fill the
+    # queue; max_queue tiny so the eviction path triggers
+    cfg = QoSConfig(
+        max_queue=3,
+        max_batch_size=64,
+        max_wait_ms=60_000.0,
+        max_dispatched=1,
+    )
+    gate = SurgeGate(cfg, _Sess(), route="/evict")
+    try:
+        assert gate.ledger is not None
+        rejected: list = []
+        from pathway_tpu.serving.gate import PendingRequest
+
+        class _Recording(PendingRequest):
+            def reject(self, exc):
+                rejected.append((self.key, exc))
+
+        now = time.monotonic()
+        for i in range(3):
+            gate.submit(_Recording(i, (i,), now + 30.0, tenant="hot"))
+        assert gate.queue_depth == 3
+        tail = _Recording(99, (99,), now + 30.0, tenant="tail")
+        gate.submit(tail)  # must NOT raise: the hot victim pays
+        assert rejected, "no hot-tenant request was evicted"
+        key, exc = rejected[0]
+        assert key in (0, 1, 2)
+        assert isinstance(exc, ShedError)
+        assert exc.status == 429 and exc.reason == "tenant_evict"
+        assert gate.queue_depth == 3  # tail took the victim's slot
+        with gate.batcher._cond:
+            queued_keys = {r.key for _t, _s, r in gate.batcher._heap}
+        assert 99 in queued_keys and key not in queued_keys
+    finally:
+        gate.close()
+
+
+def test_admission_under_pressure_signal():
+    from pathway_tpu.serving.admission import AdmissionController
+
+    ctl = AdmissionController(QoSConfig(max_queue=4), route="/p")
+    assert not ctl.under_pressure()
+    ctl.queued = 2  # half full
+    assert ctl.under_pressure()
+    ctl.queued = 0
+    rps = AdmissionController(
+        QoSConfig(max_queue=100, rate_limit_rps=5.0, rate_limit_burst=2.0),
+        route="/p2",
+    )
+    now = time.monotonic()
+    assert not rps.under_pressure(now)
+    rps._bucket.tokens = 0.5
+    rps._bucket._last = now
+    assert rps.under_pressure(now)
+
+
+def test_replica_admission_sheds_tenant_rate():
+    from pathway_tpu.serving.admission import AdmissionController
+
+    led = TenantLedger(
+        _config(burst=1.0), route="/r", capacity_rps=1.0
+    )
+    ctl = AdmissionController(
+        QoSConfig(max_queue=2, rate_limit_rps=1.0, rate_limit_burst=1.0),
+        route="/r",
+        ledger=led,
+    )
+    now = time.monotonic()
+    ctl.admit(now, tenant="hot")
+    # bucket drained (shared AND tenant): the next hot admit sheds as
+    # tenant_rate BEFORE consuming anything shared
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(now + 1e-4, tenant="hot")
+    assert ei.value.reason == "tenant_rate"
+
+
+def test_shared_path_shed_refunds_tenant_charge():
+    from pathway_tpu.serving.admission import AdmissionController
+
+    led = TenantLedger(_config(burst=2.0), route="/rf", capacity_rps=10.0)
+    ctl = AdmissionController(
+        QoSConfig(max_queue=1), route="/rf", ledger=led
+    )
+    now = time.monotonic()
+    ctl.admit(now, tenant="hot")  # tokens 2 -> 1, queued 1
+    # queue full: the shared-path queue_full shed must REFUND the
+    # tenant charge — the request never entered the queue
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(now + 1e-4, tenant="hot")
+    assert ei.value.reason == "queue_full"
+    # after the queue drains, the refunded token admits the next
+    # request even under sticky pressure (without the refund the
+    # bucket would be empty and this would shed tenant_rate)
+    ctl.on_flushed(1)
+    ctl.admit(now + 2e-4, tenant="hot")
+    # only the two REAL admissions were counted as admitted
+    assert led._m_admitted.labels("/rf", "hot").value == 2
+
+
+def test_refund_restores_token_and_wfq_clock():
+    led = TenantLedger(_config(burst=2.0), route="/t", capacity_rps=10.0)
+    now = time.monotonic()
+    tag1 = led.admit("t", None, now, pressure=False)
+    tag2 = led.admit("t", None, now, pressure=False)
+    assert led._tenants["t"].tokens == pytest.approx(0.0, abs=1e-6)
+    led.refund("t", None, tag2)
+    assert led._tenants["t"].tokens == pytest.approx(1.0, abs=1e-6)
+    assert led._tenants["t"].vfinish == pytest.approx(tag1)
+    # later admits moved the clock past the refunded tag: no rollback
+    led.admit("t", None, now, pressure=False)
+    tag4 = led.admit("t", None, now, pressure=False)
+    assert tag4 > tag2
+    led.refund("t", None, tag2)
+    assert led._tenants["t"].vfinish == pytest.approx(tag4)
+
+
+# --- Fault Forge flood= -----------------------------------------------------
+
+
+def test_flood_spec_parses_and_validates():
+    p = faults.FaultPlan("flood=tenant:hot,rps:5,ticks:3", 0, 0)
+    assert p.flood_charges(1) == [("hot", None, 5)]
+    assert p.flood_charges(3) == [("hot", None, 5)]
+    assert p.flood_charges(4) == []  # past the ticks bound
+    p2 = faults.FaultPlan("flood=tenant:hot,rps:2,class:batch", 0, 0)
+    assert p2.flood_charges(100) == [("hot", "batch", 2)]
+
+
+def test_flood_spec_rejections():
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan("flood=rps:5", 0, 0)  # needs tenant
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan("flood=tenant:t", 0, 0)  # needs rps
+    with pytest.raises(faults.FaultSpecError):
+        # admissions have no head/tail
+        faults.FaultPlan("flood=tenant:t,rps:5,at:head", 0, 0)
+
+
+def test_flood_is_incarnation_gated():
+    p = faults.FaultPlan("flood=tenant:hot,rps:5", 0, 1)
+    assert p.flood_charges(1) == []  # directive defaults to inc 0
+    p2 = faults.FaultPlan("flood=tenant:hot,rps:5,inc:1", 0, 1)
+    assert p2.flood_charges(1) == [("hot", None, 5)]
+
+
+def test_flood_charges_ledger_without_wall_clock(monkeypatch):
+    monkeypatch.setenv("PATHWAY_FAULTS", "flood=tenant:hot,rps:50")
+    faults.reset()
+    try:
+        led = TenantLedger(
+            _config(burst=2.0), route="/f", capacity_rps=10.0
+        )
+        now = time.monotonic()
+        # ONE real tail admission; the directive charges 50 synthetic
+        # hot requests against the same instant — the hot tenant's
+        # bucket drains deterministically, no load generator involved
+        led.admit("tail", None, now, pressure=True)
+        with pytest.raises(ShedError) as ei:
+            led.admit("hot", None, now + 1e-4, pressure=True)
+        assert ei.value.reason == "tenant_rate"
+        # synthetic charges never advance the REAL admission counter
+        # (the flood would otherwise feed itself)
+        assert led._admissions == 2
+    finally:
+        monkeypatch.delenv("PATHWAY_FAULTS")
+        faults.reset()
+
+
+# --- Graph Doctor -----------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _gated_graph():
+    from pathway_tpu.io.http import rest_connector
+
+    class QuerySchema(pw.Schema):
+        text: str
+
+    gated, writer = rest_connector(
+        host="127.0.0.1",
+        port=_free_port(),
+        schema=QuerySchema,
+        route="/gated",
+        qos=QoSConfig(),
+    )
+    writer(gated.select(query_id=gated.id, result=gated.text))
+
+
+def test_doctor_tenant_fairness_warns_on_tenant_blind_plane(monkeypatch):
+    from pathway_tpu.analysis import run_doctor
+
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS", "http://127.0.0.1:1,http://127.0.0.1:2"
+    )
+    monkeypatch.delenv("PATHWAY_TENANT_QOS", raising=False)
+    _gated_graph()
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    hits = report.by_rule("tenant-fairness")
+    assert len(hits) == 1
+    assert hits[0].severity.name == "WARNING"
+    # arming tenancy clears the finding
+    monkeypatch.setenv("PATHWAY_TENANT_QOS", "1")
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    assert not report.by_rule("tenant-fairness")
+
+
+def test_doctor_tenant_fairness_info_on_ttl_only_cache(monkeypatch):
+    from pathway_tpu.analysis import run_doctor
+
+    monkeypatch.delenv("PATHWAY_SERVING_REPLICAS", raising=False)
+    monkeypatch.setenv("PATHWAY_TENANT_QOS", "1")
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE", "1")
+    monkeypatch.delenv("PATHWAY_ROUTER_CACHE_WRITER", raising=False)
+    _gated_graph()
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    hits = report.by_rule("tenant-fairness")
+    assert len(hits) == 1
+    assert hits[0].severity.name == "INFO"
+    # naming the writer's delta endpoint clears it
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE_WRITER", "127.0.0.1:9999")
+    report = run_doctor(list(pw.internals.parse_graph.G.outputs))
+    assert not report.by_rule("tenant-fairness")
