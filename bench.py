@@ -3045,6 +3045,244 @@ def _bench_serve_chaos(np):
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _bench_generate_serve(np):
+    """Token Loom tier (GEN_r14.json): closed-loop generate load over
+    the zipf-tenant population against one generation replica — the
+    ask->retrieve->generate path end-to-end (retrieval over the
+    replica's KNN index, continuous-batching decode over the paged KV
+    cache).  Phases: `steady` = sustained closed loop (tokens/s, QPS,
+    TTFT p50/p99 from the scheduler's histogram); `deadline_pressure`
+    = the same loop under tight x-pathway-deadline-ms budgets sized to
+    expire MID-decode (explicit 504s, pages reclaimed — drop
+    accounting from pathway_generate_dropped_mid_decode_total);
+    `kill_restore` = a snapshot-armed scheduler frozen mid-generation
+    (the in-process stand-in for SIGKILL: only what the periodic
+    arrangement snapshot committed survives) and restored from the
+    manifest — the restored decode output must EQUAL the uninterrupted
+    run's.  error_served (responses outside 200/400/429/503/504) must
+    be 0 in every phase."""
+    import shutil
+    import tempfile
+    import threading
+
+    import requests
+
+    from pathway_tpu.generate.scheduler import (
+        DecodeScheduler,
+        GenerateConfig,
+        GenerationRequest,
+    )
+    from pathway_tpu.generate.serving import attach_generate
+    from pathway_tpu.serving.replica import ReplicaServer, text_vector
+    from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
+    from pathway_tpu.xpacks.llm import decoder as dec
+
+    out: dict = {"platform": "cpu", "cpu_cores": os.cpu_count()}
+    dim = 16
+    n_docs = 64
+    gen_cfg = GenerateConfig(
+        n_pages=256, page_size=16, max_batch=8, max_len=192,
+        max_new_tokens=16,
+    )
+    srv = ReplicaServer(
+        replica_id=0,
+        index_factory=lambda: TpuDenseKnnIndex(dimensions=dim),
+        dim=dim,
+    )
+    for i in range(n_docs):
+        srv.index.upsert(i, text_vector("doc %d" % i, dim), None)
+    sched = attach_generate(
+        srv, DecodeScheduler(gen_cfg, replica_label="bench")
+    )
+    srv.start()
+    url = "http://127.0.0.1:%d/generate" % srv.http_port
+
+    def dropped_total():
+        return float(sched._m_dropped.value)
+
+    def load_phase(
+        workers, duration_s, deadline_ms, max_tokens,
+        tight_deadline_ms=None, tight_max_tokens=None,
+    ):
+        """Closed loop; when ``tight_*`` is set, ODD workers send those
+        over-budget requests (the mid-run deadline pressure) while even
+        workers keep the normal profile — drops must land ONLY on the
+        over-budget generations."""
+        served_tokens: list = []
+        lats: list = []
+        statuses: dict = {}
+        lock = threading.Lock()
+        tenants = 1_000_000
+        t_start = time.perf_counter()
+        stop_at = t_start + duration_s
+
+        def worker(wid):
+            rng = np.random.default_rng(wid)
+            sess = requests.Session()
+            tight = tight_deadline_ms is not None and wid % 2 == 1
+            w_deadline = tight_deadline_ms if tight else deadline_ms
+            w_tokens = tight_max_tokens if tight else max_tokens
+            while time.perf_counter() < stop_at:
+                tenant = int(rng.zipf(1.2)) % tenants
+                t0 = time.perf_counter()
+                try:
+                    r = sess.post(
+                        url,
+                        json={
+                            "prompt": "summarize doc %d"
+                            % (tenant % n_docs),
+                            "k": 3,
+                            "max_tokens": w_tokens,
+                            "seed": tenant,
+                        },
+                        headers={
+                            "x-pathway-deadline-ms": str(w_deadline),
+                            "x-pathway-tenant": str(tenant),
+                        },
+                        timeout=w_deadline / 1000.0 + 15,
+                    )
+                    code = r.status_code
+                    toks = (
+                        r.json().get("token_count", 0)
+                        if code == 200
+                        else 0
+                    )
+                except Exception:
+                    code, toks = 0, 0
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    statuses[code] = statuses.get(code, 0) + 1
+                    if code == 200:
+                        served_tokens.append(toks)
+                        lats.append(dt)
+                if code in (429, 503):
+                    time.sleep(0.01)
+
+        threads = [
+            threading.Thread(target=worker, args=(w,))
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_start
+        total = sum(statuses.values())
+        benign = sum(
+            statuses.get(c, 0) for c in (200, 400, 429, 503, 504)
+        )
+        return {
+            "workers": workers,
+            "duration_s": round(elapsed, 2),
+            "qps": round(len(lats) / elapsed, 2) if elapsed else 0.0,
+            "tokens_per_sec": round(sum(served_tokens) / elapsed, 1)
+            if elapsed
+            else 0.0,
+            "latency_p50_ms": round(float(np.percentile(lats, 50)), 1)
+            if lats
+            else None,
+            "latency_p99_ms": round(float(np.percentile(lats, 99)), 1)
+            if lats
+            else None,
+            "error_served": total - benign,
+            "status_counts": {
+                str(k): v for k, v in sorted(statuses.items())
+            },
+        }
+
+    try:
+        # warm the jit caches off the clock
+        requests.post(
+            url,
+            json={"prompt": "warmup", "k": 3, "max_tokens": 4},
+            timeout=120,
+        )
+        ttft_hist = sched._m_ttft
+        out["steady"] = load_phase(
+            workers=6, duration_s=12.0, deadline_ms=20_000, max_tokens=16
+        )
+        try:
+            out["steady"]["ttft_p50_ms"] = round(
+                ttft_hist.quantile(0.5) * 1000.0, 1
+            )
+            out["steady"]["ttft_p99_ms"] = round(
+                ttft_hist.quantile(0.99) * 1000.0, 1
+            )
+        except Exception:
+            pass
+        drops_before = dropped_total()
+        out["deadline_pressure"] = load_phase(
+            workers=6, duration_s=8.0, deadline_ms=20_000, max_tokens=8,
+            tight_deadline_ms=400, tight_max_tokens=48,
+        )
+        out["deadline_pressure"]["dropped_mid_decode"] = int(
+            dropped_total() - drops_before
+        )
+        out["deadline_pressure"]["pages_in_use_after"] = sched.pool.in_use
+    finally:
+        srv.stop()
+
+    # --- kill/restore leg --------------------------------------------------
+    root = tempfile.mkdtemp(prefix="pw-genbench-")
+    try:
+        prompt = dec.encode_text("kill restore equality leg")
+        kw = dict(
+            max_new_tokens=24, temperature=0.7, top_k=20, seed=14
+        )
+        small = GenerateConfig(
+            n_pages=32, page_size=8, max_batch=1, max_len=96,
+        )
+        s0 = DecodeScheduler(small, replica_label="b-u")
+        r0 = GenerationRequest(
+            "u", list(prompt), deadline=time.monotonic() + 120, **kw
+        )
+        s0.submit(r0)
+        res0 = r0.wait(120)
+        s0.stop()
+        snap_cfg = GenerateConfig(
+            n_pages=32, page_size=8, max_batch=1, max_len=96,
+            snapshot_every=4, store_root=root,
+        )
+        s1 = DecodeScheduler(snap_cfg, replica_label="b-k")
+        r1 = GenerationRequest(
+            "k", list(prompt), deadline=time.monotonic() + 120, **kw
+        )
+        t_kill = time.perf_counter()
+        s1.submit(r1)
+        while s1.stats()["decode_steps"] < 12:
+            time.sleep(0.005)
+        s1._step = lambda: time.sleep(0.05)  # simulated SIGKILL
+        time.sleep(0.2)
+        s2 = DecodeScheduler(snap_cfg, replica_label="b-r")
+        deadline = time.monotonic() + 120
+        while not s2.finished and time.monotonic() < deadline:
+            time.sleep(0.02)
+        restore_s = time.perf_counter() - t_kill
+        res2 = (
+            next(iter(s2.finished.values())) if s2.finished else None
+        )
+        out["kill_restore"] = {
+            "restored_seqs": getattr(s2, "restored_seqs", 0),
+            "restored_equals_uninterrupted": bool(
+                res0
+                and res2
+                and res0["status"] == 200
+                and res2.get("tokens") == res0["tokens"]
+            ),
+            "kill_to_completed_s": round(restore_s, 2),
+        }
+        s2.stop()
+        s1.stop()  # the frozen "killed" scheduler: stop its loop and
+        # batcher threads so later bench tiers don't inherit the spin
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    out["error_served_total"] = int(
+        out["steady"]["error_served"]
+        + out["deadline_pressure"]["error_served"]
+    )
+    return out
+
+
 def main() -> None:
     import numpy as np
 
@@ -3197,6 +3435,15 @@ def main() -> None:
         extra["serve_chaos"] = _bench_serve_chaos(np)
     except Exception as e:
         errors.append(f"serve-chaos:{type(e).__name__}:{e}")
+
+    try:
+        # Token Loom tier: closed-loop ask->retrieve->generate load
+        # (tokens/s, TTFT p50/p99, mid-decode drop accounting, the
+        # kill/restore equality leg) — also standalone as
+        # `python bench.py generate_serve` (writes GEN_r14.json)
+        extra["generate_serve"] = _bench_generate_serve(np)
+    except Exception as e:
+        errors.append(f"generate-serve:{type(e).__name__}:{e}")
 
     try:
         extra["rag_e2e_qps"] = round(_bench_rag_qps(np, on_accel), 1)
@@ -3358,6 +3605,20 @@ if __name__ == "__main__":
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "SERVE_r13.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["generate_serve"]:
+        # standalone tier run; also records the GEN_rNN.json artifact
+        # (ask->retrieve->generate closed loop, ISSUE 14 acceptance)
+        import numpy as _np
+
+        _gen = _bench_generate_serve(_np)
+        _doc = {"tier": "generate_serve", **_gen}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "GEN_r14.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

@@ -682,6 +682,95 @@ def tenant_fairness(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# 5d. generation serving (Token Loom)
+
+
+@rule("generation-serving")
+def generation_serving(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """A ``/generate`` route without a deadline bound or admission gate
+    is unbounded DEVICE MEMORY, not just unbounded queueing: every
+    admitted generation pins KV pages for its whole decode, so nothing
+    ever reclaims them without deadline propagation, and an ungated
+    ingress lets a burst exhaust the page pool for everyone.  WARNING
+    on either; INFO when the KV page-pool size runs on the default —
+    the pool IS the generation plane's memory budget and deserves an
+    explicit statement (``PATHWAY_GENERATE_PAGES``)."""
+    import os
+
+    from pathway_tpu.generate.scheduler import (
+        DEFAULT_PAGES,
+        generate_enabled_via_env,
+    )
+
+    # graph-declared generate ingress: a rest_connector route named
+    # like /generate feeding the engine
+    gen_nodes = []
+    for node in facts.order:
+        if not isinstance(node, InputNode):
+            continue
+        subject = getattr(getattr(node, "source", None), "subject", None)
+        if subject is None or type(subject).__name__ != "RestServerSubject":
+            continue
+        route = getattr(subject, "_route", "/") or "/"
+        if "generate" not in str(route):
+            continue
+        gen_nodes.append((node, route, getattr(subject, "_qos", None)))
+    # env-armed generation plane (serving/replica.py role):
+    # PATHWAY_GENERATE=1 mounts /generate on the replica
+    env_armed = generate_enabled_via_env()
+    if not gen_nodes and not env_armed:
+        return
+    for node, route, qos in gen_nodes:
+        if qos is None:
+            yield Diagnostic(
+                "generation-serving",
+                Severity.WARNING,
+                f"generate ingress {route!r} has no admission gate: "
+                "every request starts a decode that pins KV pages "
+                "until completion — an unbounded burst exhausts the "
+                "page pool (device memory), not just the queue",
+                node,
+                fix_hint="pass qos=pathway_tpu.serving.QoSConfig(...) "
+                "(or set PATHWAY_SERVING_ENABLED=1) so generations "
+                "shed explicitly before touching the device",
+                data={"route": route},
+            )
+    anchor = gen_nodes[0][0] if gen_nodes else None
+    if env_armed or gen_nodes:
+        deadline_bounded = bool(
+            os.environ.get("PATHWAY_SERVING_DEADLINE_MS", "")
+            or os.environ.get("PATHWAY_SERVING_MAX_DEADLINE_MS", "")
+        )
+        if not deadline_bounded:
+            yield Diagnostic(
+                "generation-serving",
+                Severity.WARNING,
+                "generation serving has no configured deadline bound: "
+                "deadline propagation is what drops expired "
+                "generations MID-decode and reclaims their KV pages — "
+                "unbounded decode is unbounded device memory",
+                anchor,
+                fix_hint="set PATHWAY_SERVING_DEADLINE_MS (the default "
+                "budget applied when x-pathway-deadline-ms is absent) "
+                "and/or PATHWAY_SERVING_MAX_DEADLINE_MS (the clamp on "
+                "client budgets) for the generate route",
+            )
+        if not os.environ.get("PATHWAY_GENERATE_PAGES", ""):
+            yield Diagnostic(
+                "generation-serving",
+                Severity.INFO,
+                "the KV page pool is running on its default size "
+                f"({DEFAULT_PAGES} pages): the pool is the generation "
+                "plane's device-memory budget — size it explicitly "
+                "for the expected concurrent sequences x "
+                "(prompt+max_tokens)/page_size",
+                anchor,
+                fix_hint="set PATHWAY_GENERATE_PAGES (and "
+                "PATHWAY_GENERATE_PAGE_SIZE) to the planned budget",
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5b. recoverability (Phoenix Mesh)
 
 

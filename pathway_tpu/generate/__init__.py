@@ -1,0 +1,35 @@
+"""Token Loom — the on-device generation stage of the serving plane.
+
+Closes the RAG loop the xpack serves: ask -> retrieve (the existing KNN
+read plane) -> generate (a continuous-batching decode scheduler over a
+paged, arrangement-backed KV cache).  See:
+
+* :mod:`pathway_tpu.generate.kv_cache` — fixed-size KV pages in a block
+  pool with per-sequence page tables, mirrored into arrangement ledgers
+  (the PR-7 substrate) so generation state snapshots incrementally and
+  survives kill/restart;
+* :mod:`pathway_tpu.generate.scheduler` — decode steps admitted through
+  the Surge-Gate EDF micro-batcher on the power-of-two pad ladder, new
+  sequences joining between steps, deadline propagation dropping
+  expired generations MID-decode (504, pages reclaimed);
+* :mod:`pathway_tpu.generate.serving` — the ``/generate`` route:
+  retrieve -> prompt assembly -> streamed decode, behind the same
+  router/staleness/tenant machinery as every other read.
+"""
+
+from pathway_tpu.generate.kv_cache import KvLedger, PagePool
+from pathway_tpu.generate.scheduler import (
+    DecodeScheduler,
+    GenerateConfig,
+    GenerationRequest,
+)
+from pathway_tpu.generate.serving import attach_generate
+
+__all__ = [
+    "KvLedger",
+    "PagePool",
+    "DecodeScheduler",
+    "GenerateConfig",
+    "GenerationRequest",
+    "attach_generate",
+]

@@ -1051,6 +1051,31 @@ def dcn_active() -> bool:
     return n > 1 and os.environ.get("PATHWAY_DCN", "1") != "0"
 
 
+def _flush_mesh_at_exit() -> None:
+    """atexit hook: flush-close the mesh singleton before the
+    interpreter kills its daemon sender threads.
+
+    Nothing else ever closes the singleton, and the PR-6 overlapped
+    sender means a rank can COMPLETE its last barrier/gather (it only
+    needs the peers' frames) while its own final frame still sits in an
+    outbox queue — process exit then kills the sender mid-queue, the
+    frame is never delivered, and the slower peer blocks until the
+    socket EOF declares this rank dead (the load-flaky
+    test_two_process_wordcount_wire_formats failure: under contention
+    the sender thread loses the race with interpreter teardown).
+    ``close()`` queues the stop sentinel BEHIND pending frames and
+    joins the senders, so every frame a completed tick produced is on
+    the wire before the sockets go down.  Injected deaths (os._exit /
+    SIGKILL) bypass atexit, so Fault Forge kills stay abrupt."""
+    with _mesh_lock:
+        m = _mesh
+    if m is not None and not m._closed:
+        try:
+            m.close()
+        except Exception:
+            pass  # exit path: never mask the process's real outcome
+
+
 def get_host_mesh() -> HostMesh:
     """Process-wide mesh singleton (daemon threads live for the process)."""
     global _mesh
@@ -1060,4 +1085,7 @@ def get_host_mesh() -> HostMesh:
             if n <= 1:
                 raise HostMeshError("PATHWAY_PROCESSES must be > 1")
             _mesh = HostMesh(n, pid, port, host)
+            import atexit
+
+            atexit.register(_flush_mesh_at_exit)
         return _mesh

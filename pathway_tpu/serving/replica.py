@@ -75,6 +75,30 @@ def text_vector(text: str, dim: int) -> np.ndarray:
     return acc.astype(np.float32)
 
 
+def staleness_bound_exceeded(
+    staleness: float | None, stale: bool, max_raw: str | None
+) -> bool:
+    """The ``x-pathway-max-staleness-ms`` shed predicate — ONE rule for
+    every route that answers from this replica's corpus (/query reads
+    AND /generate, whose output is conditioned on it).  Unknown
+    staleness counts as over any finite bound; a caught-up replica is
+    FRESH (staleness ~0 between heartbeats), so bound 0 sheds only
+    when genuinely stale.  Unparseable/non-finite bounds are ignored
+    (no bound)."""
+    import math
+
+    if max_raw is None:
+        return False
+    try:
+        bound_ms = float(max_raw)
+    except ValueError:
+        return False
+    if not math.isfinite(bound_ms):
+        return False
+    over = staleness is None or staleness * 1000.0 > bound_ms
+    return over or (bound_ms <= 0.0 and stale)
+
+
 def hydrate_index_state(
     store: Any, node_class: str = "ExternalIndexNode"
 ) -> tuple[Any, int, int] | None:
@@ -280,6 +304,12 @@ class ReplicaServer:
         )
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Token Loom: extra POST routes mounted before start() —
+        # generate.serving.attach_generate registers the /generate
+        # handler (an async fn(http, request) -> StreamResponse) and
+        # the decode scheduler here
+        self.extra_post_routes: dict[str, Any] = {}
+        self.generate_scheduler: Any = None
         self._http = _ReplicaHttp(self)
 
     # --- state ------------------------------------------------------------
@@ -354,6 +384,8 @@ class ReplicaServer:
         self._closed = True
         if self._client is not None:
             self._client.close()
+        if self.generate_scheduler is not None:
+            self.generate_scheduler.stop()
         self._http.stop()
 
     # --- hydrate + deltas -------------------------------------------------
@@ -474,7 +506,13 @@ class ReplicaServer:
         c = self._client
         s = self.staleness_seconds()
         docs, nbytes = self.corpus_stats()
+        gen = (
+            self.generate_scheduler.stats()
+            if self.generate_scheduler is not None
+            else None
+        )
         return {
+            "generate": gen,
             "replica": self.replica_id,
             "incarnation": self.incarnation,
             "applied_tick": self.applied_tick,
@@ -545,6 +583,32 @@ class _ReplicaHttp:
 
         app.router.add_post(srv.route, handle_read)
         app.router.add_get("/replica/health", handle_health)
+        for path, fn in srv.extra_post_routes.items():
+
+            async def handle_extra(request: web.Request, _fn=fn):
+                try:
+                    resp = await _fn(self, request)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    # a handler bug must surface as a COUNTED
+                    # structured 500 (the bench's error_served
+                    # accounting reads these), never a raw aiohttp 500
+                    # invisible to srv._count
+                    resp = web.json_response(
+                        {"error": f"{type(exc).__name__}: {exc}"},
+                        status=500,
+                    )
+                # a streamed generation commits HTTP 200 at prepare;
+                # its REAL outcome (e.g. a 504 mid-stream drop) rides
+                # the override so request accounting stays honest
+                srv._count(
+                    getattr(resp, "_pathway_status_override", None)
+                    or resp.status
+                )
+                return resp
+
+            app.router.add_post(path, handle_extra)
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
@@ -593,8 +657,6 @@ class _ReplicaHttp:
         return web.json_response(payload, status=status, headers=headers)
 
     async def _serve(self, request) -> tuple[int, Any, dict]:
-        import math
-
         from pathway_tpu.serving.admission import ShedError
 
         srv = self.server
@@ -611,26 +673,20 @@ class _ReplicaHttp:
             headers["x-pathway-stale"] = "true"
         # the request's freshness bound: shed explicitly rather than
         # silently serve data older than the client can accept
-        max_raw = request.headers.get("x-pathway-max-staleness-ms")
-        if max_raw is not None:
-            try:
-                bound_ms = float(max_raw)
-            except ValueError:
-                bound_ms = None
-            if bound_ms is not None and math.isfinite(bound_ms):
-                over = staleness is None or staleness * 1000.0 > bound_ms
-                # a caught-up replica is FRESH (staleness ~0 between
-                # heartbeats) — only shed when genuinely over the bound
-                if over or (bound_ms <= 0.0 and stale):
-                    return (
-                        503,
-                        {
-                            "error": "replica staler than "
-                            "x-pathway-max-staleness-ms",
-                            "replica": srv.replica_id,
-                        },
-                        {"Retry-After": "1.0", **headers},
-                    )
+        if staleness_bound_exceeded(
+            staleness,
+            stale,
+            request.headers.get("x-pathway-max-staleness-ms"),
+        ):
+            return (
+                503,
+                {
+                    "error": "replica staler than "
+                    "x-pathway-max-staleness-ms",
+                    "replica": srv.replica_id,
+                },
+                {"Retry-After": "1.0", **headers},
+            )
         tenant = request.headers.get("x-pathway-tenant")
         tenant_class = request.headers.get("x-pathway-tenant-class")
         if srv.admission is not None:
@@ -761,6 +817,15 @@ def main() -> int:
         shard=int(shard_raw) if shard_raw else -1,
         n_shards=n_shards,
     )
+    # Token Loom: PATHWAY_GENERATE=1 mounts the /generate route (the
+    # ask->retrieve->generate stage) on this replica, configured by the
+    # PATHWAY_GENERATE_* knobs (pool size, snapshot cadence, store)
+    from pathway_tpu.generate.scheduler import generate_enabled_via_env
+
+    if generate_enabled_via_env():
+        from pathway_tpu.generate.serving import attach_generate
+
+        attach_generate(server)
     server.start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_a: stop.set())

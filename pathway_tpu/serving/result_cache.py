@@ -40,6 +40,17 @@ coverage is exactly the shard set of its result keys for deletions,
 and an upsert in ANY shard is score-tested (a new doc from an uncovered
 shard can still beat the worst kept match in the merged top-k).
 
+Invalidation is SUBLINEAR in the cache size: containment evictions
+come from the per-key reverse index, and the would-enter test selects
+its candidates from a sorted worst-kept-score bound index (by
+Cauchy-Schwarz, a doc of norm ``|d|`` can only enter an entry whose
+``(worst - slack)/|q| <= |d|``) — a changed key tests a bound instead
+of re-scoring every cached entry.  The bound is sharp for the ``dot``
+metric (doc norms vary); under ``cosine`` both sides are normalized so
+it degenerates to ~1 and the index selects nearly everything — the
+eviction SET is identical to the full scan either way (property-tested
+in tests/test_result_cache.py).
+
 **Freshness contract (the PR-8 degrade headers hold through the
 cache).**  A hit carries ``x-pathway-cache: hit`` plus
 ``x-pathway-applied-tick`` (the invalidation stream's applied tick —
@@ -154,6 +165,8 @@ class _Entry:
         "scoreable",
         "stored_at",
         "tick",
+        "bound",
+        "seq",
     )
 
     def __init__(
@@ -178,6 +191,25 @@ class _Entry:
         self.scoreable = qvec is not None
         self.stored_at = time.monotonic()
         self.tick = tick
+        # worst-kept-score bound for the sublinear upsert test: by
+        # Cauchy-Schwarz dot(q, d) <= |q|·|d|, so an upserted doc of
+        # norm |d| can only enter this entry's top-k when
+        # |d| >= (worst - slack) / |q|.  Entries sit in a sorted bound
+        # index; one bisect per tick finds the prefix that needs real
+        # scoring instead of re-scoring every cached entry.
+        if self.full and self.scoreable:
+            slack = _SCORE_EPS * max(1.0, abs(worst_score))
+            qn = float(np.linalg.norm(qvec))
+            need = worst_score - slack
+            if qn > 0.0:
+                self.bound = need / qn
+            else:
+                # a zero query scores every doc 0: evictable iff the
+                # bound is already <= 0, never otherwise
+                self.bound = -np.inf if need <= 0.0 else np.inf
+        else:
+            self.bound = -np.inf  # evicts on ANY upsert (no score test)
+        self.seq = 0  # bound-index tie-break, assigned at store
 
 
 class ResultCache:
@@ -218,6 +250,14 @@ class ResultCache:
         # reverse index: corpus key -> cache keys of entries whose
         # result set contains it (the deletion/containment eviction)
         self._by_key: dict[int, set] = {}
+        # sublinear upsert invalidation: entries sorted by their
+        # worst-kept-score bound (see _Entry.bound) — one bisect per
+        # tick selects the prefix an upserted doc could possibly enter;
+        # everything past it provably survives WITHOUT re-scoring.
+        # (bound, seq, ck) tuples: seq breaks ties so mixed-type cache
+        # keys never get compared.
+        self._bound_index: list[tuple[float, int, tuple]] = []
+        self._entry_seq = 0
         self._client: Any = None
         self._seen_incarnation = -1
         # newest tick ever handed to ingest(), maintained under _lock.
@@ -353,6 +393,20 @@ class ResultCache:
                     removed.append(int(key))
         changed = {k for k, _v in upserted}
         changed.update(removed)
+        dvecs = [
+            self._prep_vec(v) if v is not None else None
+            for _k, v in upserted
+        ]
+        # the covering prefix of the bound index: the largest upserted
+        # doc norm decides which entries an upsert could possibly enter
+        # (a None dvec — vectorless upsert or unknown metric — defeats
+        # the bound, so every entry becomes a candidate, matching the
+        # pre-index scan)
+        blind = any(d is None for d in dvecs)
+        max_norm = max(
+            (float(np.linalg.norm(d)) for d in dvecs if d is not None),
+            default=None,
+        )
         with self._lock:
             # recorded BEFORE any eviction work so a store() racing
             # this tick sees it and refuses answers this pass could
@@ -361,17 +415,35 @@ class ResultCache:
                 self._seen_tick = tick
             if not changed:
                 return
-            # snapshot the eviction-relevant fields: the O(entries)
-            # scoring pass runs OUTSIDE the lock so router lookups and
+            # candidates, each a SUBLINEAR selection: containment from
+            # the per-key reverse index; upsert entrants from the bound
+            # index prefix (a changed key tests a bound instead of
+            # re-scoring every cached entry)
+            cand: dict[tuple, None] = {}
+            for key in changed:
+                for ck in self._by_key.get(key, ()):
+                    cand[ck] = None
+            if upserted:
+                if blind:
+                    cand.update((ck, None) for ck in self._entries)
+                elif max_norm is not None:
+                    import bisect
+
+                    hi = bisect.bisect_right(
+                        self._bound_index, (max_norm, 1 << 62, ())
+                    )
+                    cand.update(
+                        (ck, None)
+                        for _b, _s, ck in self._bound_index[:hi]
+                    )
+            # snapshot only the candidates' eviction-relevant fields:
+            # scoring runs OUTSIDE the lock so router lookups and
             # stores never stall behind a churny invalidation tick
             snapshot = [
                 (ck, e.keys, e.worst_score, e.full, e.scoreable, e.qvec)
-                for ck, e in self._entries.items()
+                for ck in cand
+                if (e := self._entries.get(ck)) is not None
             ]
-        dvecs = [
-            self._prep_vec(v) if v is not None else None
-            for _k, v in upserted
-        ]
         evict: dict[tuple, str] = {}
         for ck, keys, worst, full, scoreable, qvec in snapshot:
             if keys & changed:
@@ -414,11 +486,20 @@ class ResultCache:
                 s.discard(ck)
                 if not s:
                     del self._by_key[key]
+        import bisect
+
+        i = bisect.bisect_left(self._bound_index, (e.bound, e.seq, ck))
+        if (
+            i < len(self._bound_index)
+            and self._bound_index[i][1] == e.seq
+        ):
+            self._bound_index.pop(i)
 
     def flush(self, reason: str) -> None:
         with self._lock:
             self._entries.clear()
             self._by_key.clear()
+            self._bound_index.clear()
         self._m_flushes.labels(reason).inc()
 
     # --- request path -------------------------------------------------------
@@ -588,6 +669,13 @@ class ResultCache:
                 if tick < 0 or max(self._seen_tick, self.applied_tick) > tick:
                     return False
             self._drop_locked(ck)  # replace: unindex the old result set
+            self._entry_seq += 1
+            entry.seq = self._entry_seq
+            import bisect
+
+            bisect.insort(
+                self._bound_index, (entry.bound, entry.seq, ck)
+            )
             self._entries[ck] = entry
             self._entries.move_to_end(ck)
             for key in keys:

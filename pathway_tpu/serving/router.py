@@ -650,11 +650,17 @@ class FailoverRouter:
                 return web.Response(
                     body=payload, status=status, headers=headers
                 )
-            if self.n_shards > 1:
+            from pathway_tpu.generate.serving import is_generate_route
+
+            if self.n_shards > 1 and not is_generate_route(request.path):
                 status, payload, headers, outcome, replica = (
                     await self._route_scatter(request, body, deadline, max_st)
                 )
             else:
+                # /generate rides the same occupancy/staleness/tenant
+                # single-member ladder even on a sharded plane:
+                # generation is stateful on the member holding the KV
+                # pages — scatter-gather is a retrieval concept
                 status, payload, headers, outcome, replica = (
                     await self._route(request, body, deadline, max_st)
                 )

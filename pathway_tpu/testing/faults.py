@@ -201,6 +201,18 @@ class FaultPlan:
                             "scoped kills (replicas have no tick "
                             "head/tail)"
                         )
+                elif args.get("decode") is not None:
+                    # decode-scoped kill: counts the generation
+                    # scheduler's decode steps (generate/scheduler.py);
+                    # `at` is meaningless — the step counter is the
+                    # deterministic clock
+                    d.arg_int("decode")
+                    if args.get("at") is not None:
+                        raise FaultSpecError(
+                            "kill: `at` does not apply to decode-"
+                            "scoped kills (the decode-step counter is "
+                            "the clock)"
+                        )
                 elif args.get("writer") is not None:
                     # writer-scoped kill: counts distinct PUBLISHED
                     # delta ticks; `at` is meaningless (the publish
@@ -278,9 +290,11 @@ class FaultPlan:
             if (
                 d.args.get("replica") is not None
                 or d.args.get("writer") is not None
+                or d.args.get("decode") is not None
             ):
-                continue  # replica-/writer-scoped kills fire in their
-                # own hooks (on_replica_tick / on_writer_tick)
+                continue  # replica-/writer-/decode-scoped kills fire in
+                # their own hooks (on_replica_tick / on_writer_tick /
+                # on_decode_step)
             if not d.matches_process(self.pid, self.incarnation):
                 continue
             if d.args.get("at", "head") != phase:
@@ -326,6 +340,22 @@ class FaultPlan:
                 self._exit(
                     f"kill writer after published tick {n_published}"
                 )
+
+    def on_decode_step(self, n_steps: int) -> None:
+        """Called by the generation scheduler (generate/scheduler.py)
+        after each completed decode step; ``n_steps`` is the
+        deterministic per-process step counter ``kill=decode:N`` fires
+        on — the chaos clock for mid-generation deaths."""
+        for d in self.directives:
+            if d.name != "kill" or d.fired:
+                continue
+            if d.args.get("decode") is None:
+                continue
+            if not d.matches_process(self.pid, self.incarnation):
+                continue
+            if n_steps >= (d.arg_int("decode") or 1):
+                d.fired += 1
+                self._exit(f"kill after decode step {n_steps}")
 
     def flood_charges(
         self, admission_n: int

@@ -1249,3 +1249,76 @@ def test_replica_shield_chaos_kill_and_supervised_restart(tmp_path):
             writer.wait(timeout=30)
         except subprocess.TimeoutExpired:
             writer.kill()
+
+
+# ---------------------------------------------------------------------------
+# mesh teardown determinism (the wordcount wire-format flake fix)
+
+
+def test_mesh_atexit_flush_hook():
+    """The atexit hook flush-closes the mesh singleton exactly once:
+    the PR-6 overlapped sender means a rank can complete its final
+    barrier while its own frame still sits in an outbox — interpreter
+    exit used to kill the sender mid-queue and the peer EOF'd
+    (test_two_process_wordcount_wire_formats under load).  close()
+    queues the stop sentinel BEHIND pending frames, so registering it
+    at exit makes the teardown deterministic."""
+    from pathway_tpu.parallel import host_exchange as hx
+
+    class _Stub:
+        def __init__(self):
+            self._closed = False
+            self.closes = 0
+
+        def close(self):
+            self.closes += 1
+            self._closed = True
+
+    stub = _Stub()
+    old = hx._mesh
+    try:
+        hx._mesh = stub
+        hx._flush_mesh_at_exit()
+        assert stub.closes == 1
+        hx._flush_mesh_at_exit()  # already closed: no double close
+        assert stub.closes == 1
+        hx._mesh = None
+        hx._flush_mesh_at_exit()  # no mesh: no-op
+    finally:
+        hx._mesh = old
+
+
+def test_mesh_close_delivers_queued_frames(monkeypatch):
+    """What the atexit hook relies on: frames already queued on an
+    outbox are ON THE WIRE before close() returns — the stop sentinel
+    queues behind them."""
+    import threading
+
+    from pathway_tpu.parallel import host_exchange as hx
+
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "flush-test")
+    base = _free_port()
+    meshes = [None, None]
+
+    def build(pid):
+        meshes[pid] = hx.HostMesh(2, pid, base, connect_timeout=30.0)
+
+    threads = [
+        threading.Thread(target=build, args=(pid,)) for pid in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    m0, m1 = meshes
+    assert m0 is not None and m1 is not None
+    try:
+        for i in range(8):
+            m0.send(1, "flushch", i, {"i": i})
+        m0.close()  # what the atexit hook calls
+        # every queued frame arrived despite the immediate close
+        for i in range(8):
+            got = m1.gather("flushch", i, timeout=30)
+            assert got == {0: {"i": i}}
+    finally:
+        m1.close()

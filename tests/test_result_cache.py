@@ -681,3 +681,172 @@ def test_router_builds_no_cache_by_default(monkeypatch):
 
     r = FailoverRouter(["http://127.0.0.1:9"])
     assert r.cache is None
+
+
+# ---------------------------------------------------------------------------
+# sublinear upsert invalidation (the worst-kept-score bound index)
+
+
+def _reference_evictions(snapshot, changed, dvecs):
+    """The pre-index O(entries) scan — the oracle the bound index must
+    match EXACTLY (same eviction set, same precedence of reasons)."""
+    from pathway_tpu.serving.result_cache import _SCORE_EPS
+
+    evict = {}
+    for ck, keys, worst, full, scoreable, qvec in snapshot:
+        if keys & changed:
+            evict[ck] = "delta_contains"
+            continue
+        for dvec in dvecs:
+            if not full:
+                evict[ck] = "delta_notfull"
+                break
+            if not scoreable or dvec is None:
+                evict[ck] = "delta_enters"
+                break
+            s = float(np.dot(qvec, dvec))
+            slack = _SCORE_EPS * max(1.0, abs(worst))
+            if s >= worst - slack:
+                evict[ck] = "delta_enters"
+                break
+    return evict
+
+
+def test_bound_index_eviction_equality_property():
+    """ROADMAP Tenant-QoS follow-up (b): the sublinear bound-index
+    path evicts EXACTLY the set the old full-scan path did, over
+    randomized corpora, entry shapes (full / under-filled / vectorless
+    upserts) and mixed delete+upsert ticks."""
+    rng = np.random.default_rng(42)
+    dim = 8
+    for trial in range(25):
+        cache = ResultCache(capacity=256, dim=dim, metric="cosine")
+        corpus = {
+            i: rng.normal(size=dim).astype(np.float32)
+            for i in range(20)
+        }
+        # a mixed population of entries: varying k (some under-filled
+        # because k > corpus), several tenants
+        bodies = {}
+        for e in range(rng.integers(3, 12)):
+            qvec = rng.normal(size=dim).astype(np.float32)
+            k = int(rng.integers(1, 26))  # k>20 => under-filled
+            tenant = f"t{rng.integers(0, 3)}"
+            body, _payload, ok = _store(
+                cache, tenant, corpus, qvec, k, tick=0
+            )
+            if ok:
+                bodies[(tenant, body)] = True
+        # one random tick: deletes + upserts (some without vectors)
+        rows = []
+        for key in rng.choice(20, size=rng.integers(1, 4), replace=False):
+            if rng.random() < 0.4:
+                rows.append((int(key), -1, (None, None)))
+            elif rng.random() < 0.15:
+                rows.append((int(key), +1, (None, None)))  # vectorless
+            else:
+                vec = rng.normal(size=dim).astype(np.float32)
+                # occasionally a LONG vector (tests the norm bound) or
+                # a tiny one (provably below every worst score)
+                scalep = rng.random()
+                if scalep < 0.25:
+                    vec = vec * 10.0
+                elif scalep < 0.5:
+                    vec = vec * 1e-3
+                rows.append((int(key), +1, (vec, None)))
+        changed = {int(k) for k, _d, _v in rows}
+        dvecs = [
+            cache._prep_vec(v[0]) if d > 0 and v[0] is not None else None
+            for _k, d, v in rows
+            if d > 0
+        ]
+        with cache._lock:
+            snapshot = [
+                (ck, e.keys, e.worst_score, e.full, e.scoreable, e.qvec)
+                for ck, e in cache._entries.items()
+            ]
+        expected = set(_reference_evictions(snapshot, changed, dvecs))
+        before = set(cache.entry_keys())
+        cache.ingest(1, [_batch(rows)])
+        after = set(cache.entry_keys())
+        assert before - after == expected, (
+            f"trial {trial}: bound-index evictions diverge from the "
+            f"full-scan oracle (extra={before - after - expected}, "
+            f"missed={expected - (before - after)})"
+        )
+
+
+def test_bound_index_maintained_across_store_drop_flush():
+    """The sorted bound index stays in lockstep with the entry map
+    through store, replace, LRU eviction, delta eviction and flush."""
+    rng = np.random.default_rng(7)
+    dim = 8
+    cache = ResultCache(capacity=4, dim=dim, metric="cosine")
+    corpus = {i: rng.normal(size=dim).astype(np.float32) for i in range(6)}
+
+    def check():
+        assert len(cache._bound_index) == len(cache._entries)
+        bounds = [b for b, _s, _ck in cache._bound_index]
+        assert bounds == sorted(bounds)
+        assert {ck for _b, _s, ck in cache._bound_index} == set(
+            cache._entries
+        )
+
+    for i in range(8):  # capacity 4: LRU evictions happen
+        _store(cache, "t", corpus, rng.normal(size=dim), 3, tick=0)
+        check()
+    # replace an existing entry (same body)
+    qvec = rng.normal(size=dim).astype(np.float32)
+    _store(cache, "t", corpus, qvec, 3, tick=0)
+    _store(cache, "t", corpus, qvec, 3, tick=0)
+    check()
+    # delta eviction
+    cache.ingest(1, [_batch([(0, -1, (None, None))])])
+    check()
+    cache.flush("test")
+    check()
+    assert len(cache) == 0
+
+
+def test_bound_index_excludes_provably_safe_entries():
+    """The point of the index: an upsert whose doc norm sits BELOW an
+    entry's worst-kept-score bound never even becomes a scoring
+    candidate (and provably survives).  Uses the DOT metric, where doc
+    norms carry real signal — under cosine both sides are normalized,
+    so the Cauchy-Schwarz bound degenerates to ~1 and the index simply
+    selects (nearly) everything, which the equality property covers."""
+    import bisect
+
+    rng = np.random.default_rng(11)
+    dim = 8
+    cache = ResultCache(capacity=64, dim=dim, metric="dot")
+    qvec = _norm(rng.normal(size=dim)).astype(np.float32)  # |q| = 1
+
+    def put(key_lo, worst):
+        body = _body(qvec, 2)
+        payload = json.dumps(
+            {"matches": [[key_lo, worst + 1.0], [key_lo + 1, worst]]}
+        ).encode()
+        assert cache.store(
+            "t", body[:-1] + f',"tag":{key_lo}}}'.encode(), None, 200,
+            payload, {"x-pathway-applied-tick": "0"},
+        )
+
+    put(0, 4.0)  # high worst: bound ~ 4.0
+    put(10, 0.01)  # low worst: always a candidate
+    assert len(cache) == 2
+    # an upserted doc of norm 0.5 can score at most 0.5 against a unit
+    # query: the worst=4.0 entry is excluded WITHOUT scoring
+    d = (_norm(rng.normal(size=dim)) * 0.5).astype(np.float32)
+    hi = bisect.bisect_right(cache._bound_index, (0.5, 1 << 62, ()))
+    covered = {ck for _b, _s, ck in cache._bound_index[:hi]}
+    assert len(covered) == 1  # only the low-bound entry needs scoring
+    cache.ingest(1, [_batch([(99, +1, (d, None))])])
+    # the high-bound entry survived; the low-bound one was score-tested
+    # (dot vs 0.01 - slack decides its fate — either way, the safe one
+    # is still here)
+    remaining = cache.entry_keys()
+    assert any("0" in str(ck) for ck in remaining) or len(cache) >= 1
+    with cache._lock:
+        worsts = [e.worst_score for e in cache._entries.values()]
+    assert 4.0 in worsts
