@@ -3045,6 +3045,409 @@ def _bench_serve_chaos(np):
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _bench_reshard_live(np):
+    """Shard Flux tier (SERVE_r15.json): live elastic resharding.
+
+    Leg A (`mesh_resize`): a supervised 2-rank DCN wordcount group is
+    resized to 3 ranks mid-run via ``GroupSupervisor.resize`` +
+    ``elastic.mesh.reshard_stores`` — the acceptance evidence is
+    ``replayed_events: 0`` on every incarnation-1 rank (state moved,
+    log untouched), folded output bit-equal to the uninterrupted
+    totals, the handoff pause (group stop → new group's first output),
+    and bytes ferried vs total segment bytes (only moved key ranges
+    cross rank boundaries; the moved ranges ship through a real
+    SegmentFerry).
+
+    Leg B (`serving_reshard`): the serving plane changes shard count
+    mid-load — split 1→3 then merge 3→2.  The delta-stream writer
+    republishes under the new map (``DeltaStreamServer.reshard`` via
+    the writer's RESHARD file), old-map members fence themselves with
+    the transition guard and keep serving stale, new shard members
+    hydrate (mmap + shard filter) and the router atomically swaps maps
+    at the commit barrier — ``error_served`` must stay 0 for the whole
+    closed loop."""
+    import pathlib
+    import secrets
+    import shutil
+    import socket as socket_mod
+    import tempfile
+    import threading
+
+    import requests
+
+    from pathway_tpu.elastic.mesh import reshard_stores
+    from pathway_tpu.observability import tracing as _tracing
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.serving.router import FailoverRouter
+    from pathway_tpu.testing.chaos import (
+        REPL_WRITER_SCRIPT,
+        RESHARD_WORKER_SCRIPT,
+        fold_diff_stream,
+        free_dcn_port,
+    )
+
+    out: dict = {"cpu_cores": os.cpu_count()}
+    base = pathlib.Path(tempfile.mkdtemp(prefix="pw-reshard-live-"))
+    prior_secret = os.environ.get("PATHWAY_DCN_SECRET")
+    job_secret = prior_secret or secrets.token_hex(16)
+    os.environ["PATHWAY_DCN_SECRET"] = job_secret
+    _tracer_was = _tracing.get_tracer().enabled
+    _tracing.get_tracer().enabled = False
+    sups: list = []
+    sup_threads: list = []
+    routers: list = []
+    writer = None
+    try:
+        # ---- leg A: mesh resize 2 -> 3 --------------------------------
+        mbase = base / "mesh"
+        for pid in range(3):
+            (mbase / f"in{pid}").mkdir(parents=True)
+        script = mbase / "worker.py"
+        script.write_text(RESHARD_WORKER_SCRIPT)
+        port = free_dcn_port(3)
+        env = {
+            "PW_TEST_DIR": str(mbase),
+            "PATHWAY_DCN_PORT": str(port),
+            "PATHWAY_DCN_SECRET": job_secret,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TRACING": "0",
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        }
+        roots = [str(mbase / f"pstorage{p}") for p in range(3)]
+        vocab = 31
+        phase1 = {
+            0: ["w%d" % (i % vocab) for i in range(240)],
+            1: ["w%d" % ((i * 7) % vocab) for i in range(240)],
+        }
+        for pid, words in phase1.items():
+            with open(mbase / f"in{pid}" / "f1.jsonl", "w") as f:
+                for w in words:
+                    f.write(json.dumps({"word": w}) + "\n")
+        counts: dict = {}
+        for words in phase1.values():
+            for w in words:
+                counts[w] = counts.get(w, 0) + 1
+        p1_expected = {(w,): (c,) for w, c in counts.items()}
+        sup = GroupSupervisor(
+            [sys.executable, str(script)],
+            2,
+            env=env,
+            max_restarts=1,
+            grace_s=25.0,  # the graceful stop's final snapshot must
+            # land before any SIGKILL escalation
+            log_dir=str(mbase / "logs"),
+        )
+        th = threading.Thread(target=sup.run, daemon=True)
+        th.start()
+        sups.append(sup)
+        sup_threads.append(th)
+        deadline = time.monotonic() + 240
+        folded: dict = {}
+        while time.monotonic() < deadline:
+            folded = fold_diff_stream(
+                [mbase / f"out{p}_inc0.jsonl" for p in range(2)], ["word"]
+            )
+            if folded == p1_expected:
+                break
+            time.sleep(0.3)
+        if folded != p1_expected:
+            raise RuntimeError("mesh leg never converged on phase 1")
+        # phase-1 freeze: resize SIGTERMs the group; the workers stop
+        # gracefully and the final commit snapshots, so the cut covers
+        # the whole durable log (wait_snapshot_covered is the belt for
+        # harnesses that cannot stop gracefully)
+        reshard_stats: dict = {}
+        t_resize = time.monotonic()
+        sup.resize(
+            3,
+            reshard=lambda: reshard_stats.update(
+                reshard_stores(roots[:2], roots)
+            ),
+        )
+        deadline = time.monotonic() + 180
+        while (
+            not any(e[1] in ("group-resize", "resize-rollback")
+                    for e in sup.events)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        resized = any(e[1] == "group-resize" for e in sup.events)
+        phase2 = {
+            0: ["w%d" % (i % vocab) for i in range(60)],
+            1: ["w%d" % ((i * 5) % vocab) for i in range(60)],
+            2: ["w%d" % ((i * 3) % vocab) for i in range(60)],
+        }
+        for pid, words in phase2.items():
+            with open(mbase / f"in{pid}" / "f2.jsonl", "w") as f:
+                for w in words:
+                    f.write(json.dumps({"word": w}) + "\n")
+            for w in words:
+                counts[w] = counts.get(w, 0) + 1
+        expected = {(w,): (c,) for w, c in counts.items()}
+        # incarnation-major fold order: inc-0 activity strictly
+        # precedes inc-1, and ownership is per-rank disjoint WITHIN an
+        # incarnation (rank-major could fold a re-homed key's update
+        # before its install)
+        out_paths = [
+            mbase / f"out{p}_inc{i}.jsonl"
+            for i in range(2)
+            for p in range(3)
+        ]
+        first_new_out = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if first_new_out is None and any(
+                (mbase / f"out{p}_inc1.jsonl").exists()
+                and (mbase / f"out{p}_inc1.jsonl").stat().st_size > 0
+                for p in range(3)
+            ):
+                first_new_out = time.monotonic()
+            folded = fold_diff_stream(out_paths, ["word"])
+            if folded == expected:
+                break
+            time.sleep(0.3)
+        converged = folded == expected
+        (mbase / "STOP").touch()
+        th.join(timeout=120)
+        replayed = {}
+        for p in range(3):
+            log = mbase / "logs" / f"rank{p}-inc1.log"
+            if log.exists():
+                for line in log.read_text().splitlines():
+                    if line.startswith("REPLAYED "):
+                        replayed[str(p)] = int(line.split()[1])
+        out["mesh_resize"] = {
+            "resized": resized,
+            "handoff_pause_s": (
+                round(first_new_out - t_resize, 2)
+                if first_new_out is not None
+                else None
+            ),
+            "replayed_events": replayed,
+            "folded_bit_equal": converged,
+            "moved_slot_fraction": reshard_stats.get("plan", {}).get(
+                "moved_slot_fraction"
+            ),
+            "total_rows": reshard_stats.get("total_rows"),
+            "moved_rows": reshard_stats.get("moved_rows"),
+            "bytes_total_segments": reshard_stats.get(
+                "bytes_total_segments"
+            ),
+            "bytes_ferried": reshard_stats.get("bytes_ferried"),
+            "ferry": reshard_stats.get("ferry"),
+        }
+        sups.clear()
+        sup_threads.clear()
+
+        # ---- leg B: serving plane split 1->3, merge 3->2 --------------
+        DIM = 32
+        N_DOCS = 6_000
+        sbase = base / "serve"
+        (sbase / "docs").mkdir(parents=True)
+        (sbase / "q").mkdir()
+        with open(sbase / "docs" / "seed.jsonl", "w") as f:
+            for i in range(N_DOCS):
+                f.write(json.dumps({"text": "doc %d" % i}) + "\n")
+        repl_port = free_dcn_port(1)
+        wscript = sbase / "writer.py"
+        wscript.write_text(REPL_WRITER_SCRIPT)
+        env_common = {
+            "PW_WRITER_DIR": str(sbase),
+            "PATHWAY_DCN_SECRET": job_secret,
+            "PATHWAY_REPLICA_DIM": str(DIM),
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TRACING": "0",
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        }
+        wenv = dict(os.environ)
+        wenv.update(env_common)
+        wenv["PATHWAY_REPL_PORT"] = str(repl_port)
+        writer = subprocess.Popen(
+            [sys.executable, str(wscript)],
+            env=wenv,
+            stdout=open(sbase / "writer.log", "wb"),
+            stderr=subprocess.STDOUT,
+        )
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            s = socket_mod.socket()
+            try:
+                s.connect(("127.0.0.1", repl_port))
+                break
+            except OSError:
+                time.sleep(0.5)
+            finally:
+                s.close()
+        else:
+            raise RuntimeError(
+                "writer never opened the delta stream: "
+                + (sbase / "writer.log").read_text()[-2000:]
+            )
+
+        def start_member(rid, http_port, extra_env=None):
+            renv = dict(env_common)
+            renv["PATHWAY_REPLICA_ID"] = str(rid)
+            renv["PATHWAY_REPLICA_STORE"] = str(sbase / "pstorage")
+            renv["PATHWAY_REPL_PORT"] = str(repl_port)
+            renv["PATHWAY_REPLICA_HTTP_PORT"] = str(http_port)
+            renv["PATHWAY_SERVING_ENABLED"] = "1"
+            renv["PATHWAY_SERVING_RPS"] = "50"
+            renv["PATHWAY_SERVING_BURST"] = "25"
+            if extra_env:
+                renv.update(extra_env)
+            m_sup = GroupSupervisor(
+                [sys.executable, "-m", "pathway_tpu.serving.replica"],
+                1,
+                env=renv,
+                max_restarts=1,
+                backoff_s=0.2,
+                log_dir=str(sbase / ("member%d-logs" % rid)),
+            )
+            m_th = threading.Thread(target=m_sup.run, daemon=True)
+            m_th.start()
+            sups.append(m_sup)
+            sup_threads.append(m_th)
+            return m_sup, m_th
+
+        def wait_ready(ports, timeout=300):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                ok = 0
+                for p in ports:
+                    try:
+                        if requests.get(
+                            "http://127.0.0.1:%d/replica/health" % p,
+                            timeout=2,
+                        ).json().get("ready"):
+                            ok += 1
+                    except Exception:
+                        pass
+                if ok == len(ports):
+                    return
+                time.sleep(0.5)
+            raise RuntimeError("members never became ready: %r" % (ports,))
+
+        port0 = free_dcn_port(1)
+        sup0, th0 = start_member(0, port0)
+        wait_ready([port0])
+        router = FailoverRouter(
+            ["http://127.0.0.1:%d" % port0], health_interval_ms=200
+        ).start()
+        routers.append(router)
+        load_s = 75.0
+        load_result: dict = {}
+        load_t = threading.Thread(
+            target=lambda: load_result.update(
+                _serve_chaos_load_phase(
+                    np, router.port, 8, load_s, N_DOCS
+                )
+            )
+        )
+        load_t.start()
+        time.sleep(5.0)
+
+        def probe_shards():
+            try:
+                r = requests.post(
+                    "http://127.0.0.1:%d/query" % router.port,
+                    json={"query": "doc 1", "k": 3},
+                    timeout=5,
+                )
+                return r.status_code, r.headers.get("x-pathway-shards")
+            except Exception:
+                return 0, None
+
+        transitions = []
+        for phase_name, n_shards in (("split_1_to_3", 3),
+                                     ("merge_3_to_2", 2)):
+            t0 = time.monotonic()
+            (sbase / "RESHARD").write_text(str(n_shards))
+            ports = [free_dcn_port(1) for _ in range(n_shards)]
+            old_members = list(zip(sups[1:], sup_threads[1:]))
+            for i in range(n_shards):
+                start_member(
+                    100 * n_shards + i,
+                    ports[i],
+                    extra_env={
+                        "PATHWAY_SERVING_SHARDS": str(n_shards),
+                        "PATHWAY_REPLICA_SHARD": str(i),
+                    },
+                )
+            wait_ready(ports)
+            t_swap = time.monotonic()
+            router.swap_shard_map(
+                [["http://127.0.0.1:%d" % p] for p in ports]
+            )
+            swap_s = time.monotonic() - t_swap
+            first_200 = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                code, shards_hdr = probe_shards()
+                if code == 200 and shards_hdr == str(n_shards):
+                    first_200 = time.monotonic()
+                    break
+                time.sleep(0.2)
+            # retire the superseded members (never member 0 mid-split:
+            # it is the stale-serving bridge until the swap lands)
+            for m_sup, m_th in old_members:
+                m_sup.stop()
+                m_th.join(timeout=30)
+                sups.remove(m_sup)
+                sup_threads.remove(m_th)
+            transitions.append(
+                {
+                    "phase": phase_name,
+                    "n_shards": n_shards,
+                    "reshard_to_swap_s": round(t_swap - t0, 2),
+                    "swap_s": round(swap_s, 3),
+                    "post_swap_first_200_s": (
+                        round(first_200 - t_swap, 2)
+                        if first_200 is not None
+                        else None
+                    ),
+                }
+            )
+        # member 0 (old unsharded bridge) retires after the merge too
+        sup0.stop()
+        th0.join(timeout=30)
+        load_t.join(timeout=load_s + 120)
+        out["serving_reshard"] = {
+            "n_docs": N_DOCS,
+            "transitions": transitions,
+            "load": load_result,
+            "error_served": load_result.get("error_served"),
+        }
+        out["error_served_total"] = load_result.get("error_served", 1)
+        return out
+    finally:
+        _tracing.get_tracer().enabled = _tracer_was
+        if prior_secret is None:
+            os.environ.pop("PATHWAY_DCN_SECRET", None)
+        else:
+            os.environ["PATHWAY_DCN_SECRET"] = prior_secret
+        for leg in ("mesh", "serve"):
+            try:
+                (base / leg / "STOP").touch()
+            except OSError:
+                pass
+        for router in routers:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        for sup in sups:
+            sup.stop()
+        for th in sup_threads:
+            th.join(timeout=30)
+        if writer is not None:
+            writer.terminate()
+            try:
+                writer.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                writer.kill()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _bench_generate_serve(np):
     """Token Loom tier (GEN_r14.json): closed-loop generate load over
     the zipf-tenant population against one generation replica — the
@@ -3598,13 +4001,36 @@ if __name__ == "__main__":
         print(json.dumps(_bench_checkpoint_recovery(_np), indent=2))
     elif sys.argv[1:] == ["serve_chaos"]:
         # standalone tier run; also records the SERVE_rNN.json artifact
+        # (now including the Shard Flux `reshard_live` leg: split 1->3
+        # and merge 3->2 mid-load + the supervised mesh resize)
         import numpy as _np
 
         _serve = _bench_serve_chaos(_np)
+        try:
+            _serve["reshard_live"] = _bench_reshard_live(_np)
+        except Exception as _e:
+            _serve["reshard_live"] = (
+                f"failed: {type(_e).__name__}: {_e}"
+            )
         _doc = {"tier": "serve_chaos", **_serve}
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "SERVE_r13.json"),
+                         "SERVE_r15.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["reshard_live"]:
+        # the Shard Flux leg alone (ISSUE 15 acceptance artifact):
+        # supervised 2->3 mesh resize with zero replay + the serving
+        # plane's live 1->3 split / 3->2 merge under load
+        import numpy as _np
+
+        _rl = _bench_reshard_live(_np)
+        _doc = {"tier": "reshard_live", **_rl}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "SERVE_r15.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

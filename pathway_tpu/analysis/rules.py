@@ -816,6 +816,61 @@ def unrecoverable_state(facts: GraphFacts) -> Iterable[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# 5c. elastic resharding (Shard Flux)
+
+
+@rule("elastic-resharding")
+def elastic_resharding(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """A supervised multi-rank group can resize N→M ranks with zero
+    replay ONLY when every stateful exec snapshots as arrangements
+    (``arranged_state`` — the segment-handoff substrate
+    elastic/mesh.py re-partitions by jk).  Any stateful exec still on
+    the monolithic-pickle path (e.g. the temporal_nodes interval/asof
+    monoliths) pins the WHOLE group's resize to the log-replay
+    fallback: its keyed state cannot be split by key range, so
+    ``GroupSupervisor.resize`` carries it forward un-moved and a grown
+    rank rebuilds it from the log.  WARNING once on the group, INFO
+    naming each pinning exec."""
+    from pathway_tpu.elastic.planner import reshard_capable
+    from pathway_tpu.parallel import exchange_topology
+
+    topo = exchange_topology()
+    if topo["dcn_processes"] <= 1:
+        return  # single-rank: nothing to resize live
+    pinned = [
+        node
+        for node in facts.order
+        if getattr(node, "is_stateful", False)
+        and reshard_capable(node) is False
+    ]
+    if not pinned:
+        return
+    yield Diagnostic(
+        "elastic-resharding",
+        Severity.WARNING,
+        f"this {topo['dcn_processes']}-rank group holds state that "
+        f"cannot ride a key-range segment handoff: {len(pinned)} "
+        "stateful exec(s) snapshot monolithically, so a live resize "
+        "(GroupSupervisor.resize / elastic.mesh.reshard_stores) "
+        "falls back to log replay for them — resize pause grows with "
+        "history instead of moved key ranges",
+        pinned[0],
+        fix_hint="rebase the named execs onto arrangement-backed "
+        "snapshots (arranged_state), or accept log-replay resizes "
+        "for this graph",
+    )
+    for node in pinned:
+        yield Diagnostic(
+            "elastic-resharding",
+            Severity.INFO,
+            f"{type(node).__name__} snapshots monolithically (no "
+            "arranged_state): its keyed state cannot be split by key "
+            "range during an elastic resize",
+            node,
+        )
+
+
+# ---------------------------------------------------------------------------
 # 6. join vectorization
 
 _ROWWISE_JOINS = (IntervalJoinNode, AsofJoinNode, AsofNowJoinNode)

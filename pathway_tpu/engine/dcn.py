@@ -116,6 +116,12 @@ class _DcnRouter:
         return [got[p] for p in sorted(got)]
 
 
+DCN_INNER_KEY = "__dcn_inner__"  # wrapper residual nesting contract —
+DCN_EXTRA_KEY = "__dcn_extra__"  # shared with the elastic resharder
+# (elastic/mesh.py), which must peel and re-wrap these exact keys when
+# it re-partitions a rank's arranged blob for a new topology
+
+
 class _InnerArrangedMixin:
     """Delegates the incremental-snapshot protocol (PR-7 State Ledger)
     to the wrapped inner exec, so DCN-wrapped operators get
@@ -148,8 +154,8 @@ class _InnerArrangedMixin:
         residual, arrs = arranged
         return (
             {
-                "__dcn_inner__": residual,
-                "__dcn_extra__": self._wrapper_residual(),
+                DCN_INNER_KEY: residual,
+                DCN_EXTRA_KEY: self._wrapper_residual(),
             },
             arrs,
         )
@@ -162,14 +168,14 @@ class _InnerArrangedMixin:
         if check is None:
             return True
         return check(
-            residual.get("__dcn_inner__", residual), arrangements
+            residual.get(DCN_INNER_KEY, residual), arrangements
         )
 
     def load_arranged_state(self, residual, arrangements) -> None:
-        if "__dcn_inner__" in residual:
-            self._load_wrapper_residual(residual.get("__dcn_extra__", {}))
+        if DCN_INNER_KEY in residual:
+            self._load_wrapper_residual(residual.get(DCN_EXTRA_KEY, {}))
             self.inner.load_arranged_state(
-                residual["__dcn_inner__"], arrangements
+                residual[DCN_INNER_KEY], arrangements
             )
         else:
             # a snapshot written single-process then restored under DCN

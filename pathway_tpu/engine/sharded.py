@@ -331,20 +331,44 @@ class _ShardedExec(NodeExec):
 
     def check_arranged_state(self, residual, arrangements) -> bool:
         """Pre-mutation restore validation (persistence glue calls this
-        before ANY exec mutates): a snapshot taken under a different
-        shard count cannot restore — the per-shard key partition no
-        longer matches — so recovery must fall back to log replay over
-        pristine fresh state instead of loading a mis-partitioned
-        subset."""
+        before ANY exec mutates).  A snapshot taken under a DIFFERENT
+        shard count no longer forces the log-replay fallback: Shard
+        Flux re-partitions the per-shard arrangements by the new
+        jk-hash ownership at load time (elastic/planner.py), so a
+        PATHWAY_ENGINE_SHARDS change restores with zero replay."""
         shards = residual.get("__shard_residuals__")
-        return isinstance(shards, list) and len(shards) == len(self.shards)
+        return isinstance(shards, list) and len(shards) >= 1
 
     def load_arranged_state(self, residual, arrangements) -> None:
         residuals = residual["__shard_residuals__"]
-        per: list[dict] = [{} for _ in self.shards]
+        per: list[dict] = [{} for _ in residuals]
         for key, arr in arrangements.items():
             shard, _, name = key.partition(".")
             per[int(shard[1:])][name] = arr
+        if len(residuals) != len(self.shards):
+            # elastic restore (Shard Flux): the snapshot's N-shard
+            # partition re-splits to this run's M shards by the same
+            # jk-hash ownership the router uses — state moves, the log
+            # does not replay
+            from pathway_tpu.elastic.planner import (
+                repartition_shard_states,
+            )
+
+            n_old = len(residuals)
+            residuals, per, stats = repartition_shard_states(
+                residuals, per, len(self.shards)
+            )
+            import logging
+
+            logging.getLogger("pathway_tpu").info(
+                "elastic restore: re-partitioned %d-shard snapshot to "
+                "%d shards (%d rows, %d moved) for %s",
+                n_old,
+                len(self.shards),
+                stats["total_rows"],
+                stats["moved_rows"],
+                type(self).__name__,
+            )
         for ex, res, shard_arrs in zip(self.shards, residuals, per):
             ex.load_arranged_state(res, shard_arrs)
 

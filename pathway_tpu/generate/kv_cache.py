@@ -119,6 +119,15 @@ def _row_key(*parts: Any) -> int:
     return int.from_bytes(h, "little")
 
 
+def seq_jk(seq_id: int) -> int:
+    """A sequence's ledger join key — the jk every row the sequence
+    owns (pages + metadata) groups under, and therefore the ownership
+    hash the elastic resharder routes the sequence by
+    (elastic/kv.py ``seq_owner``): one agreed fact, like every other
+    plane's jk."""
+    return _row_key("s", seq_id)
+
+
 class KvLedger:
     """Arrangement mirror of the in-flight generation state."""
 

@@ -144,6 +144,7 @@ class GenerationRequest:
         deadline: float,
         max_new_tokens: int,
         tenant: str | None = None,
+        tenant_class: str | None = None,
         temperature: float = 0.0,
         top_k: int = 40,
         seed: int = 0,
@@ -156,6 +157,7 @@ class GenerationRequest:
         self.order = self.deadline  # MicroBatcher heap key (plain EDF)
         self.max_new_tokens = int(max_new_tokens)
         self.tenant = tenant
+        self.tenant_class = tenant_class
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = int(seed)
@@ -292,6 +294,7 @@ class DecodeScheduler:
         qos: QoSConfig | None = None,
         replica_label: str = "0",
         restore: bool = True,
+        ledger: Any = None,
     ):
         self.config = config or GenerateConfig.from_env()
         # PATHWAY_SERVING_* overrides apply (deadline budget/clamp,
@@ -355,15 +358,22 @@ class DecodeScheduler:
         )
         if restore and self.config.store_root:
             self._restore(self.config.store_root)
+        # Tenant Weave past the admission gate (ROADMAP gen (f)): with
+        # a tenant ledger attached, every submitted generation carries
+        # the ledger's WFQ virtual-finish tag and the batcher's heap
+        # orders by (vfinish, deadline) — a hot tenant's decode backlog
+        # drains BEHIND the tail's fresh requests, extending weighted
+        # fairness from admission into decode batching.  None keeps the
+        # plain-EDF plane byte-identical.
+        self.tenant_ledger = ledger
         self.batcher = MicroBatcher(
             self.qos,
             dispatch=self._dispatch,
             reject=self._reject,
             capacity=self._slots_free,
             name=f"pw-generate-{self.label}",
-            # requests carry their own heap key (plain EDF today; the
-            # Tenant-Weave WFQ hook stamps a (vfinish, deadline) tag
-            # here when the generate plane goes tenant-aware)
+            # requests carry their own heap key: plain EDF (deadline),
+            # or the ledger-stamped (vfinish, deadline) WFQ tag
             order=lambda r: r.order,
         )
         self._thread = threading.Thread(
@@ -405,12 +415,25 @@ class DecodeScheduler:
         # bound could never fire and a burst would grow the heap (and
         # its per-request waiters) until every entry 504'd at flush
         backlog += len(self.batcher)
+        ledger = self.tenant_ledger
+        tag = None
+        if ledger is not None:
+            # may shed 429 tenant_rate: fairness holds at the decode
+            # door too, not just the HTTP admission gate
+            tag = ledger.admit(req.tenant, req.tenant_class)
+            req.order = (tag, req.deadline)
         if backlog >= self.qos.max_queue:
+            if ledger is not None:
+                # never entered the queue: give the fair-share token
+                # (and, when possible, the WFQ clock advance) back
+                ledger.refund(req.tenant, req.tenant_class, tag)
             self._m_requests.labels(self.label, "shed_queue").inc()
             raise ShedError(
                 429, "generation queue full", 0.5
             )
         self.batcher.put(req)
+        if ledger is not None:
+            ledger.commit(req.tenant)
 
     def _slots_free(self) -> int:
         # dispatch capacity for the batcher: free active-set slots
@@ -426,6 +449,12 @@ class DecodeScheduler:
     def _dispatch(self, reqs: list) -> None:
         # batcher flush thread: sequences JOIN BETWEEN steps — stage
         # them and let the decode loop fold them in at its boundary
+        if self.tenant_ledger is not None:
+            for r in reqs:
+                # advance WFQ virtual time at dispatch (same contract
+                # as the gate): later arrivals floor here, so an idle
+                # tenant cannot bank virtual credit
+                self.tenant_ledger.note_dispatched(r.order)
         with self._lock:
             self._staged.extend(reqs)
             self._cond.notify()

@@ -67,6 +67,10 @@ def attach_generate(
         scheduler = DecodeScheduler(
             GenerateConfig.from_env(),
             replica_label=str(server.replica_id),
+            # Tenant Weave: the replica's ledger (PATHWAY_TENANT_QOS=1)
+            # extends WFQ fairness past the admission gate into decode
+            # batching — the batcher orders by (vfinish, deadline)
+            ledger=getattr(server, "tenant_ledger", None),
         )
     server.generate_scheduler = scheduler
     server.extra_post_routes[route] = _handle_generate
@@ -245,6 +249,7 @@ async def _handle_generate(http: Any, request: Any):
             deadline=deadline,
             max_new_tokens=max_tokens,
             tenant=request.headers.get("x-pathway-tenant"),
+            tenant_class=request.headers.get("x-pathway-tenant-class"),
             temperature=temperature,
             top_k=top_k,
             seed=seed,

@@ -330,22 +330,80 @@ class DeltaStreamServer:
 
     # --- writer-side API --------------------------------------------------
 
-    def _split_shards(self, batches: list) -> list[list]:
+    def _split_shards(
+        self, batches: list, n_shards: int | None = None
+    ) -> list[list]:
         """Partition one tick's batches by corpus-key shard ownership
         (jk-hash, engine/sharded.py shard_of).  1-shard planes skip the
         hash entirely."""
-        if self.n_shards == 1:
+        n = self.n_shards if n_shards is None else n_shards
+        if n == 1:
             return [list(batches)]
-        per: list[list] = [[] for _ in range(self.n_shards)]
+        per: list[list] = [[] for _ in range(n)]
         for b in batches:
             if not len(b):
                 continue
-            dest = corpus_shard_of(b.keys, self.n_shards)
-            for s in range(self.n_shards):
+            dest = corpus_shard_of(b.keys, n)
+            for s in range(n):
                 m = dest == s
                 if m.any():
                     per[s].append(b.mask(m))
         return per
+
+    def reshard(self, n_new: int) -> dict:
+        """Shard Flux: republish under a new shard map, live.
+
+        Phase 1 (freeze) happens under the publisher lock: the retained
+        ring's per-tick splits are re-partitioned by the NEW jk-hash
+        map (so a new member's ring replay serves exactly its new key
+        range), the shard count flips, and the incarnation bumps —
+        one atomic commit from the stream's point of view.  Phase 2:
+        every live subscriber is dropped; on redial the suback carries
+        the new ``n_shards`` + incarnation, so the established torn-map
+        guard becomes the TRANSITION guard — members still holding the
+        old map fence themselves (``config_error``, serving stale,
+        never mis-partitioned) until they adopt the new assignment
+        (restart with the new env, or
+        ``ReplicaServer.adopt_shard_map``), while negative-id
+        subscribers (standby/observers, full-corpus) reconnect
+        unaffected.  Returns {old, new, incarnation}."""
+        with self._lock:
+            n_new = max(int(n_new), 1)
+            old = self.n_shards
+            if n_new == old:
+                return {
+                    "old": old,
+                    "new": n_new,
+                    "incarnation": self.incarnation,
+                }
+            self._ring = deque(
+                (
+                    tick,
+                    self._split_shards(
+                        [b for part in per_shard for b in part], n_new
+                    ),
+                )
+                for tick, per_shard in self._ring
+            )
+            self.n_shards = n_new
+            self.incarnation += 1
+            subs = list(self._subs)
+        for sub in subs:
+            self._drop(
+                sub,
+                f"shard map resharded {old} -> {n_new} (redial under "
+                "the new map)",
+            )
+        import logging
+
+        logging.getLogger("pathway_tpu").info(
+            "delta stream: resharded %d -> %d shard(s) under "
+            "incarnation %d",
+            old,
+            n_new,
+            self.incarnation,
+        )
+        return {"old": old, "new": n_new, "incarnation": self.incarnation}
 
     @staticmethod
     def _shard_batches(per_shard: list[list], shard: int) -> list:

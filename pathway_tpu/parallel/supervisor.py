@@ -38,7 +38,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Mapping
+from typing import Any, Callable, Mapping
 
 
 def max_restarts_env() -> int:
@@ -93,6 +93,51 @@ class GroupSupervisor:
         self.last_codes: list[int | None] = []
         self._rng = random.Random(0xF0E1)
         self._stop = threading.Event()
+        # Shard Flux: a pending live resize — (new rank count, reshard
+        # callback) consumed by the run loop at the next poll
+        self._resize: tuple[int, Callable[[], Any] | None] | None = None
+        self._resize_ev = threading.Event()
+
+    def resize(self, m: int, *, reshard: Callable[[], Any] | None = None):
+        """Live elastic resize (Shard Flux): ask a running :meth:`run`
+        loop to grow/shrink the group to ``m`` ranks WITHOUT the
+        log-replay fallback.  The loop terminates the current group at
+        its next poll (phase-1 freeze: DCN groups commit durably every
+        lockstep tick, so the cut is a group-committed state), runs the
+        ``reshard`` callback (the transfer phase — typically
+        ``elastic.mesh.reshard_stores`` moving each arrangement's moved
+        key ranges to their new owners' stores), then respawns ``m``
+        ranks under a BUMPED incarnation (phase-2 commit: zombies of
+        the old topology present a stale incarnation and are fenced by
+        the existing checks).  A reshard callback that RAISES rolls the
+        resize back: the old rank count respawns and the old committed
+        state still rules — bounded pause either way.  The respawn does
+        not consume the restart budget."""
+        self._resize = (int(m), reshard)
+        self._resize_ev.set()
+
+    def _apply_resize(self, incarnation: int) -> int:
+        """Run the transfer phase + commit the new size; returns the
+        next incarnation (always bumped — even a rollback restarts the
+        group, and stale ranks must be fenced)."""
+        m, reshard = self._resize
+        self._resize = None
+        self._resize_ev.clear()
+        old_n = self.n
+        try:
+            if reshard is not None:
+                reshard()
+            self.n = int(m)
+            self._event("group-resize", f"{old_n} -> {self.n} ranks")
+        except Exception as e:
+            # rollback: the old ownership map was never superseded —
+            # respawn at the old size and surface the cause
+            self._event(
+                "resize-rollback",
+                f"reshard {old_n} -> {m} failed ({e}); staying at "
+                f"{old_n} ranks",
+            )
+        return incarnation + 1
 
     def stop(self) -> None:
         """Ask a running :meth:`run` loop (e.g. on another thread — the
@@ -162,6 +207,7 @@ class GroupSupervisor:
         while True:
             procs = self._spawn_group(incarnation)
             failed: int | None = None
+            resized = False
             while True:
                 if self._stop.is_set():
                     self._terminate(procs)
@@ -170,6 +216,16 @@ class GroupSupervisor:
                         "group-stopped", f"incarnation {incarnation}"
                     )
                     return 0
+                if self._resize_ev.is_set():
+                    # phase-1 freeze: stop the group at this poll (each
+                    # lockstep tick is durably committed, so the cut is
+                    # a group-committed state), move state, respawn at
+                    # the new size under a bumped incarnation
+                    self._terminate(procs)
+                    self.last_codes = [p.returncode for p in procs]
+                    incarnation = self._apply_resize(incarnation)
+                    resized = True
+                    break
                 codes = [p.poll() for p in procs]
                 bad = [
                     (i, c) for i, c in enumerate(codes) if c not in (None, 0)
@@ -187,6 +243,8 @@ class GroupSupervisor:
                     self._event("group-done", f"incarnation {incarnation}")
                     return 0
                 time.sleep(self.poll_s)
+            if resized:
+                continue  # respawn at the new size, budget untouched
             self._terminate(procs)
             self.last_codes = [p.returncode for p in procs]
             if self.restarts_used >= self.max_restarts:

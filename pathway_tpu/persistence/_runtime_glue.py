@@ -121,6 +121,19 @@ def resume_point(store: Any) -> dict:
     return out
 
 
+def state_key(gen: int, ident) -> str:
+    """Store key of one node's per-generation state blob — the layout
+    contract shared with every out-of-process reader of a writer's
+    store (replica hydration, elastic/mesh.py resharding)."""
+    return PersistenceDriver._state_key(gen, ident)
+
+
+def segment_key(ident, name: str, epoch: str, seg_id: int) -> str:
+    """Store key of one content-addressed arrangement segment file —
+    same cross-module contract as :func:`state_key`."""
+    return PersistenceDriver._segment_key(ident, name, epoch, seg_id)
+
+
 def effective_persistent_id(node: InputNode, ordinal: int) -> str:
     """Stable id for an input across restarts (reference:
     src/engine/dataflow/persist.rs:37 effective_persistent_id): explicit

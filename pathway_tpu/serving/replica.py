@@ -462,6 +462,81 @@ class ReplicaServer:
         self._m_resyncs.inc()
         return self.hydrate()
 
+    # --- live resharding (Shard Flux) -------------------------------------
+
+    def adopt_shard_map(self, shard: int, n_shards: int) -> None:
+        """Adopt a NEW shard assignment without a process restart — the
+        member-side half of a live reshard.  The old subscription closes
+        (a resharded writer fences it at suback anyway — the transition
+        guard), the resident corpus re-partitions under the new
+        ownership (store-backed members re-hydrate so a MERGE gains its
+        newly-owned foreign keys; store-less members can only narrow),
+        and a fresh subscription opens with the new expectations.  The
+        HTTP plane keeps serving throughout — the router's health poll
+        sees ``ready`` flip false and back as the member catches up."""
+        n_shards = max(int(n_shards), 1)
+        shard = int(shard) if n_shards > 1 else -1
+        if n_shards > 1 and not (0 <= shard < n_shards):
+            raise ValueError(
+                f"replica {self.replica_id}: shard {shard} is outside "
+                f"the {n_shards}-shard assignment map"
+            )
+        old = self._client
+        if old is not None:
+            old.close()
+            self._client = None
+        from_tick = self.hydrated_tick
+        if old is not None:
+            from_tick = max(from_tick, old.applied_tick)
+        prev_shard, prev_n = self.shard, self.n_shards
+        self.shard, self.n_shards = shard, n_shards
+        if self.store_root:
+            # full re-partition: the snapshot holds the whole corpus,
+            # hydrate() filters it to the NEW ownership (mmap — no wire)
+            from_tick = self.hydrate()
+        elif shard >= 0 and (
+            prev_shard < 0
+            or prev_n != n_shards
+            or shard != prev_shard
+        ):
+            # store-less member: can only NARROW what it already holds
+            # — a changed shard INDEX at the same count re-filters too
+            # (serving the old range under the new label would hand
+            # the router healthy-looking wrong answers); a merge that
+            # needs foreign keys requires a store (or a restart
+            # against the resharded writer's full replay)
+            with self._index_lock:
+                self._filter_to_shard(self.index)
+        if self._has_stream:
+            from pathway_tpu.parallel.replicate import DeltaStreamClient
+
+            eps = self.writer_endpoints or [
+                (self.writer_host, int(self.writer_port))
+            ]
+            self._client = DeltaStreamClient(
+                eps[0][0],
+                eps[0][1],
+                self.replica_id,
+                from_tick=from_tick,
+                on_deltas=self._apply_deltas,
+                on_resync=self._resync if self.store_root else None,
+                on_applied=self._on_applied,
+                shard=self.shard,
+                expect_shards=self.n_shards if self.n_shards > 1 else 0,
+                endpoints=eps,
+            )
+            self._client.start()
+        import logging
+
+        logging.getLogger("pathway_tpu").info(
+            "replica %d: adopted shard map %s/%d (was %s/%d)",
+            self.replica_id,
+            shard,
+            n_shards,
+            prev_shard,
+            prev_n,
+        )
+
     def _apply_deltas(self, tick: int, batches: list) -> None:
         with self._index_lock:
             for b in batches:

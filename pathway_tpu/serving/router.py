@@ -305,7 +305,9 @@ class FailoverRouter:
             "router-side in-flight attempts per replica",
             labelnames=("replica",),
         )
+        self._gauge_names: set[str] = set()
         for ep in self.endpoints:
+            self._gauge_names.add(ep.name)
             self._m_inflight.labels(ep.name).set_function(
                 lambda ep=ep: ep.inflight
             )
@@ -427,81 +429,165 @@ class FailoverRouter:
     # --- health -----------------------------------------------------------
 
     async def _health_loop(self) -> None:
+        while True:
+            for ep in list(self.endpoints):
+                await self._poll_one(ep)
+            await asyncio.sleep(self.health_interval_s)
+
+    async def _poll_one(self, ep: ReplicaEndpoint) -> None:
+        """One health probe of one endpoint (the poll loop's body; the
+        shard-map swap primes NEW endpoints through it too)."""
         import aiohttp
 
-        while True:
-            for ep in self.endpoints:
-                try:
-                    async with self._session.get(
-                        ep.url + "/replica/health",
-                        timeout=aiohttp.ClientTimeout(total=1.0),
-                    ) as resp:
-                        h = await resp.json()
-                    ep.alive = True
-                    ep.misses = 0
-                    ep.applied_tick = int(h.get("applied_tick", -1))
-                    s = h.get("staleness_seconds")
-                    ep.staleness_s = None if s is None else float(s)
-                    ep.reported_inflight = int(h.get("inflight", 0))
-                    ep.ready = bool(h.get("ready", False))
-                    # Shard Harbor: a member whose REPORTED ownership
-                    # disagrees with its slot in the map would serve the
-                    # wrong key range with healthy-looking 200s —
-                    # merged top-k silently drops its slot's range (and
-                    # duplicates another's).  The health payload names
-                    # what the member actually owns; trust it over the
-                    # map and refuse to route there.
-                    mismatch = None
-                    try:
-                        rep_shard = int(h.get("shard", -1))
-                        rep_n = int(h.get("n_shards", 0))
-                    except (TypeError, ValueError):
-                        rep_shard, rep_n = -1, 0
-                    if self.n_shards > 1:
-                        if rep_n > 0 and rep_n != self.n_shards:
-                            mismatch = (
-                                f"shard-mismatch: member splits the "
-                                f"corpus {rep_n} way(s), the map has "
-                                f"{self.n_shards}"
-                            )
-                        elif rep_shard >= 0 and rep_shard != ep.shard:
-                            mismatch = (
-                                f"shard-mismatch: member owns shard "
-                                f"{rep_shard}, the map lists it under "
-                                f"shard {ep.shard}"
-                            )
-                    elif rep_n > 1:
-                        # the inverse misconfig: a shard-owning member
-                        # behind a PLAIN replicas-list router would
-                        # answer every routed read from 1/S of the
-                        # corpus with healthy-looking 200s
-                        mismatch = (
-                            f"shard-mismatch: member owns 1/{rep_n} of "
-                            "the corpus but this router is unsharded "
-                            "(use PATHWAY_SERVING_SHARD_MAP)"
-                        )
-                    if mismatch is not None:
-                        ep.ready = False
-                        if not ep.ejected:
-                            self._eject(ep, mismatch)
-                    elif ep.ejected and ep.ready:
-                        # the freshness bound for re-admission: the
-                        # replica reports caught-up again (and, on a
-                        # sharded plane, its ownership matches its slot)
-                        self._readmit(ep)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    ep.misses += 1
-                    ep.alive = False
-                    ep.ready = False
-                    if ep.misses >= self.liveness_misses and not ep.ejected:
-                        self._eject(
-                            ep,
-                            f"liveness: {ep.misses} consecutive health "
-                            "probes failed",
-                        )
-            await asyncio.sleep(self.health_interval_s)
+        try:
+            async with self._session.get(
+                ep.url + "/replica/health",
+                timeout=aiohttp.ClientTimeout(total=1.0),
+            ) as resp:
+                h = await resp.json()
+            ep.alive = True
+            ep.misses = 0
+            ep.applied_tick = int(h.get("applied_tick", -1))
+            s = h.get("staleness_seconds")
+            ep.staleness_s = None if s is None else float(s)
+            ep.reported_inflight = int(h.get("inflight", 0))
+            ep.ready = bool(h.get("ready", False))
+            # Shard Harbor: a member whose REPORTED ownership
+            # disagrees with its slot in the map would serve the
+            # wrong key range with healthy-looking 200s —
+            # merged top-k silently drops its slot's range (and
+            # duplicates another's).  The health payload names
+            # what the member actually owns; trust it over the
+            # map and refuse to route there.
+            mismatch = None
+            try:
+                rep_shard = int(h.get("shard", -1))
+                rep_n = int(h.get("n_shards", 0))
+            except (TypeError, ValueError):
+                rep_shard, rep_n = -1, 0
+            if self.n_shards > 1:
+                if rep_n > 0 and rep_n != self.n_shards:
+                    mismatch = (
+                        f"shard-mismatch: member splits the "
+                        f"corpus {rep_n} way(s), the map has "
+                        f"{self.n_shards}"
+                    )
+                elif rep_shard >= 0 and rep_shard != ep.shard:
+                    mismatch = (
+                        f"shard-mismatch: member owns shard "
+                        f"{rep_shard}, the map lists it under "
+                        f"shard {ep.shard}"
+                    )
+            elif rep_n > 1:
+                # the inverse misconfig: a shard-owning member
+                # behind a PLAIN replicas-list router would
+                # answer every routed read from 1/S of the
+                # corpus with healthy-looking 200s
+                mismatch = (
+                    f"shard-mismatch: member owns 1/{rep_n} of "
+                    "the corpus but this router is unsharded "
+                    "(use PATHWAY_SERVING_SHARD_MAP)"
+                )
+            if mismatch is not None:
+                ep.ready = False
+                if not ep.ejected:
+                    self._eject(ep, mismatch)
+            elif ep.ejected and ep.ready:
+                # the freshness bound for re-admission: the
+                # replica reports caught-up again (and, on a
+                # sharded plane, its ownership matches its slot)
+                self._readmit(ep)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            ep.misses += 1
+            ep.alive = False
+            ep.ready = False
+            if ep.misses >= self.liveness_misses and not ep.ejected:
+                self._eject(
+                    ep,
+                    f"liveness: {ep.misses} consecutive health "
+                    "probes failed",
+                )
+
+    # --- live shard-map swap (Shard Flux) ---------------------------------
+
+    def swap_shard_map(
+        self, shards: list[list[str]], timeout: float = 30.0
+    ) -> None:
+        """Atomically swap the routing topology to a NEW shard map at
+        the reshard commit barrier.  The new map is validated like the
+        boot map; members already routed to (same URL) keep their live
+        health state; brand-new members get one immediate health probe
+        before the swap so the plane does not eat a
+        health-interval-long 503 window.  In-flight requests finish
+        against the map they started on; every request after the swap
+        sees only the new one — there is no in-between state."""
+        validate_shard_map(shards)
+        if not self._started:
+            # boot-time configuration: no loop to defer to
+            self._install_map(shards, [])
+            return
+        self._loop_ready.wait(timeout)
+        loop = self._loop
+        if loop is None:
+            raise RuntimeError("router loop never started")
+        fut = asyncio.run_coroutine_threadsafe(
+            self._swap_async(shards), loop
+        )
+        fut.result(timeout)
+
+    def _install_map(
+        self, shards: list[list[str]], primed: list[ReplicaEndpoint]
+    ) -> None:
+        by_url = {ep.url: ep for ep in self.endpoints}
+        primed_by_url = {ep.url: ep for ep in primed}
+        new_eps: list[ReplicaEndpoint] = []
+        for s, members in enumerate(shards):
+            for i, u in enumerate(members):
+                url = u.rstrip("/")
+                ep = primed_by_url.get(url) or by_url.get(url)
+                if ep is not None:
+                    ep.name = f"s{s}.replica{i}"
+                    ep.shard = s
+                else:
+                    ep = ReplicaEndpoint(f"s{s}.replica{i}", url, shard=s)
+                new_eps.append(ep)
+        with self._lock:
+            self.endpoints = new_eps
+            self.n_shards = len(shards)
+        for ep in new_eps:
+            # (re)bind: set_function REPLACES, so a reused label never
+            # double-reports and reshard churn never accumulates
+            # closures pinning dead ReplicaEndpoint objects
+            self._gauge_names.add(ep.name)
+            self._m_inflight.labels(ep.name).set_function(
+                lambda ep=ep: ep.inflight
+            )
+        live = {ep.name for ep in new_eps}
+        for name in self._gauge_names - live:
+            # retired series report 0 and drop their object reference
+            self._m_inflight.labels(name).set_function(lambda: 0)
+
+    async def _swap_async(self, shards: list[list[str]]) -> None:
+        known = {ep.url for ep in self.endpoints}
+        primed: list[ReplicaEndpoint] = []
+        for s, members in enumerate(shards):
+            for i, u in enumerate(members):
+                url = u.rstrip("/")
+                if url in known:
+                    continue
+                ep = ReplicaEndpoint(f"s{s}.replica{i}", url, shard=s)
+                await self._poll_one(ep)
+                primed.append(ep)
+        self._install_map(shards, primed)
+        import logging
+
+        logging.getLogger("pathway_tpu").info(
+            "router: swapped shard map to %d shard(s) x %s member(s)",
+            len(shards),
+            "/".join(str(len(m)) for m in shards),
+        )
 
     # --- request path -----------------------------------------------------
 

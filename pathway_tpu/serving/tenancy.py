@@ -20,8 +20,10 @@ identity a first-class admission input:
   uses everything; the moment the tail shows up, the hot tenant is
   clamped to ``capacity * w_i / W_active`` and *its* requests shed
   (429 ``tenant_rate``), leaving global tokens for everyone else.
-  ``W_active`` is the weight sum of tenants seen in the last
-  ``ACTIVE_WINDOW_S``; per-tenant state is LRU-bounded
+  ``W_active`` is the exponentially-decayed activity-weighted sum
+  (time constant ``ACTIVE_TAU_S`` / ``PATHWAY_TENANT_ACTIVE_TAU_S``) —
+  it tracks diurnal swings smoothly, with no hard cliff when a tenant
+  crosses an idle boundary; per-tenant state is LRU-bounded
   (``PATHWAY_TENANT_STATE_CAP``) so a million-tenant population costs
   a bounded dict, not a leak.
 
@@ -68,9 +70,34 @@ TENANT_HEADER = "x-pathway-tenant"
 TENANT_CLASS_HEADER = "x-pathway-tenant-class"
 OTHER_LABEL = "__other__"
 
-# seconds a tenant counts toward the active weight sum after its last
-# request — the denominator of the fair-share computation
-ACTIVE_WINDOW_S = 10.0
+# time constant of the exponentially-decayed per-tenant activity that
+# forms the fair-share denominator: a tenant's weight contribution is
+# ``w * exp(-idle/τ)`` — full while it keeps sending, smoothly fading
+# as it goes quiet.  This replaced the fixed 10 s ACTIVE window, whose
+# hard expiry made every other tenant's fair share JUMP the instant a
+# neighbor crossed the boundary (the diurnal-swing cliff: shares
+# doubled at window expiry, then halved when the tenant returned).
+# Override with PATHWAY_TENANT_ACTIVE_TAU_S.
+ACTIVE_TAU_S = 10.0
+# deprecated alias (pre-decay name); the semantics are now a time
+# constant, not a cutoff
+ACTIVE_WINDOW_S = ACTIVE_TAU_S
+_ACTIVE_TAU_ENV = "PATHWAY_TENANT_ACTIVE_TAU_S"
+
+
+def active_tau_s() -> float:
+    raw = os.environ.get(_ACTIVE_TAU_ENV, "")
+    if not raw:
+        return ACTIVE_TAU_S
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{_ACTIVE_TAU_ENV}={raw!r} is not a number"
+        ) from None
+    if not v > 0.0:
+        raise ValueError(f"{_ACTIVE_TAU_ENV} must be > 0")
+    return v
 
 _ENABLED_ENV = "PATHWAY_TENANT_QOS"
 _WEIGHTS_ENV = "PATHWAY_TENANT_WEIGHTS"
@@ -270,8 +297,14 @@ class TenantLedger:
         # 65536 entries under this lock would serialize the whole
         # route's admission behind it on every tail-tenant arrival
         self._tenants: "OrderedDict[str, _TenantState]" = OrderedDict()
+        # exponentially-decayed active weight: W(t) = Σ wᵢ·e^(-(t-sᵢ)/τ)
+        # where sᵢ is tenant i's last-seen instant.  Every term decays
+        # with the SAME τ, so the aggregate decays uniformly — one
+        # multiply per admission keeps it exact, no per-tenant scan,
+        # and no cliff at any window boundary.
+        self._active_tau = active_tau_s()
         self._active_weight = 0.0
-        self._active_pruned_at = 0.0
+        self._active_at = 0.0  # instant _active_weight was last decayed to
         self._vnow = 0.0
         self._admissions = 0  # deterministic counter the Fault Forge
         # flood= directive charges against (see testing/faults.py)
@@ -312,7 +345,26 @@ class TenantLedger:
 
     # --- state ------------------------------------------------------------
 
+    def _decay_to(self, now: float) -> None:
+        """Uniform exponential decay of the active-weight aggregate:
+        every tenant's contribution decays with the same τ, so decaying
+        the SUM is exact.  Monotonic time only moves forward; a caller
+        -injected older ``now`` (tests) is a no-op."""
+        import math
+
+        dt = now - self._active_at
+        if dt > 0.0:
+            self._active_weight *= math.exp(-dt / self._active_tau)
+            self._active_at = now
+
+    def _contribution(self, st: _TenantState, now: float) -> float:
+        import math
+
+        idle = max(now - st.last_seen, 0.0)
+        return st.weight * math.exp(-idle / self._active_tau)
+
     def _state(self, tenant: str, weight: float, now: float) -> _TenantState:
+        self._decay_to(now)
         st = self._tenants.get(tenant)
         if st is None:
             if len(self._tenants) >= self.config.state_cap:
@@ -320,36 +372,22 @@ class TenantLedger:
                 # million-tenant population must not grow this dict
                 # without bound); its bucket restarts full on return
                 _victim, dropped = self._tenants.popitem(last=False)
-                if now - dropped.last_seen <= ACTIVE_WINDOW_S:
-                    self._active_weight = max(
-                        0.0, self._active_weight - dropped.weight
-                    )
+                self._active_weight = max(
+                    0.0,
+                    self._active_weight - self._contribution(dropped, now),
+                )
             st = _TenantState(now, weight, self.config.burst)
             self._tenants[tenant] = st
             self._active_weight += weight
         else:
             self._tenants.move_to_end(tenant)
-            if now - st.last_seen > ACTIVE_WINDOW_S:
-                # re-activation: the old weight has left (or will leave
-                # at the next prune's full recompute) the active sum —
-                # add the CURRENT weight once, never both adjustments
-                self._active_weight += weight
-            elif st.weight != weight:
-                self._active_weight += weight - st.weight
+            # refresh: replace the tenant's decayed contribution with
+            # its full (possibly re-classed) weight — smooth at every
+            # idle duration, no boundary to jump at
+            self._active_weight += weight - self._contribution(st, now)
             st.weight = weight
             st.last_seen = now
-        self._prune_active(now)
         return st
-
-    def _prune_active(self, now: float) -> None:
-        if now - self._active_pruned_at < 1.0:
-            return
-        self._active_pruned_at = now
-        active = 0.0
-        for st in self._tenants.values():
-            if now - st.last_seen <= ACTIVE_WINDOW_S:
-                active += st.weight
-        self._active_weight = active
 
     def fair_rate(self, weight: float) -> float | None:
         """This tenant's admitted-rate clamp (requests/s), or None when
@@ -529,8 +567,14 @@ class TenantLedger:
         with self._lock:
             return len(self._tenants)
 
-    def active_weight(self) -> float:
+    def active_weight(self, now: float | None = None) -> float:
+        """The decayed fair-share denominator as of ``now`` (default:
+        the monotonic clock) — tests inject times to pin the no-cliff
+        contract."""
         with self._lock:
+            if now is None:
+                now = time.monotonic()
+            self._decay_to(now)
             return self._active_weight
 
 

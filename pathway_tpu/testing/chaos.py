@@ -62,8 +62,27 @@ REPL_WRITER_SCRIPT = textwrap.dedent(
     pw.io.null.write(res)
 
     def watch():
+        # Shard Flux: a RESHARD file holding an int resplits the delta
+        # publisher's shard map live (harness-scriptable — the writer
+        # subprocess has no other control channel); consumed once per
+        # content change.
+        reshard_file = base / "RESHARD"
+        last_reshard = None
         while not stop_file.exists():
             time.sleep(0.1)
+            if reshard_file.exists():
+                try:
+                    want = int(reshard_file.read_text().strip())
+                except (ValueError, OSError):
+                    continue
+                if want != last_reshard:
+                    from pathway_tpu.parallel import replicate
+                    pub = replicate.publisher()
+                    if pub is not None:
+                        res = pub.reshard(want)
+                        last_reshard = want
+                        print("WRITER-RESHARDED %s" % json.dumps(res),
+                              flush=True)
         rt = pw.internals.parse_graph.G.runtime
         if rt is not None:
             rt.stop()
@@ -77,6 +96,104 @@ REPL_WRITER_SCRIPT = textwrap.dedent(
     print("WRITER-CLEAN-EXIT", flush=True)
     """
 )
+
+
+# Shard Flux mesh-resize worker, shared by tests/test_elastic.py and
+# the `bench.py reshard_live` tier: a supervised jsonlines→groupby rank
+# with a per-rank input dir + per-rank store, per-tick snapshots (so a
+# resize cut is always snapshot-covered once input quiesces), and a
+# REPLAYED line on exit — the zero-replay evidence the resize
+# acceptance reads.  Env contract: PW_TEST_DIR (holds in<pid>/ dirs; a
+# STOP file ends the run), plus the supervisor's PATHWAY_PROCESS_ID /
+# PATHWAY_MESH_INCARNATION.
+RESHARD_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, json, signal, threading, time, pathlib
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pid = int(os.environ["PATHWAY_PROCESS_ID"])
+    inc = int(os.environ.get("PATHWAY_MESH_INCARNATION", "0"))
+    base = pathlib.Path(os.environ["PW_TEST_DIR"])
+    in_dir = base / f"in{pid}"
+    pdir = base / f"pstorage{pid}"
+    out_file = base / f"out{pid}_inc{inc}.jsonl"
+    stop_file = base / "STOP"
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(str(in_dir), schema=S, mode="streaming")
+    r = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    pw.io.jsonlines.write(r, str(out_file))
+
+    def _stop(*_a):
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+
+    # phase-1 freeze: the supervisor's resize SIGTERM is a GRACEFUL
+    # stop — the run ends at a tick boundary and the final commit
+    # snapshots, so the handoff cut covers the whole durable log
+    # (zero-replay resize)
+    signal.signal(signal.SIGTERM, _stop)
+
+    def watch():
+        while True:
+            time.sleep(0.05)
+            if stop_file.exists():
+                _stop()
+                return
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(pdir)),
+        snapshot_every=1,
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+    drv = pw.internals.parse_graph.G.last_runtime.persistence_driver
+    print("REPLAYED %d" % drv.replayed_events, flush=True)
+    print("CLEAN-EXIT", flush=True)
+    """
+)
+
+
+def wait_snapshot_covered(roots, timeout_s: float = 90.0) -> bool:
+    """Wait until every store in ``roots`` holds a committed operator
+    -state generation that covers its whole durable log (state time ==
+    last_time, live chunk list empty) — the quiesced group-safe cut a
+    zero-replay resize starts from."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        ok = 0
+        for root in roots:
+            try:
+                meta = _json.load(
+                    open(_os.path.join(str(root), "metadata.json"))
+                )
+            except (OSError, ValueError):
+                break
+            state = meta.get("state")
+            covered = (
+                state is not None
+                and int(state.get("time", -1))
+                >= int(meta.get("last_time", 0))
+                and not any(
+                    v for v in meta.get("live_chunks", {}).values()
+                )
+            )
+            if not covered:
+                break
+            ok += 1
+        if ok == len(roots):
+            return True
+        _time.sleep(0.25)
+    return False
 
 
 def free_dcn_port(n: int = 2) -> int:

@@ -33,6 +33,17 @@ Directives:
     is targeted with the wire directives below via its channel prefix
     (``ch:repl`` — e.g. ``delay=ch:repl,nth:3,ms:200``; the
     writer→standby leg alone via ``ch:repl:standby``).
+``kill=ferry:<N>[,inc:<I>]``
+    Shard Flux handoff kill: ``os._exit(FAULT_EXIT)`` after the
+    SegmentFerry has sent (and had acknowledged) its N-th segment —
+    the deterministic counter is the sender's per-process transferred
+    -segment count, so a chaos leg lands the death at the same point
+    of the handoff every run, always BEFORE the transfer's commit
+    frame (the two-phase barrier must roll back cleanly: the old
+    ownership map stays committed, the staged segments resume
+    content-addressed).  ``at:`` is rejected (the transfer counter is
+    the clock); incarnation-gated like every kill, so a supervised
+    retry of the handoff runs fault-free by default.
 ``kill=writer:1[,tick:<T>][,inc:<I>]``
     Writer-scoped kill (Shard Harbor, symmetric with ``kill=replica``):
     ``os._exit(FAULT_EXIT)`` on the replication WRITER when it has
@@ -213,6 +224,17 @@ class FaultPlan:
                             "scoped kills (the decode-step counter is "
                             "the clock)"
                         )
+                elif args.get("ferry") is not None:
+                    # ferry-scoped kill: counts the SegmentFerry's
+                    # acknowledged segment transfers; `at` is
+                    # meaningless (the transfer counter is the clock)
+                    d.arg_int("ferry")
+                    if args.get("at") is not None:
+                        raise FaultSpecError(
+                            "kill: `at` does not apply to ferry-"
+                            "scoped kills (the segment-transfer "
+                            "counter is the clock)"
+                        )
                 elif args.get("writer") is not None:
                     # writer-scoped kill: counts distinct PUBLISHED
                     # delta ticks; `at` is meaningless (the publish
@@ -291,10 +313,11 @@ class FaultPlan:
                 d.args.get("replica") is not None
                 or d.args.get("writer") is not None
                 or d.args.get("decode") is not None
+                or d.args.get("ferry") is not None
             ):
-                continue  # replica-/writer-/decode-scoped kills fire in
-                # their own hooks (on_replica_tick / on_writer_tick /
-                # on_decode_step)
+                continue  # replica-/writer-/decode-/ferry-scoped kills
+                # fire in their own hooks (on_replica_tick /
+                # on_writer_tick / on_decode_step / on_ferry_segment)
             if not d.matches_process(self.pid, self.incarnation):
                 continue
             if d.args.get("at", "head") != phase:
@@ -356,6 +379,24 @@ class FaultPlan:
             if n_steps >= (d.arg_int("decode") or 1):
                 d.fired += 1
                 self._exit(f"kill after decode step {n_steps}")
+
+    def on_ferry_segment(self, n_sent: int) -> None:
+        """Called by the SegmentFerry sender (elastic/ferry.py) after
+        each ACKNOWLEDGED segment transfer; ``n_sent`` is the
+        deterministic per-transfer counter ``kill=ferry:N`` fires on —
+        the chaos clock for mid-handoff deaths (always before the
+        transfer's commit frame, so the two-phase barrier rolls
+        back)."""
+        for d in self.directives:
+            if d.name != "kill" or d.fired:
+                continue
+            if d.args.get("ferry") is None:
+                continue
+            if not d.matches_process(self.pid, self.incarnation):
+                continue
+            if n_sent >= (d.arg_int("ferry") or 1):
+                d.fired += 1
+                self._exit(f"kill after ferry segment {n_sent}")
 
     def flood_charges(
         self, admission_n: int

@@ -1,0 +1,815 @@
+"""Shard Flux (pathway_tpu/elastic/): live elastic resharding.
+
+Covers: the reshard planner's hash-ring delta (minimal moves,
+conservation), the N→M→N randomized property (resharded folded output
+bit-equal to the uninterrupted run — inserts, retracts, updates, ties,
+mid-transfer deletions), the SegmentFerry (authenticated round-trip,
+content-addressed resume, auth rejection, per-segment MAC), the
+two-phase handover barrier (commit/rollback/incarnation fencing), the
+mesh-plane store re-partition (1→2 split of a real persisted run), the
+serving plane's live writer reshard + transition guard + router map
+swap, the generation plane's KV split, the ``kill=ferry:N`` Fault Forge
+directive (slow: real subprocess SIGKILL mid-ferry, barrier rolls
+back), and the ``elastic-resharding`` Graph Doctor rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw  # noqa: F401  (conftest clears its graph)
+from pathway_tpu.elastic import handover as ho
+from pathway_tpu.elastic import planner
+from pathway_tpu.elastic.ferry import FerryReceiver, FerryError, ferry_files
+from pathway_tpu.engine.batch import DiffBatch
+from pathway_tpu.engine.nodes import GroupByNode, InputNode
+from pathway_tpu.engine.reducers import ReducerSpec
+from pathway_tpu.engine.runtime import StaticSource
+from pathway_tpu.engine.sharded import ShardedGroupByExec, shard_of
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# --- planner ---------------------------------------------------------------
+
+
+def test_plan_identity_moves_nothing():
+    p = planner.plan_reshard(3, 3)
+    assert p.moved_slots == 0 and p.moves == ()
+
+
+@pytest.mark.parametrize("n_old,n_new", [(2, 3), (3, 2), (1, 3), (4, 5)])
+def test_plan_moves_exactly_the_differing_slots(n_old, n_new):
+    p = planner.plan_reshard(n_old, n_new)
+    old = planner.slot_owners(n_old)
+    new = planner.slot_owners(n_new)
+    differing = int((old != new).sum())
+    assert p.moved_slots == differing
+    # conservation + correctness of every (src, dst) bucket
+    for m in p.moves:
+        assert m.src != m.dst
+        mask = (old == m.src) & (new == m.dst)
+        assert m.n_slots == int(mask.sum())
+    # a grow never moves more than everything; 1→M moves (M-1)/M
+    assert 0 < p.moved_fraction <= 1.0
+    if n_old == 1:
+        assert p.moved_fraction == pytest.approx(
+            (n_new - 1) / n_new, abs=1e-3
+        )
+
+
+def test_split_arrangement_routes_by_jk_owner():
+    from pathway_tpu.engine.arrangement import Arrangement
+
+    rng = np.random.default_rng(7)
+    jks = rng.integers(0, 2**63, size=500, dtype=np.uint64)
+    arr = Arrangement(1)
+    arr.append(
+        jks,
+        jks,
+        np.ones(500, np.int64),
+        [np.arange(500).astype(object)],
+    )
+    parts = planner.split_arrangement(arr, 3)
+    total = 0
+    for s, part in enumerate(parts):
+        rows = part.entries()
+        total += len(rows)
+        if len(rows):
+            assert (
+                shard_of(np.asarray(rows.jk, np.uint64), 3) == s
+            ).all()
+    assert total == len(arr.entries())
+
+
+# --- the N→M→N property (satellite: randomized bit-equality) ---------------
+
+
+def _gb_node():
+    gin = InputNode(StaticSource(["k", "v"]), ["k", "v"])
+    return GroupByNode(
+        gin,
+        ["k"],
+        {
+            "cnt": ReducerSpec(kind="count", arg_cols=()),
+            "s": ReducerSpec(kind="sum", arg_cols=("v",)),
+        },
+    )
+
+
+def _sharded(node, n):
+    ex = ShardedGroupByExec(node, SimpleNamespace(shape={"data": n}), "data")
+    ex.enable_state_ledger()
+    return ex
+
+
+def _fold(rows):
+    """Fold an emitted diff stream into current state per row key —
+    the bit-equality surface (insert overwrites, matching retraction
+    removes)."""
+    state: dict = {}
+    for key, diff, vals in rows:
+        if diff > 0:
+            state[key] = vals
+        elif state.get(key) == vals:
+            del state[key]
+    return state
+
+
+def _random_phases(seed: int, n_phases: int = 3):
+    """Random insert/retract/update traffic with ties and deletions;
+    retractions always match a live row (engine contract)."""
+    rng = np.random.default_rng(seed)
+    live: list[tuple[int, tuple]] = []
+    next_key = 1
+    phases = []
+    for _p in range(n_phases):
+        events = []
+        for _ in range(rng.integers(30, 60)):
+            op = rng.random()
+            if op < 0.6 or not live:
+                k = next_key
+                next_key += 1
+                # heavy key-collision pressure: few distinct groups +
+                # tied values
+                row = (f"g{int(rng.integers(0, 9))}", int(rng.integers(0, 4)))
+                live.append((k, row))
+                events.append((k, 1, row))
+            elif op < 0.8:
+                i = int(rng.integers(0, len(live)))
+                k, row = live.pop(i)
+                events.append((k, -1, row))  # deletion (incl. mid-transfer)
+            else:
+                i = int(rng.integers(0, len(live)))
+                k, row = live[i]
+                new_row = (row[0], int(rng.integers(0, 4)))
+                live[i] = (k, new_row)
+                events.append((k, -1, row))
+                events.append((k, 1, new_row))
+        phases.append(events)
+    return phases
+
+
+def _feed(ex, t, events):
+    out = []
+    for b in ex.process(t, [[DiffBatch.from_rows(events, ["k", "v"])]]):
+        out.extend(b.iter_rows())
+    return out
+
+
+def _handoff(ex_src, node, n_new):
+    """snapshot → elastic re-partition → load into a fresh N_new-shard
+    exec (exactly the restore path engine/sharded.py takes when
+    PATHWAY_ENGINE_SHARDS changed between runs)."""
+    arranged = ex_src.arranged_state()
+    assert arranged is not None
+    residual, arrs = arranged
+    ex_dst = _sharded(node, n_new)
+    assert ex_dst.check_arranged_state(residual, arrs)
+    ex_dst.load_arranged_state(residual, arrs)
+    return ex_dst
+
+
+@pytest.mark.parametrize("n,m", [(2, 3), (3, 2), (1, 4)])
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_reshard_n_m_n_bit_equal_to_uninterrupted(n, m, seed):
+    phases = _random_phases(seed)
+    node = _gb_node()
+
+    # uninterrupted reference: one N-shard exec sees all phases
+    ref = _sharded(node, n)
+    ref_out = []
+    for t, events in enumerate(phases):
+        ref_out.extend(_feed(ref, t, events))
+
+    # subject: N → (handoff) → M → (handoff) → N mid-run
+    subj_out = []
+    ex = _sharded(node, n)
+    subj_out.extend(_feed(ex, 0, phases[0]))
+    ex = _handoff(ex, node, m)  # grow/shrink 1
+    subj_out.extend(_feed(ex, 1, phases[1]))
+    ex = _handoff(ex, node, n)  # and back
+    subj_out.extend(_feed(ex, 2, phases[2]))
+
+    assert _fold(subj_out) == _fold(ref_out)
+    # per-shard ownership is disjoint and matches the hash partition
+    owned = ex.shard_group_keys()
+    for s, keys in enumerate(owned):
+        if keys:
+            arr = np.asarray(sorted(keys), dtype=np.uint64)
+            assert (shard_of(arr, n) == s).all()
+
+
+def test_same_count_snapshot_unchanged_path():
+    """N→N restore must not take the elastic branch (the established
+    path stays byte-identical)."""
+    node = _gb_node()
+    ex = _sharded(node, 2)
+    _feed(ex, 0, [(1, 1, ("a", 1)), (2, 1, ("b", 2))])
+    residual, arrs = ex.arranged_state()
+    ex2 = _sharded(node, 2)
+    assert ex2.check_arranged_state(residual, arrs)
+    ex2.load_arranged_state(residual, arrs)
+    assert ex2.shard_group_keys() == ex.shard_group_keys()
+
+
+# --- SegmentFerry ----------------------------------------------------------
+
+
+@pytest.fixture()
+def job_secret(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "elastic-test-secret")
+    yield "elastic-test-secret"
+
+
+def test_ferry_roundtrip_places_files(tmp_path, job_secret):
+    recv = FerryReceiver(str(tmp_path / "dst"))
+    try:
+        files = [
+            ("segments/a/0.seg", b"alpha" * 100),
+            ("segments/b/1.seg", b"beta" * 50),
+            ("manifest.json", b'{"v":1}'),
+        ]
+        stats = ferry_files(
+            recv.host, recv.port, files, transfer_id="t1"
+        )
+        assert stats["committed"] and stats["segments_sent"] == 3
+        assert stats["segments_resumed"] == 0
+        for name, blob in files:
+            assert (tmp_path / "dst" / name).read_bytes() == blob
+        assert "t1" in recv.received
+    finally:
+        recv.close()
+
+
+def test_ferry_resume_ships_only_missing(tmp_path, job_secret):
+    recv = FerryReceiver(str(tmp_path / "dst"))
+    try:
+        files = [(f"f{i}", bytes([i]) * 64) for i in range(4)]
+        # first attempt stages everything but never commits (a torn
+        # transfer: the sender died before the commit frame)
+        s1 = ferry_files(
+            recv.host, recv.port, files, transfer_id="t2", commit=False
+        )
+        assert s1["segments_sent"] == 4 and not s1["committed"]
+        assert not recv.received  # nothing placed: rollback-able
+        # retry resumes content-addressed: zero re-sent bytes
+        s2 = ferry_files(recv.host, recv.port, files, transfer_id="t2")
+        assert s2["segments_sent"] == 0
+        assert s2["segments_resumed"] == 4
+        assert s2["committed"]
+        for name, blob in files:
+            assert (tmp_path / "dst" / name).read_bytes() == blob
+    finally:
+        recv.close()
+
+
+def test_ferry_rejects_wrong_secret(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "secret-A")
+    recv = FerryReceiver(str(tmp_path / "dst"))
+    try:
+        monkeypatch.setenv("PATHWAY_DCN_SECRET", "secret-B")
+        with pytest.raises(FerryError, match="authentication"):
+            ferry_files(
+                recv.host, recv.port, [("x", b"y")], transfer_id="t3"
+            )
+        assert not (tmp_path / "dst" / "x").exists()
+    finally:
+        recv.close()
+
+
+def test_ferry_abort_discards_staging(tmp_path, job_secret):
+    recv = FerryReceiver(str(tmp_path / "dst"))
+    try:
+        ferry_files(
+            recv.host,
+            recv.port,
+            [("f", b"data")],
+            transfer_id="t4",
+            commit=False,
+        )
+        assert recv.staged("t4")
+        recv.abort("t4")
+        assert not recv.staged("t4")
+    finally:
+        recv.close()
+
+
+# --- two-phase handover ----------------------------------------------------
+
+
+def test_handover_commit_and_rollback(tmp_path):
+    h = ho.TwoPhaseHandover(str(tmp_path))
+    assert h.committed is None
+    cur = h.ensure_committed(2)
+    assert cur == ho.OwnershipMap(2, 0)
+    nxt = h.begin(3)
+    assert nxt.n_shards == 3 and nxt.incarnation == 1
+    # the committed map is UNCHANGED while in transition (a crash here
+    # leaves the old topology in force)
+    assert h.committed == ho.OwnershipMap(2, 0)
+    assert h.in_transition
+    with pytest.raises(ho.HandoverError):
+        h.begin(4)  # one transition at a time
+    h.rollback()
+    assert h.committed == ho.OwnershipMap(2, 0)
+    assert not h.in_transition
+    h.begin(3)
+    done = h.commit()
+    assert done == ho.OwnershipMap(3, 1)
+    assert h.committed == ho.OwnershipMap(3, 1)
+    # incarnations are monotone across reshardings (zombie fencing)
+    h.begin(5)
+    assert h.commit().incarnation == 2
+
+
+# --- mesh plane: store re-partition ---------------------------------------
+
+
+def _run_persisted_wordcount(base: pathlib.Path, words: list[str]):
+    """One single-process streaming run with snapshots — produces the
+    per-rank store layout reshard_stores consumes."""
+    import pathway_tpu as pw
+
+    (base / "in").mkdir(parents=True, exist_ok=True)
+    with open(base / "in" / "w.jsonl", "w") as f:
+        for w in words:
+            f.write(json.dumps({"word": w}) + "\n")
+    out_file = base / "out.jsonl"
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(
+        str(base / "in"), schema=S, mode="streaming"
+    )
+    r = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    pw.io.jsonlines.write(r, str(out_file))
+
+    def watch():
+        deadline = time.monotonic() + 60
+        want = len(set(words))
+        while time.monotonic() < deadline:
+            try:
+                got = {
+                    json.loads(line)["word"]
+                    for line in open(out_file)
+                    if line.strip()
+                }
+            except OSError:
+                got = set()
+            if len(got) >= want:
+                break
+            time.sleep(0.05)
+        rt = pw.internals.parse_graph.G.runtime
+        if rt is not None:
+            rt.stop()
+
+    threading.Thread(target=watch, daemon=True).start()
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(base / "pstorage")),
+        snapshot_every=1,
+    )
+    pw.run(persistence_config=cfg, autocommit_duration_ms=20)
+
+
+def test_reshard_stores_splits_one_rank_into_two(tmp_path, job_secret):
+    from pathway_tpu.elastic.mesh import reshard_stores
+    from pathway_tpu.persistence.backends import FilesystemStore
+
+    words = [f"w{i % 13}" for i in range(60)]
+    _run_persisted_wordcount(tmp_path, words)
+    src = str(tmp_path / "pstorage")
+    dsts = [str(tmp_path / "new0"), str(tmp_path / "new1")]
+    # rank 0 keeps its own root in place: resize-in-place is the
+    # production shape (new0 here keeps the test readable)
+    stats = reshard_stores([src], dsts, via_wire=True)
+    assert stats["plan"]["n_old"] == 1 and stats["plan"]["n_new"] == 2
+    assert stats["total_rows"] > 0
+    # a 1→2 split moves ~half the key space — and ONLY that
+    assert 0 < stats["moved_rows"] < stats["total_rows"]
+    assert 0 < stats["bytes_ferried"] <= stats["bytes_total_segments"]
+    assert stats["ferry"] and stats["ferry"][0]["committed"]
+    # each new store holds a restorable generation whose arrangement
+    # rows are exactly the jk ranges that rank owns under n=2
+    from pathway_tpu.persistence._runtime_glue import PersistenceDriver
+    from pathway_tpu.persistence.segments import load_arrangement
+
+    import pickle
+
+    seen_jks: list[np.ndarray] = []
+    for p, root in enumerate(dsts):
+        store = FilesystemStore(root)
+        meta = json.loads(store.get("metadata.json").decode())
+        snap = meta["state"]
+        assert snap["gen"] == stats["generation"]
+        for ident, cls in snap["nodes"].items():
+            blob = pickle.loads(
+                store.get(PersistenceDriver._state_key(snap["gen"], ident))
+            )
+            if not (isinstance(blob, dict) and blob.get("__pw_arranged__")):
+                continue
+            for name, man in blob["manifests"].items():
+                arr = load_arrangement(
+                    man,
+                    lambda sid, n=name, e=man["epoch"], i=ident,
+                    s=store: s.get_buffer(
+                        PersistenceDriver._segment_key(i, n, e, sid)
+                    ),
+                )
+                rows = arr.entries()
+                if len(rows):
+                    jks = np.asarray(rows.jk, np.uint64)
+                    assert (shard_of(jks, 2) == p).all()
+                    seen_jks.append(jks)
+    assert seen_jks, "no arranged state landed in the new stores"
+
+
+def test_reshard_stores_refuses_uncovered_tail_on_shrink(tmp_path):
+    from pathway_tpu.elastic.handover import HandoverError
+    from pathway_tpu.elastic.mesh import reshard_stores
+    from pathway_tpu.persistence.backends import FilesystemStore
+
+    # two synthetic stores; rank 1 (to be retired) has a log tail newer
+    # than its snapshot
+    for r in range(2):
+        st = FilesystemStore(str(tmp_path / f"p{r}"))
+        st.put(
+            "metadata.json",
+            json.dumps(
+                {
+                    "last_time": 9 if r == 1 else 5,
+                    "chunks": {},
+                    "live_chunks": {"input-0": [3]} if r == 1 else {},
+                    "state": {
+                        "gen": 1,
+                        "time": 5,
+                        "nodes": {},
+                        "segment_keys": [],
+                    },
+                }
+            ).encode(),
+        )
+    with pytest.raises(HandoverError, match="retires"):
+        reshard_stores(
+            [str(tmp_path / "p0"), str(tmp_path / "p1")],
+            [str(tmp_path / "n0")],
+            via_wire=False,
+        )
+
+
+# --- serving plane: live writer reshard + router swap ----------------------
+
+
+def test_delta_stream_reshard_fences_old_map_and_serves_new(
+    tmp_path, job_secret
+):
+    from pathway_tpu.parallel.replicate import (
+        DeltaStreamClient,
+        DeltaStreamServer,
+    )
+
+    srv = DeltaStreamServer(0, ring_ticks=64, n_shards=1)
+    applied: dict[int, list] = {1: [], 2: []}
+    try:
+        keys = np.arange(1, 33, dtype=np.uint64)
+        b = DiffBatch(
+            keys,
+            np.ones(len(keys), np.int64),
+            {"v": np.arange(len(keys)).astype(object)},
+        )
+        srv.publish(0, [b])
+
+        # an unsharded subscriber on the OLD map
+        old_client = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            1,
+            from_tick=-1,
+            on_deltas=lambda t, bs: applied[1].append((t, bs)),
+        )
+        old_client.start()
+        deadline = time.monotonic() + 20
+        while not applied[1] and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert applied[1], "old-map subscriber never caught up"
+
+        res = srv.reshard(3)
+        assert res == {"old": 1, "new": 3, "incarnation": 1}
+        # transition guard: the old-map subscriber redials, sees the
+        # new shard count in the suback, and fences itself with a
+        # sticky config_error instead of mis-applying
+        deadline = time.monotonic() + 20
+        while old_client.config_error is None and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert old_client.config_error is not None
+        assert "3 shard(s)" in old_client.config_error
+
+        # a member on the NEW map receives exactly its key range —
+        # including the re-split ring replay of tick 0
+        new_client = DeltaStreamClient(
+            "127.0.0.1",
+            srv.port,
+            2,
+            from_tick=-1,
+            on_deltas=lambda t, bs: applied[2].append((t, bs)),
+            shard=1,
+            expect_shards=3,
+        )
+        new_client.start()
+        deadline = time.monotonic() + 20
+        while not any(
+            bs for _t, bs in applied[2]
+        ) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        got_keys = [
+            int(k)
+            for _t, bs in applied[2]
+            for bb in bs
+            for k, _d, _v in bb.iter_rows()
+        ]
+        assert got_keys, "new-map subscriber got no ring replay"
+        assert (
+            shard_of(np.asarray(got_keys, np.uint64), 3) == 1
+        ).all()
+        old_client.close()
+        new_client.close()
+    finally:
+        srv.close()
+
+
+def test_router_swap_shard_map_before_start(job_secret):
+    from pathway_tpu.serving.router import FailoverRouter
+
+    r = FailoverRouter(["http://127.0.0.1:1"])
+    assert r.n_shards == 1
+    r.swap_shard_map(
+        [["http://127.0.0.1:1"], ["http://127.0.0.1:2"]]
+    )
+    assert r.n_shards == 2
+    assert [ep.shard for ep in r.endpoints] == [0, 1]
+    with pytest.raises(ValueError):
+        r.swap_shard_map([[]])  # torn maps stay rejected
+
+
+# --- kill=ferry (Fault Forge) ----------------------------------------------
+
+
+def test_kill_ferry_spec_parses_and_rejects_at():
+    from pathway_tpu.testing import faults
+
+    p = faults.FaultPlan("kill=ferry:2", 0, 0)
+    assert p.directives[0].args["ferry"] == "2"
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan("kill=ferry:2,at:head", 0, 0)
+    # incarnation gating: a retry under a bumped incarnation runs free
+    p1 = faults.FaultPlan("kill=ferry:1", 0, 1)
+    p1.on_ferry_segment(5)  # inc 1 vs default inc 0: no exit
+
+
+_FERRY_KILL_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from pathway_tpu.elastic.ferry import ferry_files
+files = [(f"f{{i}}", bytes([i]) * 128) for i in range(5)]
+ferry_files("127.0.0.1", int(sys.argv[1]), files, transfer_id="chaos")
+print("FERRY-DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_kill_ferry_mid_handoff_rolls_back(tmp_path, job_secret):
+    """Satellite acceptance: a rank killed mid-ferry (deterministic on
+    the segment-transfer counter) leaves the two-phase barrier
+    rollback-able — the old ownership map stays committed, the staged
+    transfer resumes content-addressed on retry."""
+    h = ho.TwoPhaseHandover(str(tmp_path))
+    h.ensure_committed(2)
+    h.begin(3)  # transition open; commit would happen after the ferry
+    recv = FerryReceiver(str(tmp_path / "dst"))
+    try:
+        env = dict(os.environ)
+        env["PATHWAY_FAULTS"] = "kill=ferry:2"
+        env["PATHWAY_DCN_SECRET"] = "elastic-test-secret"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                _FERRY_KILL_CHILD.format(repo=str(REPO)),
+                str(recv.port),
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 23, proc.stderr[-2000:]
+        assert "FERRY-DONE" not in proc.stdout
+        # the transfer never committed: nothing placed, two segments
+        # staged — and the OLD ownership map still rules
+        assert not recv.received
+        assert len(recv.staged("chaos")) == 2
+        h.rollback()
+        assert h.committed == ho.OwnershipMap(2, 0)
+        # retry (fault-free: the supervisor bumps the incarnation)
+        # resumes from the staged half and completes; only then commit
+        env["PATHWAY_MESH_INCARNATION"] = "1"
+        proc2 = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                _FERRY_KILL_CHILD.format(repo=str(REPO)),
+                str(recv.port),
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc2.returncode == 0, proc2.stderr[-2000:]
+        assert "chaos" in recv.received
+        h.begin(3)
+        assert h.commit() == ho.OwnershipMap(3, 1)
+    finally:
+        recv.close()
+
+
+# --- Graph Doctor rule -----------------------------------------------------
+
+
+def test_elastic_resharding_rule(monkeypatch):
+    from pathway_tpu.analysis import run_doctor
+
+    # single-rank: silent
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+          | k | v
+        9 | c | 3
+        """
+    )
+    # update_rows keeps both sides' rows as monolithic keyed state
+    # (UpdateRowsExec has no arranged_state): reshard-pinned
+    merged = t.update_rows(t2)
+    pw.io.null.write(merged)
+    assert not run_doctor().by_rule("elastic-resharding")
+    # multi-rank: the monolithic exec pins the group to log-replay
+    # resizes — WARNING once, INFO naming the exec
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    diags = run_doctor().by_rule("elastic-resharding")
+    from pathway_tpu.analysis import Severity
+
+    assert any(d.severity == Severity.WARNING for d in diags)
+    infos = [d for d in diags if d.severity == Severity.INFO]
+    assert any("UpdateRowsNode" in d.message for d in infos)
+
+
+def test_reshard_capable_resolution():
+    node = _gb_node()
+    assert planner.reshard_capable(node) is True
+
+
+# --- mesh plane e2e: supervised 2 -> 3 rank resize (slow) ------------------
+
+@pytest.mark.slow
+def test_supervised_group_resizes_2_to_3_with_zero_replay(
+    tmp_path, job_secret
+):
+    """The tentpole acceptance: a supervised 2-rank group resizes to 3
+    ranks mid-run via GroupSupervisor.resize + reshard_stores — the
+    grown group restores with ``replayed_events == 0`` (state moved,
+    log untouched) and the folded output is bit-equal to the
+    uninterrupted totals."""
+    from pathway_tpu.elastic.mesh import reshard_stores
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+    from pathway_tpu.testing.chaos import (
+        RESHARD_WORKER_SCRIPT,
+        fold_diff_stream,
+        free_dcn_port,
+    )
+
+    base = tmp_path / "work"
+    for pid in range(3):
+        (base / f"in{pid}").mkdir(parents=True)
+    script = tmp_path / "worker.py"
+    script.write_text(RESHARD_WORKER_SCRIPT)
+    port = free_dcn_port(3)
+
+    def write_words(pid, fname, words):
+        with open(base / f"in{pid}" / fname, "w") as f:
+            for w in words:
+                f.write(json.dumps({"word": w}) + "\n")
+
+    phase1 = {
+        0: ["a", "b", "a", "c", "a"],
+        1: ["b", "c", "d", "a", "d"],
+    }
+    for pid, words in phase1.items():
+        write_words(pid, "f1.jsonl", words)
+    env = {
+        "PW_TEST_DIR": str(base),
+        "PATHWAY_DCN_PORT": str(port),
+        "PATHWAY_DCN_SECRET": "elastic-test-secret",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(REPO),
+    }
+    roots = [str(base / f"pstorage{p}") for p in range(3)]
+    sup = GroupSupervisor(
+        [sys.executable, str(script)],
+        2,
+        env=env,
+        max_restarts=1,
+        grace_s=25.0,  # graceful SIGTERM stop: the final covering
+        # snapshot must land before any SIGKILL escalation
+        log_dir=str(base / "logs"),
+    )
+    th = threading.Thread(target=sup.run, daemon=True)
+    th.start()
+    try:
+        # wait until the phase-1 totals are durably processed (plus a
+        # breath of idle ticks so the per-tick snapshot covers the log)
+        p1_expected = {("a",): (3 + 1,), ("b",): (2,), ("c",): (2,),
+                       ("d",): (2,)}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            folded = fold_diff_stream(
+                [base / f"out{p}_inc0.jsonl" for p in range(2)], ["word"]
+            )
+            if folded == p1_expected:
+                break
+            time.sleep(0.2)
+        assert folded == p1_expected, folded
+        # phase-1 freeze: resize SIGTERMs the group, the workers stop
+        # gracefully at a tick boundary, and the final commit snapshots
+        # — the handoff cut covers the whole durable log
+        sup.resize(
+            3, reshard=lambda: reshard_stores(roots[:2], roots)
+        )
+        deadline = time.monotonic() + 120
+        while (
+            not any(e[1] == "group-resize" for e in sup.events)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert any(e[1] == "group-resize" for e in sup.events), sup.events
+        assert not any(e[1] == "resize-rollback" for e in sup.events), (
+            sup.events
+        )
+
+        # phase 2: traffic to every rank, including the NEW one
+        phase2 = {0: ["a", "e"], 1: ["e", "b"], 2: ["f", "a", "d"]}
+        for pid, words in phase2.items():
+            write_words(pid, "f2.jsonl", words)
+        expected = {
+            ("a",): (6,), ("b",): (3,), ("c",): (2,), ("d",): (3,),
+            ("e",): (2,), ("f",): (1,),
+        }
+        # fold INCARNATION-major: within one incarnation each word's
+        # updates come from exactly one rank (disjoint ownership), and
+        # all inc-0 activity strictly precedes inc-1 — rank-major order
+        # could fold a re-homed key's update before its install
+        out_paths = [
+            base / f"out{p}_inc{i}.jsonl"
+            for i in range(2)
+            for p in range(3)
+        ]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            folded = fold_diff_stream(out_paths, ["word"])
+            if folded == expected:
+                break
+            time.sleep(0.2)
+        assert folded == expected, folded
+        (base / "STOP").touch()
+        th.join(timeout=90)
+        assert not th.is_alive(), "supervised group never stopped"
+        # the grown group restored from MOVED state, not the log
+        replayed = {}
+        for p in range(3):
+            log = base / "logs" / f"rank{p}-inc1.log"
+            for line in log.read_text().splitlines():
+                if line.startswith("REPLAYED "):
+                    replayed[p] = int(line.split()[1])
+        assert replayed == {0: 0, 1: 0, 2: 0}, replayed
+    finally:
+        (base / "STOP").touch()
+        sup.stop()
+        th.join(timeout=30)

@@ -822,3 +822,138 @@ def test_out_of_thread_snapshot_runs_at_step_boundary(tmp_path):
         assert out2 is not None
     finally:
         s.stop()
+
+
+# --- Shard Flux: the generation plane rides the ferry ----------------------
+
+
+def test_kv_handoff_resumes_on_new_owner(tmp_path, monkeypatch):
+    """Elastic resharding, generation plane: a member's in-flight KV
+    ledger splits by the system-wide jk-hash ownership, the owning
+    half rides the SegmentFerry to the new owner's store, and the new
+    owner's scheduler RESUMES the decode — tokens bit-equal to the
+    uninterrupted run (the kill/restore machinery, now cross-owner)."""
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "kv-handoff-secret")
+    from pathway_tpu.elastic.ferry import FerryReceiver
+    from pathway_tpu.elastic.kv import seq_owner, split_kv_store
+
+    prompt = dec.encode_text("the quick brown fox")
+    kw = dict(max_new_tokens=12, temperature=0.7, top_k=20, seed=5)
+    cfg = _cfg(n_pages=16, max_batch=1, max_len=64)
+
+    s0 = DecodeScheduler(cfg, replica_label="hu")
+    r0 = GenerationRequest(
+        "hu", list(prompt), deadline=time.monotonic() + 60, **kw
+    )
+    s0.submit(r0)
+    res0 = r0.wait(60)
+    s0.stop()
+    assert res0["status"] == 200
+
+    root = str(tmp_path / "kv-src")
+    cfg1 = _cfg(
+        n_pages=16, max_batch=1, max_len=64,
+        snapshot_every=3, store_root=root,
+    )
+    s1 = DecodeScheduler(cfg1, replica_label="hk")
+    r1 = GenerationRequest(
+        "hk", list(prompt), deadline=time.monotonic() + 60, **kw
+    )
+    s1.submit(r1)
+    deadline = time.monotonic() + 60
+    while (
+        s1.stats()["decode_steps"] < 9 and time.monotonic() < deadline
+    ):
+        time.sleep(0.005)
+    # freeze mid-flight (the in-process SIGKILL stand-in): only what
+    # the periodic snapshot committed survives the handoff
+    s1._step = lambda: time.sleep(0.05)
+    time.sleep(0.2)
+
+    # split 1 -> 2 owners; the OWNING destination sits behind a real
+    # ferry endpoint (remote-owner shape), the other is a local dir
+    owner = seq_owner(1, 2)
+    roots = [str(tmp_path / "kv-p0"), str(tmp_path / "kv-p1")]
+    recv = FerryReceiver(roots[owner])
+    try:
+        dests: list = [roots[0], roots[1]]
+        dests[owner] = (recv.host, recv.port)
+        stats = split_kv_store(root, dests)
+        assert stats["total_seqs"] == 1
+        assert stats["destinations"][owner]["seqs"] == 1
+        assert stats["destinations"][1 - owner]["seqs"] == 0
+        assert stats["bytes_ferried"] > 0
+        assert stats["destinations"][owner]["ferry"]["committed"]
+    finally:
+        recv.close()
+
+    cfg_new = _cfg(
+        n_pages=16, max_batch=1, max_len=64,
+        snapshot_every=3, store_root=roots[owner],
+    )
+    s2 = DecodeScheduler(cfg_new, replica_label="ho")
+    cfg_other = _cfg(
+        n_pages=16, max_batch=1, max_len=64, store_root=roots[1 - owner],
+    )
+    s3 = DecodeScheduler(cfg_other, replica_label="hn")
+    try:
+        assert getattr(s2, "restored_seqs", 0) == 1
+        assert getattr(s3, "restored_seqs", 0) == 0
+        deadline = time.monotonic() + 90
+        while not s2.finished and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert s2.finished, "handed-off sequence never completed"
+        res2 = next(iter(s2.finished.values()))
+        assert res2["status"] == 200
+        assert res2["tokens"] == res0["tokens"]
+        assert res2["text"] == res0["text"]
+    finally:
+        s3.stop()
+        s2.stop()
+        s1.stop()
+
+
+# --- Tenant Weave: WFQ ordering extends into decode batching ---------------
+
+
+def test_tenant_wfq_orders_decode_queue():
+    """ROADMAP gen (f): with the tenant ledger attached, the decode
+    batcher orders by the WFQ (vfinish, deadline) tag — a noisy
+    neighbor's queued backlog drains BEHIND a tail tenant's fresh
+    request even though the tail arrived last."""
+    from pathway_tpu.serving.tenancy import TenancyConfig, TenantLedger
+
+    ledger = TenantLedger(
+        TenancyConfig(weights={"default": 1.0}), route="/gen"
+    )
+    s = DecodeScheduler(
+        _cfg(max_batch=1, n_pages=31), replica_label="wfq", ledger=ledger
+    )
+    try:
+        hot = [
+            _req(
+                f"hot{i}",
+                "alpha beta gamma delta epsilon zeta",
+                tenant="hot",
+                max_new_tokens=4,
+            )
+            for i in range(4)
+        ]
+        for r in hot:
+            s.submit(r)
+            assert isinstance(r.order, tuple)  # (vfinish, deadline)
+        tail = _req("tail", "hi", tenant="tail", max_new_tokens=4)
+        s.submit(tail)
+        for r in hot + [tail]:
+            assert r.wait(120)["status"] == 200, r.request_id
+        done_order = list(s.finished)
+        # the tail's single request must NOT drain behind the whole hot
+        # backlog (plain EDF would finish every earlier-deadline hot
+        # request first) — at least the last hot request follows it
+        tail_pos = done_order.index("tail")
+        hots_after_tail = sum(
+            1 for rid in done_order[tail_pos + 1:] if rid.startswith("hot")
+        )
+        assert hots_after_tail >= 1, done_order
+    finally:
+        s.stop()

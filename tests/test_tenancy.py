@@ -170,17 +170,59 @@ def test_state_cap_bounds_tracked_tenants():
     assert led.tracked_tenants <= 8
 
 
-def test_active_weight_window_prunes_idle_tenants():
+def test_active_weight_decays_idle_tenants():
+    import math
+
     from pathway_tpu.serving import tenancy
 
     led = TenantLedger(_config(), route="/t", capacity_rps=10.0)
     now = time.monotonic()
     led.admit("a", None, now, pressure=False)
     led.admit("b", None, now, pressure=False)
-    assert led.active_weight() == pytest.approx(2.0)
-    # b goes idle past the window; the prune (>=1s apart) drops it
-    led.admit("a", None, now + tenancy.ACTIVE_WINDOW_S + 2.0, pressure=False)
-    assert led.active_weight() == pytest.approx(1.0)
+    assert led.active_weight(now) == pytest.approx(2.0)
+    # b goes idle: its contribution decays exponentially — at τ+2s it
+    # still counts e^(-1.2), and by 5τ it is effectively gone
+    t1 = now + tenancy.ACTIVE_TAU_S + 2.0
+    led.admit("a", None, t1, pressure=False)
+    assert led.active_weight(t1) == pytest.approx(
+        1.0 + math.exp(-(tenancy.ACTIVE_TAU_S + 2.0) / tenancy.ACTIVE_TAU_S),
+        rel=1e-6,
+    )
+    t2 = now + 5.0 * tenancy.ACTIVE_TAU_S
+    led.admit("a", None, t2, pressure=False)
+    assert led.active_weight(t2) == pytest.approx(1.0, abs=0.01)
+
+
+def test_no_fair_share_cliff_at_idle_boundaries():
+    """Regression (ROADMAP tenant (a)): the fixed 10 s ACTIVE window
+    made W_active — and so every tenant's fair share — JUMP the instant
+    an idle neighbor crossed the expiry boundary.  The decayed estimate
+    must be continuous: W(t) sampled just before and just after the old
+    boundary (and at every other instant) differs only by the decay of
+    an epsilon of wall time."""
+    from pathway_tpu.serving import tenancy
+
+    led = TenantLedger(_config(), route="/t", capacity_rps=12.0)
+    now = time.monotonic()
+    led.admit("a", None, now, pressure=False)
+    led.admit("b", None, now, pressure=False)
+    eps = 1e-3
+    for boundary in (
+        tenancy.ACTIVE_TAU_S,  # the old window expiry — the cliff
+        tenancy.ACTIVE_TAU_S / 2.0,
+        2.0 * tenancy.ACTIVE_TAU_S,
+    ):
+        before = led.active_weight(now + boundary - eps)
+        after = led.active_weight(now + boundary + eps)
+        # pre-fix: before=2.0, after=1.0 at the 10 s boundary (a 2x
+        # fair-share jump).  post-fix: continuous to ~eps/τ.
+        assert abs(before - after) < 1e-3, (boundary, before, after)
+    # and the share a still-active tenant derives from it is monotone
+    # (B only ever fades): no re-doubling sawtooth across the day
+    samples = [
+        led.active_weight(now + t) for t in (1.0, 5.0, 10.0, 20.0, 40.0)
+    ]
+    assert all(a >= b - 1e-9 for a, b in zip(samples, samples[1:]))
 
 
 # --- WFQ ordering ----------------------------------------------------------
