@@ -24,9 +24,20 @@ from pathway_tpu.analysis.doctor import (
     GraphDoctorError,
     check_before_run,
     run_doctor,
+    run_plane_doctor,
     suppress,
 )
 from pathway_tpu.analysis.graph_facts import GraphFacts
+from pathway_tpu.analysis.lowering import (
+    LoweringCase,
+    LoweringReport,
+    LoweringRuleViolation,
+    check_tpu_block_rules,
+    lane_pad,
+    prove_lowering,
+    write_manifest,
+)
+from pathway_tpu.analysis.plane import PLANE_RULES, plane_rule
 from pathway_tpu.analysis.rules import RULES, default_rules, rule
 
 __all__ = [
@@ -34,12 +45,22 @@ __all__ = [
     "DoctorReport",
     "GraphDoctorError",
     "GraphFacts",
+    "LoweringCase",
+    "LoweringReport",
+    "LoweringRuleViolation",
+    "PLANE_RULES",
     "RULES",
     "Severity",
     "check_before_run",
+    "check_tpu_block_rules",
     "default_rules",
+    "lane_pad",
     "node_provenance",
+    "plane_rule",
+    "prove_lowering",
     "rule",
     "run_doctor",
+    "run_plane_doctor",
     "suppress",
+    "write_manifest",
 ]

@@ -155,6 +155,47 @@ def run_doctor(
     return DoctorReport(diags)
 
 
+def run_plane_doctor(
+    outputs: Iterable[Any] | None = None,
+    all_nodes: Iterable[Any] | None = None,
+    rules: "dict | Iterable[str] | None" = None,
+) -> DoctorReport:
+    """Run the deployment-scope rules (analysis/plane.py) and return
+    the report: snapshot coverage for elastic resizes, pickle-on-hot-
+    path over the wire/segment encoders, ``PATHWAY_*`` knob coherence.
+    Unlike :func:`run_doctor` this is meaningful even with NO declared
+    graph (the knob lint is pure environment), so an empty graph is
+    fine."""
+    from pathway_tpu.analysis.plane import PLANE_RULES, default_plane_rules
+
+    facts = GraphFacts(outputs=outputs, all_nodes=all_nodes)
+    if rules is None:
+        active = default_plane_rules()
+    elif isinstance(rules, dict):
+        active = rules
+    else:
+        rules = list(rules)
+        unknown = sorted(set(rules) - set(PLANE_RULES))
+        if unknown:
+            raise ValueError(
+                f"unknown plane rule id(s) {unknown}; "
+                f"registered plane rules: {sorted(PLANE_RULES)}"
+            )
+        active = {rid: PLANE_RULES[rid] for rid in rules}
+    diags: list[Diagnostic] = []
+    for rule_id, fn in active.items():
+        try:
+            found = list(fn(facts))
+        except Exception:
+            logger.exception("plane doctor rule %r crashed", rule_id)
+            continue
+        diags.extend(
+            d for d in found if not _suppressed(d, facts.consumers)
+        )
+    diags.sort(key=lambda d: (-int(d.severity), d.rule))
+    return DoctorReport(diags)
+
+
 def check_before_run(seeds: list, mode: str) -> None:
     """The pw.run() integration: run the doctor and act per `mode`
     ("off" | "warn" | "error"). Raises GraphDoctorError in error mode

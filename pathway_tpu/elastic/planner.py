@@ -231,3 +231,21 @@ def reshard_capable(node) -> bool | None:
         return None
     fn = getattr(cls, "arranged_state", None)
     return fn is not None and fn is not NodeExec.arranged_state
+
+
+def monolithic_state_nodes(nodes) -> list[tuple]:
+    """[(node, exec class name)] for every stateful node whose exec
+    provably lacks ``arranged_state`` — the operators that pin a Shard
+    Flux resize to log-replay (ROADMAP 5c). The elastic plane's
+    metadata hook for static verification: the Plane Doctor's
+    snapshot-coverage rule (analysis/plane.py) names these before
+    anyone attempts a resize against them."""
+    out = []
+    for node in nodes:
+        if not getattr(node, "is_stateful", False):
+            continue
+        if reshard_capable(node) is not False:
+            continue
+        cls = exec_class_for(node)
+        out.append((node, cls.__name__ if cls else type(node).__name__))
+    return out

@@ -875,7 +875,38 @@ class SegmentRunner:
         compile_hist.observe(time.perf_counter() - t0)
         with self._lock:
             self._cache[key] = prog
+        self._register_with_ledger(prog, bucket, dtypes)
         return prog, key
+
+    def _register_with_ledger(self, prog: _Program, bucket: int, dtypes):
+        """Hand the freshly-built segment program to the Lowering Ledger
+        (analysis/lowering.py): ``prove_lowering`` can then AOT-check
+        the exact jitted tick this process runs against the TPU rules,
+        device-free. Best-effort — the ledger must never break a tick."""
+        try:
+            import jax
+
+            from pathway_tpu.analysis import lowering as ledger
+
+            args = tuple(
+                jax.ShapeDtypeStruct((bucket,), dtypes[c])
+                for c in prog.in_cols
+            )
+            name = (
+                f"seg_{'-'.join(prog.in_cols)}_rows{bucket}"
+            )
+            ledger.register_program(
+                name,
+                prog.fn,
+                args,
+                meta={
+                    "rows": bucket,
+                    "in_cols": list(prog.in_cols),
+                    "out_cols": [c for c, _ in prog.dev_out],
+                },
+            )
+        except Exception:  # pragma: no cover - defensive
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -513,6 +513,13 @@ class Table(Joinable):
         # (pathway_tpu/analysis re-checks universe relations over the
         # declared graph and surfaces them as diagnostics)
         node._universe = universe
+        # ... and the declared column dtypes, so the Plane Doctor can
+        # spot object columns headed for the wire/segment pickle
+        # fallback (analysis/plane.py pickle-hot-path) without running
+        # the encoders
+        node._column_dtypes = {
+            name: schema[name].dtype for name in schema.column_names()
+        }
 
     # --- metadata -------------------------------------------------------------
 

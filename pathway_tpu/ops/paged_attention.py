@@ -47,17 +47,19 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from pathway_tpu.ops.pallas_topk import check_tpu_block_rules
+# shared 8x128 gate: analysis/lowering.py is the single source of truth
+# for the Mosaic tiling rules (re-exported for existing callers)
+from pathway_tpu.analysis.lowering import (  # noqa: F401
+    LoweringRuleViolation,
+    RULE_LANE_PAD,
+    check_block_specs,
+    check_tpu_block_rules,
+    lane_pad,
+)
 
 # mask value for invalid key positions: large-negative finite (an -inf
 # mask makes the online-softmax rescale NaN on fully-masked pages)
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
-
-
-def lane_pad(d: int) -> int:
-    """d padded up to the TPU lane width (multiple of 128) — the same
-    rule pallas_topk._kpad applies to its top-k output tiles."""
-    return -(-int(d) // 128) * 128
 
 
 def _specs(b: int, h: int, p: int, dp: int, n_pages: int, max_pages: int):
@@ -104,13 +106,13 @@ def validate_lowering(
     """Assert every block spec the kernel will use satisfies the Mosaic
     TPU rule — the compiled-mode test gate (pallas_topk precedent)."""
     if dp % 128 != 0:
-        raise ValueError(
+        raise LoweringRuleViolation(
+            RULE_LANE_PAD,
             f"head_dim pool width {dp} is not lane-padded (multiple of "
-            f"128); pad with lane_pad() — got lane_pad={lane_pad(dp)}"
+            f"128); pad with lane_pad() — got lane_pad={lane_pad(dp)}",
         )
     grid, in_specs, out_specs, _ = _specs(b, h, p, dp, n_pages, max_pages)
-    for spec, arr_shape in in_specs + out_specs:
-        check_tpu_block_rules(spec.block_shape, arr_shape)
+    check_block_specs(in_specs + out_specs)
 
 
 def _decode_kernel(
@@ -140,13 +142,21 @@ def _decode_kernel(
     q = q_ref[0].astype(jnp.float32)  # [H, Dp]
     k = k_ref[0].astype(jnp.float32)  # [H, P, Dp]
     v = v_ref[0].astype(jnp.float32)
-    # per-head scores of the query against this page: [H, P]
-    s = jax.lax.dot_general(
-        q,
-        k,
-        (((1,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ) * sm_scale
+    # per-head scores of the query against this page: [H, P].  Unrolled
+    # over heads as 2-D dots — Mosaic only lowers 2-D dot_general (a
+    # batched [H,Dp]x[H,P,Dp] contraction is interpret-green but fails
+    # TPU lowering; the ledger's AOT export proves this shape)
+    s_rows = []
+    for hh in range(h):
+        s_rows.append(
+            jax.lax.dot_general(
+                q[hh : hh + 1, :],
+                k[hh],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    s = jnp.concatenate(s_rows, axis=0) * sm_scale
     # ragged mask: token index j*P + col vs this sequence's length
     pos = j * p + jax.lax.broadcasted_iota(jnp.int32, (1, p), 1)
     valid = pos < sl_ref[b]  # [1, P]
@@ -163,12 +173,18 @@ def _decode_kernel(
     l_new = l_prev * alpha + jnp.broadcast_to(
         jnp.sum(w, axis=1, keepdims=True), (h, 128)
     )
-    pv = jax.lax.dot_general(
-        w,
-        v,
-        (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )  # [H, Dp]
+    # weighted page values, same per-head 2-D unroll: [H, Dp]
+    pv_rows = []
+    for hh in range(h):
+        pv_rows.append(
+            jax.lax.dot_general(
+                w[hh : hh + 1, :],
+                v[hh],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    pv = jnp.concatenate(pv_rows, axis=0)
     acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
     m_scr[:] = m_new
     l_scr[:] = l_new

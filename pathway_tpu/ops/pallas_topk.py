@@ -28,35 +28,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# the 8x128 rules live in ONE place (analysis/lowering.py) — re-exported
+# here for the existing test gates and callers
+from pathway_tpu.analysis.lowering import (  # noqa: F401
+    check_block_specs,
+    check_tpu_block_rules,
+    lane_pad,
+)
+
 BLK = 1024
 
 
 def _kpad(k: int) -> int:
     """k padded up to the TPU lane width (multiple of 128)."""
-    return -(-k // 128) * 128
-
-
-def check_tpu_block_rules(block_shape, array_shape) -> None:
-    """Static mirror of the Mosaic lowering rule: the last two dims of a
-    block must be divisible by (8, 128) respectively, or equal the
-    corresponding overall-array dims. Raises ValueError otherwise — the
-    compiled-mode test gate calls this for every spec the kernel uses so
-    an un-lowerable shape fails the suite even on the CPU backend."""
-    if len(block_shape) != len(array_shape):
-        raise ValueError(
-            f"block rank {len(block_shape)} != array rank {len(array_shape)}"
-        )
-    if len(block_shape) < 2:
-        return
-    checks = ((block_shape[-2], array_shape[-2], 8), (
-        block_shape[-1], array_shape[-1], 128))
-    for blk_dim, arr_dim, align in checks:
-        if blk_dim % align != 0 and blk_dim != arr_dim:
-            raise ValueError(
-                f"block shape {tuple(block_shape)} vs array "
-                f"{tuple(array_shape)}: dim {blk_dim} is neither divisible "
-                f"by {align} nor equal to the array dim {arr_dim}"
-            )
+    return lane_pad(k)
 
 
 def _specs(bq: int, d: int, n: int, k: int):
@@ -94,6 +79,9 @@ def _topk_block_kernel(k: int, kp: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref)
     s = jnp.where(valid_ref[:] > 0.5, s, -jnp.inf)
     b = s.shape[0]
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # float copy for the argmax reduction: Mosaic has no integer
+    # reduce_min lowering, and BLK (< 2^24) is exact in f32
+    colsf = cols.astype(jnp.float32)
     out_cols = jax.lax.broadcasted_iota(jnp.int32, (b, kp), 1)
 
     def body(i, carry):
@@ -101,7 +89,9 @@ def _topk_block_kernel(k: int, kp: int, q_ref, c_ref, valid_ref, sc_ref, ix_ref)
         m = jnp.max(s_cur, axis=1)  # [B]
         is_max = s_cur == m[:, None]
         # first column attaining the max
-        a = jnp.min(jnp.where(is_max, cols, BLK), axis=1).astype(jnp.int32)
+        a = jnp.min(
+            jnp.where(is_max, colsf, float(BLK)), axis=1
+        ).astype(jnp.int32)
         # one-hot lane write (dynamic per-lane .at[] scatters lower poorly
         # on the VPU; a masked select vectorizes)
         hit = out_cols == i
@@ -186,5 +176,4 @@ def validate_lowering(bq: int, d: int, n: int, k: int) -> None:
     """Assert every block spec the kernel will use satisfies the TPU
     lowering rule. Used by the compiled-mode test gate."""
     _grid, in_specs, out_specs, _shapes, _nblk, _kp = _specs(bq, d, n, k)
-    for spec, arr_shape in in_specs + out_specs:
-        check_tpu_block_rules(spec.block_shape, arr_shape)
+    check_block_specs(in_specs + out_specs)

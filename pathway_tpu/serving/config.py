@@ -48,6 +48,20 @@ def serving_enabled_via_env() -> bool:
     )
 
 
+def plane_knobs() -> dict[str, str]:
+    """Snapshot of every ``PATHWAY_*`` knob set in this environment —
+    the serving plane's metadata hook for static verification: the
+    Plane Doctor (analysis/plane.py knob-coherence) lints this surface
+    and ``python -m pathway_tpu.analysis --plane`` records it alongside
+    its findings so CI logs show exactly which deployment the verdict
+    applied to."""
+    return {
+        k: v
+        for k, v in sorted(os.environ.items())
+        if k.startswith("PATHWAY_")
+    }
+
+
 def default_bucket_ladder(max_batch_size: int) -> tuple[int, ...]:
     """Power-of-two ladder capped at ``max_batch_size`` — matching the
     encoder's pad buckets (xpacks/llm/_encoder.py ``_bucket_batch``) so a

@@ -467,12 +467,24 @@ def test_cli_fail_on_threshold():
 
 def test_cli_gates_example_pipelines():
     """The CI gate: every in-repo example must be free of error-severity
-    findings, and the flagship streaming example free of warnings too."""
+    findings, and the flagship streaming example free of warnings too.
+    The flagship also passes the deployment-plane gate (`--plane
+    --json`): plane rules plus the device-free TPU lowering proofs, so
+    an unpadded kernel shape fails this suite, not the bench."""
     for script in sorted((REPO / "examples").glob("*.py")):
         res = _run_cli(str(script.relative_to(REPO)))
         assert res.returncode == 0, f"{script.name}:\n{res.stdout}{res.stderr}"
     res = _run_cli("--fail-on", "warning", "examples/streaming_wordcount.py")
     assert res.returncode == 0, res.stdout
+    res = _run_cli_plane(
+        "--plane",
+        "--json",
+        "--manifest",
+        "none",
+        "examples/streaming_wordcount.py",
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert json.loads(res.stdout)["lowering"]["cases"]
 
 
 def test_cli_rule_filter():
@@ -815,3 +827,317 @@ def test_unreplicated_serving_negative_without_index(monkeypatch):
     )
     writer(queries.select(query_id=queries.id, result=queries.q))
     assert not run_doctor().by_rule("unreplicated-serving")
+
+
+# --- plane doctor: deployment-scope rules (analysis/plane.py) --------------
+
+
+import os  # noqa: E402
+
+from pathway_tpu.analysis import run_plane_doctor  # noqa: E402
+
+
+@pytest.fixture
+def _clean_knobs(monkeypatch):
+    """Strip ambient PATHWAY_* knobs so env-lint assertions are exact."""
+    for k in list(os.environ):
+        if k.startswith("PATHWAY_"):
+            monkeypatch.delenv(k, raising=False)
+
+
+def _monolith_graph():
+    """One graph touching all four arranged-state gaps (ROADMAP 5c):
+    UpdateRows, instance-less Sort, Ix, UniverseSetOp."""
+    t = _static()
+    u = _static_other()
+    t.update_rows(u)
+    t.sort(key=pw.this.v)
+    keys = u.select(ptr=t.pointer_from(pw.this.k))
+    t.ix(keys.ptr)
+    u.with_universe_of(t)
+    return t
+
+
+def test_snapshot_coverage_names_the_four_monoliths(_clean_knobs):
+    _monolith_graph()
+    found = run_plane_doctor().by_rule("snapshot-coverage")
+    execs = {d.data["exec"] for d in found}
+    assert execs >= {
+        "UpdateRowsExec",
+        "SortExec",
+        "IxExec",
+        "UniverseSetOpExec",
+    }, execs
+    assert all(d.severity == Severity.WARNING for d in found)
+
+
+def test_snapshot_coverage_skips_arrangement_backed_execs(_clean_knobs):
+    t = _stream()
+    t.groupby(pw.this.k).reduce(
+        pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    execs = {
+        d.data["exec"]
+        for d in run_plane_doctor().by_rule("snapshot-coverage")
+    }
+    assert "GroupByExec" not in execs
+
+
+def test_snapshot_coverage_clears_when_arranged_state_lands(
+    _clean_knobs, monkeypatch
+):
+    """The audit is driven by the exec metadata, not a hardcoded list:
+    giving UpdateRowsExec an arranged_state override clears it."""
+    from pathway_tpu.engine import nodes as en
+
+    t = _static()
+    t.update_rows(_static_other())
+    before = {
+        d.data["exec"]
+        for d in run_plane_doctor().by_rule("snapshot-coverage")
+    }
+    assert "UpdateRowsExec" in before
+
+    monkeypatch.setattr(
+        en.UpdateRowsExec,
+        "arranged_state",
+        lambda self: {},
+        raising=False,
+    )
+    after = {
+        d.data["exec"]
+        for d in run_plane_doctor().by_rule("snapshot-coverage")
+    }
+    assert "UpdateRowsExec" not in after
+
+
+def test_snapshot_coverage_per_node_suppression(_clean_knobs):
+    t = _static()
+    upd = t.update_rows(_static_other())
+    suppress(upd, "snapshot-coverage")
+    execs = {
+        d.data["exec"]
+        for d in run_plane_doctor().by_rule("snapshot-coverage")
+    }
+    assert "UpdateRowsExec" not in execs
+
+
+def test_pickle_hot_path_flags_object_exchange_key(_clean_knobs):
+    t = _static()  # k: str
+    t.groupby(pw.this.k).reduce(
+        pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    found = run_plane_doctor().by_rule("pickle-hot-path")
+    assert found, "str groupby key should be flagged on the wire"
+    assert any("str" in d.data["dtype"] for d in found)
+
+
+def test_pickle_hot_path_quiet_on_numeric_columns(_clean_knobs):
+    t = _static()
+    t.groupby(pw.this.v).reduce(
+        pw.this.v, n=pw.reducers.count()
+    )
+    numeric_only = t.select(v=pw.this.v)
+    numeric_only.groupby(pw.this.v).reduce(
+        pw.this.v, n=pw.reducers.count()
+    )
+    found = run_plane_doctor().by_rule("pickle-hot-path")
+    # the int key column itself must not be flagged
+    assert all("int" not in d.data["dtype"] for d in found)
+
+
+def test_knob_lint_shard_count_disagreement(_clean_knobs, monkeypatch):
+    """The satellite case: PATHWAY_SERVING_SHARDS says 3 but the shard
+    map describes 2 — an ERROR before any process boots."""
+    monkeypatch.setenv("PATHWAY_SERVING_SHARDS", "3")
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_SHARD_MAP", "h1:9000|h2:9001"
+    )
+    found = run_plane_doctor().by_rule("knob-coherence")
+    conflict = [d for d in found if "conflicting shard counts" in d.message]
+    assert conflict and conflict[0].severity == Severity.ERROR
+    assert conflict[0].data["shards"] == 3
+    assert conflict[0].data["map_shards"] == 2
+
+    # agreement clears it
+    monkeypatch.setenv("PATHWAY_SERVING_SHARDS", "2")
+    found = run_plane_doctor().by_rule("knob-coherence")
+    assert not [d for d in found if "conflicting" in d.message]
+
+
+def test_knob_lint_torn_shard_map_and_bad_qos(_clean_knobs, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVING_SHARD_MAP", "|||")
+    monkeypatch.setenv("PATHWAY_SERVING_MAX_QUEUE", "many")
+    found = run_plane_doctor().by_rule("knob-coherence")
+    msgs = [d.message for d in found if d.severity == Severity.ERROR]
+    assert any("SHARD_MAP" in m for m in msgs)
+    assert any("MAX_QUEUE" in m for m in msgs)
+
+
+def test_knob_lint_gated_ingress_without_deadline(
+    _clean_knobs, monkeypatch
+):
+    monkeypatch.setenv("PATHWAY_SERVING_ENABLED", "1")
+    monkeypatch.setenv("PATHWAY_SERVING_DEADLINE_MS", "0")
+    found = run_plane_doctor().by_rule("knob-coherence")
+    assert any(
+        "without deadline bounds" in d.message
+        and d.severity == Severity.WARNING
+        for d in found
+    )
+
+
+def test_knob_lint_cache_without_stream_and_inert_tenancy(
+    _clean_knobs, monkeypatch
+):
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE", "1")
+    monkeypatch.setenv("PATHWAY_TENANT_QOS", "1")
+    found = run_plane_doctor().by_rule("knob-coherence")
+    assert any(
+        "PATHWAY_ROUTER_CACHE_WRITER" in d.message
+        and d.severity == Severity.WARNING
+        for d in found
+    )
+    assert any(
+        "PATHWAY_TENANT_QOS" in d.message
+        and d.severity == Severity.INFO
+        for d in found
+    )
+
+
+def test_knob_lint_quiet_on_clean_env(_clean_knobs):
+    assert not run_plane_doctor().by_rule("knob-coherence")
+
+
+# --- plane mode CLI (the tier-1 lowering gate) -----------------------------
+
+
+def _run_cli_plane(*args, env_overrides=None):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("PATHWAY_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_overrides or {})
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=240,
+    )
+
+
+def test_cli_plane_proves_all_families_and_writes_manifest(tmp_path):
+    """The tier-1 gate half 1: `--plane` lowers every kernel family
+    across the pad ladder with zero device access (JAX_PLATFORMS=cpu)
+    and writes the content-addressed manifest."""
+    manifest = tmp_path / "LOWERING_r16.json"
+    res = _run_cli_plane(
+        "--plane", "--json", "--manifest", str(manifest)
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["findings"] == []
+    cases = doc["lowering"]["cases"]
+    families = {c["family"] for c in cases}
+    assert families >= {"pallas_topk", "paged_attention", "tick_forge"}
+    assert all(
+        c["status"] in ("lowered", "rejected") for c in cases
+    ), cases
+    # every expected-lower case really went through Mosaic lowering
+    for c in cases:
+        if c["status"] == "lowered":
+            assert len(c["stablehlo_sha256"]) == 64
+    ondisk = json.loads(manifest.read_text())
+    assert ondisk["content_sha256"] == doc["lowering"]["content_sha256"]
+
+
+def test_cli_plane_fails_suite_on_unpadded_shape(tmp_path):
+    """The tier-1 gate half 2: a newly introduced unpadded kernel shape
+    fails the suite (exit 1) with a finding naming the kernel, the
+    shape and the violated rule — not the bench."""
+    res = _run_cli_plane(
+        "--plane",
+        "--json",
+        "--manifest",
+        "none",
+        "--prove-shape",
+        "paged_attention:head_dim=129",
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    (finding,) = [
+        f for f in doc["findings"] if f["rule"] == "tpu-lowering"
+    ]
+    assert finding["severity"] == "error"
+    assert finding["data"]["family"] == "paged_attention"
+    assert finding["data"]["shape"]["head_dim"] == 129
+    assert finding["data"]["rule"] == "lane-pad"
+
+    # same for an un-lane-padded raw top-k tile
+    res = _run_cli_plane(
+        "--plane",
+        "--json",
+        "--manifest",
+        "none",
+        "--prove-shape",
+        "pallas_topk:k=10,pad=0",
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert any(
+        f["data"].get("rule") == "mosaic-8x128"
+        for f in doc["findings"]
+    )
+
+
+def test_cli_plane_env_findings_and_knob_snapshot(tmp_path):
+    res = _run_cli_plane(
+        "--plane",
+        "--json",
+        "--manifest",
+        "none",
+        env_overrides={
+            "PATHWAY_SERVING_SHARDS": "3",
+            "PATHWAY_SERVING_SHARD_MAP": "h1:9000|h2:9001",
+        },
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert any(
+        f["rule"] == "knob-coherence" and f["severity"] == "error"
+        for f in doc["findings"]
+    )
+    # the knob snapshot records the deployment the verdict applied to
+    assert doc["knobs"]["PATHWAY_SERVING_SHARDS"] == "3"
+
+
+def test_cli_plane_with_script_runs_both_scopes():
+    res = _run_cli_plane(
+        "--plane",
+        "--json",
+        "--manifest",
+        "none",
+        "--fail-on",
+        "never",
+        "examples/diagnostics_demo.py",
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    rules_hit = {f["rule"] for f in doc["findings"]}
+    # graph rules and the lowering proofs land in ONE report
+    assert "dead-node" in rules_hit or "dead-column" in rules_hit
+    assert doc["lowering"] is not None
+    assert {c["family"] for c in doc["lowering"]["cases"]} >= {
+        "pallas_topk",
+        "paged_attention",
+    }
+
+
+def test_cli_requires_script_unless_plane():
+    res = _run_cli()
+    assert res.returncode == 2
+    assert "script is required" in res.stderr
