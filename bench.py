@@ -2161,7 +2161,13 @@ def _bench_chaos_recovery(np):
 
 
 def _serve_chaos_load_phase(
-    np, router_port, workers, duration_s, n_docs, surge_period_s=None
+    np,
+    router_port,
+    workers,
+    duration_s,
+    n_docs,
+    surge_period_s=None,
+    samples_out=None,
 ):
     """Closed-loop load through the failover router: zipf-distributed
     tenants over a million-user population, diurnal surge (a sinusoidal
@@ -2232,6 +2238,10 @@ def _serve_chaos_load_phase(
     total = sum(statuses.values())
     shed = sum(statuses.get(c, 0) for c in (429, 503))
     errors = total - shed - len(served)
+    if samples_out is not None:
+        # pooled-percentile callers (obs_overhead) need the raw served
+        # latencies, not just this phase's summary
+        samples_out.extend(served)
     return {
         "workers": workers,
         "duration_s": round(elapsed, 2),
@@ -2797,6 +2807,7 @@ def _bench_serve_chaos(np):
         ).start()
         time.sleep(2.0)
         t_kill = time.monotonic()
+        wall_kill = time.time()
         writer.kill()  # SIGKILL: no flush, no goodbye
         took_over = standby.wait_takeover(timeout=60)
         resumed_at = None
@@ -2813,12 +2824,44 @@ def _bench_serve_chaos(np):
                 break
             time.sleep(0.3)
         to_t.join(timeout=to_phase_s + 120)
+        # Fleet Lens: derive the SAME window from /fleet/events ALONE —
+        # first stream-disconnect (replicas see the SIGKILL as stream
+        # EOF) to the LAST caught-up under the takeover incarnation —
+        # and check it against the stopwatch (acceptance: within 10%)
+        fleet_window = None
+        try:
+            from pathway_tpu.observability.fleet import window_from_events
+
+            evs = requests.get(
+                "http://127.0.0.1:%d/fleet/events" % router_to.port,
+                timeout=10,
+            ).json()["events"]
+            evs = [
+                e
+                for e in evs
+                if float(e.get("wall") or 0.0) >= wall_kill - 1.0
+            ]
+            win = window_from_events(
+                evs, ["stream-disconnect"], ["caught-up"]
+            )
+            if (
+                win is not None
+                and int(win["end_event"].get("incarnation") or 0) >= 1
+            ):
+                fleet_window = round(win["seconds"], 2)
+        except Exception:
+            pass
+        handoff_s = (
+            round(resumed_at - t_kill, 2) if resumed_at is not None else None
+        )
         out["writer_takeover"] = {
             "standby_took_over": bool(took_over),
             "takeover_incarnation": standby.takeover_incarnation,
-            "handoff_to_fresh_s": (
-                round(resumed_at - t_kill, 2)
-                if resumed_at is not None
+            "handoff_to_fresh_s": handoff_s,
+            "window_from_events_s": fleet_window,
+            "window_agreement": (
+                round(fleet_window / handoff_s, 3)
+                if fleet_window and handoff_s
                 else None
             ),
             "load_during_handoff": to_load,
@@ -3089,6 +3132,7 @@ def _bench_reshard_live(np):
     out: dict = {"cpu_cores": os.cpu_count()}
     base = pathlib.Path(tempfile.mkdtemp(prefix="pw-reshard-live-"))
     prior_secret = os.environ.get("PATHWAY_DCN_SECRET")
+    prior_fleet = os.environ.get("PATHWAY_FLEET_MEMBERS")
     job_secret = prior_secret or secrets.token_hex(16)
     os.environ["PATHWAY_DCN_SECRET"] = job_secret
     _tracer_was = _tracing.get_tracer().enabled
@@ -3097,6 +3141,7 @@ def _bench_reshard_live(np):
     sup_threads: list = []
     routers: list = []
     writer = None
+    mon_server = None
     try:
         # ---- leg A: mesh resize 2 -> 3 --------------------------------
         mbase = base / "mesh"
@@ -3334,6 +3379,25 @@ def _bench_reshard_live(np):
             ["http://127.0.0.1:%d" % port0], health_interval_ms=200
         ).start()
         routers.append(router)
+        # Fleet Lens: a monitoring server in the bench process serves
+        # /fleet/events over the live member map — the per-transition
+        # reshard windows below are computed from that surface alone
+        # (journal edges), then checked against the stopwatch
+        from pathway_tpu.internals.monitoring_server import (
+            start_http_server,
+        )
+        from pathway_tpu.observability.fleet import window_from_events
+
+        fleet_members = {"member0": "http://127.0.0.1:%d" % port0}
+
+        def _set_fleet_env():
+            os.environ["PATHWAY_FLEET_MEMBERS"] = ",".join(
+                "%s=%s" % (n, u) for n, u in fleet_members.items()
+            )
+
+        _set_fleet_env()
+        mon_server = start_http_server(None, port=0)
+        mon_port = mon_server.server_address[1]
         load_s = 75.0
         load_result: dict = {}
         load_t = threading.Thread(
@@ -3361,6 +3425,7 @@ def _bench_reshard_live(np):
         for phase_name, n_shards in (("split_1_to_3", 3),
                                      ("merge_3_to_2", 2)):
             t0 = time.monotonic()
+            wall_t0 = time.time()
             (sbase / "RESHARD").write_text(str(n_shards))
             ports = [free_dcn_port(1) for _ in range(n_shards)]
             old_members = list(zip(sups[1:], sup_threads[1:]))
@@ -3373,6 +3438,10 @@ def _bench_reshard_live(np):
                         "PATHWAY_REPLICA_SHARD": str(i),
                     },
                 )
+                fleet_members["%s.s%d" % (phase_name, i)] = (
+                    "http://127.0.0.1:%d" % ports[i]
+                )
+            _set_fleet_env()
             wait_ready(ports)
             t_swap = time.monotonic()
             router.swap_shard_map(
@@ -3387,6 +3456,31 @@ def _bench_reshard_live(np):
                     first_200 = time.monotonic()
                     break
                 time.sleep(0.2)
+            # the same window from /fleet/events ALONE: the old map's
+            # config-error (members fence on the writer's new split,
+            # the journal's earliest reshard edge) -> the router's
+            # shard-swap commit record (every member is still alive
+            # here, so the federated fetch sees all journals)
+            fleet_window = None
+            try:
+                evs = requests.get(
+                    "http://127.0.0.1:%d/fleet/events" % mon_port,
+                    timeout=15,
+                ).json()["events"]
+                evs = [
+                    e
+                    for e in evs
+                    if float(e.get("wall") or 0.0) >= wall_t0 - 0.5
+                ]
+                win = window_from_events(
+                    evs,
+                    ["config-error", "writer-reshard"],
+                    ["shard-swap"],
+                )
+                if win is not None:
+                    fleet_window = round(win["seconds"], 2)
+            except Exception:
+                pass
             # retire the superseded members (never member 0 mid-split:
             # it is the stale-serving bridge until the swap lands)
             for m_sup, m_th in old_members:
@@ -3394,11 +3488,18 @@ def _bench_reshard_live(np):
                 m_th.join(timeout=30)
                 sups.remove(m_sup)
                 sup_threads.remove(m_th)
+            stopwatch_s = round(t_swap - t0, 2)
             transitions.append(
                 {
                     "phase": phase_name,
                     "n_shards": n_shards,
-                    "reshard_to_swap_s": round(t_swap - t0, 2),
+                    "reshard_to_swap_s": stopwatch_s,
+                    "window_from_events_s": fleet_window,
+                    "window_agreement": (
+                        round(fleet_window / stopwatch_s, 3)
+                        if fleet_window and stopwatch_s
+                        else None
+                    ),
                     "swap_s": round(swap_s, 3),
                     "post_swap_first_200_s": (
                         round(first_200 - t_swap, 2)
@@ -3425,6 +3526,15 @@ def _bench_reshard_live(np):
             os.environ.pop("PATHWAY_DCN_SECRET", None)
         else:
             os.environ["PATHWAY_DCN_SECRET"] = prior_secret
+        if prior_fleet is None:
+            os.environ.pop("PATHWAY_FLEET_MEMBERS", None)
+        else:
+            os.environ["PATHWAY_FLEET_MEMBERS"] = prior_fleet
+        if mon_server is not None:
+            try:
+                mon_server.shutdown()
+            except Exception:
+                pass
         for leg in ("mesh", "serve"):
             try:
                 (base / leg / "STOP").touch()
@@ -3446,6 +3556,257 @@ def _bench_reshard_live(np):
             except subprocess.TimeoutExpired:
                 writer.kill()
         shutil.rmtree(base, ignore_errors=True)
+
+
+def _bench_obs_overhead(np):
+    """Fleet Lens overhead tier (OBS_r17.json): the observability plane
+    must be free at the tail.  One in-process writer -> 2 replicas ->
+    router plane serves the serve_chaos steady closed loop twice — OFF
+    (no sampler, no scrape) and ON (signal sampler at 1 Hz, incident
+    journal heartbeat, and a 1 Hz ``/fleet/metrics`` federated scrape
+    through the router) — and reports the p99 latency delta.  Target:
+    under 2% (`p99_delta_within_2pct`)."""
+    import secrets
+    import threading
+
+    import requests
+
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.observability import tracing as _tracing
+    from pathway_tpu.observability.journal import record as journal_record
+    from pathway_tpu.observability.journal import reset_journal
+    from pathway_tpu.observability.signals import (
+        SignalSampler,
+        reset_sampler,
+    )
+    from pathway_tpu.parallel import replicate as repl_mod
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+    from pathway_tpu.serving.router import FailoverRouter
+
+    N_DOCS = 4_000
+    workers = 8
+    phase_s = float(os.environ.get("PW_BENCH_OBS_PHASE_S", "20") or 20)
+    warmup_s = 3.0
+    out: dict = {
+        "n_docs": N_DOCS,
+        "workers": workers,
+        "phase_s": phase_s,
+        "cpu_cores": os.cpu_count(),
+    }
+    prior_secret = os.environ.get("PATHWAY_DCN_SECRET")
+    if prior_secret is None:
+        os.environ["PATHWAY_DCN_SECRET"] = secrets.token_hex(16)
+    # the tier isolates the sampler+journal+scrape cost: spans off,
+    # like every other serving load phase on the smoke box
+    _tracer_was = _tracing.get_tracer().enabled
+    _tracing.get_tracer().enabled = False
+    reset_sampler()
+    reset_journal()
+
+    class _Index:
+        def __init__(self):
+            self.d = {}
+
+        def keys(self):
+            return list(self.d)
+
+        def upsert(self, key, data, meta):
+            self.d[int(key)] = data
+
+        def remove(self, key):
+            self.d.pop(int(key), None)
+
+        def search(self, triples):
+            keys = sorted(self.d)
+            return [
+                tuple((kk, 1.0) for kk in keys[: int(k)])
+                for _q, k, _f in triples
+            ]
+
+    srv = DeltaStreamServer(0)
+    reps = []
+    router = None
+    stop = threading.Event()
+    try:
+        srv.publish(
+            0,
+            [
+                DiffBatch.from_rows(
+                    [(i, 1, ("doc %d" % i, None)) for i in range(N_DOCS)],
+                    ("_data", "_meta"),
+                )
+            ],
+        )
+        reps = [
+            ReplicaServer(
+                replica_id=i,
+                index_factory=_Index,
+                writer_port=srv.port,
+            ).start()
+            for i in range(2)
+        ]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not all(
+            r.ready for r in reps
+        ):
+            time.sleep(0.1)
+        router = FailoverRouter(
+            ["http://127.0.0.1:%d" % r.http_port for r in reps],
+            health_interval_ms=500,
+        ).start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(
+            ep.ready for ep in router.endpoints
+        ):
+            time.sleep(0.1)
+
+        # a slow trickle keeps deltas flowing (the staleness / shed
+        # signals have something to read) without dominating the load
+        def trickle():
+            tick = 1
+            while not stop.wait(1.0):
+                try:
+                    srv.publish(
+                        tick,
+                        [
+                            DiffBatch.from_rows(
+                                [(N_DOCS + tick, 1,
+                                  ("doc %d" % (N_DOCS + tick), None))],
+                                ("_data", "_meta"),
+                            )
+                        ],
+                    )
+                    tick += 1
+                except Exception:
+                    return
+
+        threading.Thread(target=trickle, daemon=True).start()
+        _serve_chaos_load_phase(np, router.port, workers, warmup_s, N_DOCS)
+
+        # Alternating OFF/ON rounds; the reported delta is the MEDIAN
+        # of the per-round-pair deltas — a single pair on a core-bound
+        # smoke box is dominated by scheduler noise, three pairs are
+        # not (drift hits both arms of a pair equally)
+        rounds = int(os.environ.get("PW_BENCH_OBS_ROUNDS", "3") or 3)
+        scrape_counts = {"ok": 0, "failed": 0}
+        sample_total = 0
+        pairs = []
+        off_lat: list = []
+        on_lat: list = []
+
+        def run_off():
+            # arm OFF: no sampler thread, no scrape
+            return _serve_chaos_load_phase(
+                np, router.port, workers, phase_s, N_DOCS,
+                samples_out=off_lat,
+            )
+
+        def run_on():
+            # arm ON: 1 Hz sampler + journal heartbeat + 1 Hz federated
+            # /fleet/metrics scrape through the router
+            nonlocal sample_total
+            sampler = SignalSampler(interval_s=1.0)
+            sampler.start()
+            scrape_stop = threading.Event()
+
+            def scraper():
+                url = "http://127.0.0.1:%d/fleet/metrics" % router.port
+                sess = requests.Session()
+                while not scrape_stop.wait(1.0):
+                    try:
+                        r = sess.get(url, timeout=5)
+                        scrape_counts[
+                            "ok" if r.status_code == 200 else "failed"
+                        ] += 1
+                    except Exception:
+                        scrape_counts["failed"] += 1
+                    journal_record(
+                        "obs-heartbeat", "overhead bench scrape tick"
+                    )
+
+            scrape_t = threading.Thread(target=scraper, daemon=True)
+            scrape_t.start()
+            try:
+                return _serve_chaos_load_phase(
+                    np, router.port, workers, phase_s, N_DOCS,
+                    samples_out=on_lat,
+                )
+            finally:
+                scrape_stop.set()
+                scrape_t.join(timeout=10)
+                sample_total += sampler.snapshot()["samples"]
+                sampler.stop()
+
+        for r in range(rounds):
+            # alternate arm order per round: any monotonic drift over
+            # the run (allocator state, corpus trickle) would otherwise
+            # land entirely on whichever arm always runs second
+            if r % 2 == 0:
+                off, on = run_off(), run_on()
+            else:
+                on, off = run_on(), run_off()
+            pairs.append({"off": off, "on": on})
+
+        out["rounds"] = pairs
+        out["fleet_scrapes"] = dict(scrape_counts)
+        out["signal_samples"] = sample_total
+        out["p99_delta_per_round_pct"] = [
+            round(
+                (p["on"]["p99_ms"] - p["off"]["p99_ms"])
+                / p["off"]["p99_ms"]
+                * 100,
+                2,
+            )
+            for p in pairs
+            if p["off"].get("p99_ms") and p["on"].get("p99_ms")
+        ]
+        if off_lat and on_lat:
+            # the headline delta pools every served latency per arm
+            # across the alternating rounds — the only estimator whose
+            # p99 is stable on a core-bound smoke box
+            p99_off = float(np.percentile(off_lat, 99))
+            p99_on = float(np.percentile(on_lat, 99))
+            delta = (p99_on - p99_off) / p99_off
+            out["pooled_p99_off_ms"] = round(p99_off, 3)
+            out["pooled_p99_on_ms"] = round(p99_on, 3)
+            out["pooled_p50_off_ms"] = round(
+                float(np.percentile(off_lat, 50)), 3
+            )
+            out["pooled_p50_on_ms"] = round(
+                float(np.percentile(on_lat, 50)), 3
+            )
+            out["p99_delta_pct"] = round(delta * 100, 2)
+            out["p99_delta_within_2pct"] = bool(delta < 0.02)
+        out["error_served_total"] = sum(
+            p[a].get("error_served", 1)
+            for p in pairs
+            for a in ("off", "on")
+        )
+        return out
+    finally:
+        stop.set()
+        if router is not None:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        for r in reps:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        try:
+            srv.close()
+        except Exception:
+            pass
+        try:
+            repl_mod.reset_publisher()
+        except Exception:
+            pass
+        _tracing.get_tracer().enabled = _tracer_was
+        if prior_secret is None:
+            os.environ.pop("PATHWAY_DCN_SECRET", None)
 
 
 def _bench_generate_serve(np):
@@ -4031,6 +4392,21 @@ if __name__ == "__main__":
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "SERVE_r15.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["obs_overhead"]:
+        # Fleet Lens overhead tier (ISSUE 17 acceptance artifact):
+        # sampler + journal + 1 Hz federated scrape vs bare serving —
+        # the p99 delta must stay under 2%
+        import numpy as _np
+
+        _obs = _bench_obs_overhead(_np)
+        _doc = {"tier": "obs_overhead", **_obs}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "OBS_r17.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

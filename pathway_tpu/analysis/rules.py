@@ -985,6 +985,95 @@ def compile_boundary(facts: GraphFacts) -> Iterable[Diagnostic]:
         )
 
 
+# ---------------------------------------------------------------------------
+# 8. observability coverage (Fleet Lens)
+
+
+@rule("observability-coverage")
+def observability_coverage(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """A replicated or sharded plane nobody can see: takeovers, ejections
+    and reshards leave no record, and the first debugging tool arrives
+    AFTER the incident.  WARNING when a replicated/sharded serving plane
+    runs in this process with no monitoring server armed (no /metrics,
+    /debug/signals, /debug/events, /fleet/* — and no signal sampler or
+    crash hooks, which arming installs) or with tracing disabled (the
+    stitched /fleet/trace view cannot cross this member); INFO when the
+    signal sampler runs but no ``PATHWAY_SLO_*`` target is declared —
+    burn rates have nothing to burn against."""
+    import os
+
+    from pathway_tpu.internals import monitoring_server
+    from pathway_tpu.observability.signals import (
+        get_sampler,
+        signals_enabled,
+        slo_targets,
+    )
+    from pathway_tpu.observability.tracing import get_tracer
+    from pathway_tpu.serving.router import shard_map_from_env
+
+    replicas = [
+        u
+        for u in os.environ.get("PATHWAY_SERVING_REPLICAS", "").split(",")
+        if u.strip()
+    ]
+    try:
+        shard_map = shard_map_from_env()
+    except ValueError:
+        shard_map = None
+    replicated = bool(
+        replicas or shard_map or os.environ.get("PATHWAY_REPL_PORT", "")
+    )
+    if replicated:
+        with monitoring_server._servers_lock:
+            armed = bool(monitoring_server._servers)
+        if not armed:
+            yield Diagnostic(
+                "observability-coverage",
+                Severity.WARNING,
+                "replicated/sharded serving plane with no monitoring "
+                "server armed in this process: no /metrics scrape, no "
+                "SLO signal rings, no incident journal endpoint, no "
+                "postmortem crash hooks — the fleet's failure story "
+                "goes unrecorded",
+                None,
+                fix_hint="call "
+                "pathway_tpu.internals.monitoring_server."
+                "start_http_server() (pw.run(with_http_server=True)); "
+                "arming it also starts the signal sampler and installs "
+                "the crash hooks",
+                data={
+                    "replicas": len(replicas),
+                    "shards": len(shard_map or []),
+                },
+            )
+        if not get_tracer().enabled:
+            yield Diagnostic(
+                "observability-coverage",
+                Severity.WARNING,
+                "tracing is disabled (PATHWAY_TRACING=0) on a "
+                "replicated/sharded plane: the stitched /fleet/trace "
+                "view cannot cross this member, so a slow request's "
+                "router -> replica -> writer path is invisible",
+                None,
+                fix_hint="unset PATHWAY_TRACING (default on) — the "
+                "span ring is bounded and costs microseconds per hop",
+            )
+    if (get_sampler() is not None or signals_enabled()) and not slo_targets():
+        yield Diagnostic(
+            "observability-coverage",
+            Severity.INFO,
+            "the SLO signal sampler is armed but no PATHWAY_SLO_* "
+            "target is declared: signal rings fill, burn rates have "
+            "nothing to burn against, and /debug/signals reports "
+            "trends without verdicts",
+            None,
+            fix_hint="declare targets, e.g. PATHWAY_SLO_SHED_RATE=0.01 "
+            "PATHWAY_SLO_TTFT_P99_MS=500 PATHWAY_SLO_STALENESS_S=5 "
+            "(see README 'Observability' for the full signal "
+            "inventory)",
+        )
+
+
 @rule("graph-stats")
 def graph_stats(facts: GraphFacts) -> Iterable[Diagnostic]:
     """One INFO report: node counts per type, exchange edges, estimated

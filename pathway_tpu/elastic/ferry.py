@@ -295,7 +295,42 @@ def ferry_files(
     Returns stats: segments offered/sent/skipped (resume hits) and
     bytes sent.  The Fault Forge ``kill=ferry:N`` directive fires on
     the deterministic sent-segment counter — BEFORE the commit frame,
-    so an injected death always leaves a rollback-able transfer."""
+    so an injected death always leaves a rollback-able transfer.
+
+    The whole transfer is a ``ferry.transfer`` root span (Fleet Lens:
+    the previously-untraced hop of a reshard), carrying the resume
+    arithmetic as attributes."""
+    from pathway_tpu.observability.tracing import get_tracer
+
+    with get_tracer().span(
+        "ferry.transfer",
+        root=True,
+        transfer_id=transfer_id,
+        segments=len(files),
+    ) as span:
+        stats = _ferry_files(
+            host,
+            port,
+            files,
+            transfer_id=transfer_id,
+            connect_timeout=connect_timeout,
+            commit=commit,
+        )
+        span.set_attribute("segments_sent", stats["segments_sent"])
+        span.set_attribute("segments_resumed", stats["segments_resumed"])
+        span.set_attribute("bytes_sent", stats["bytes_sent"])
+        return stats
+
+
+def _ferry_files(
+    host: str,
+    port: int,
+    files: list[tuple[str, bytes]],
+    *,
+    transfer_id: str,
+    connect_timeout: float = 30.0,
+    commit: bool = True,
+) -> dict[str, Any]:
     from pathway_tpu.testing import faults
 
     key = _job_key()

@@ -382,6 +382,23 @@ def reshard_stores(
     # and the driving handover (supervisor resize / TwoPhaseHandover)
     # still brackets the whole thing.
     tid = transfer_id or f"reshard-{n_old}to{n_new}-g{new_gen}"
+    # Fleet Lens: reshard phase transitions land in the incident journal
+    # (persisted — peers reconstruct a SIGKILLed rank's reshard from
+    # these), and /fleet/events derives the reshard window from
+    # reshard-transfer -> reshard-commit
+    from pathway_tpu.observability.journal import record as journal_record
+
+    journal_record(
+        "reshard-transfer",
+        f"{n_old} -> {n_new} ranks (generation {new_gen})",
+        persist=True,
+        n_old=n_old,
+        n_new=n_new,
+        generation=new_gen,
+        group_time=group_time,
+        moved_rows=moved_rows,
+        bytes_ferried=bytes_moved,
+    )
     ferry_stats: list[dict] = []
     dsts = [FilesystemStore(root) for root in new_roots]
     for p, dst in enumerate(dsts):
@@ -453,6 +470,15 @@ def reshard_stores(
         import shutil as _shutil
 
         _shutil.rmtree(dst._path("reshard/inbox"), ignore_errors=True)
+    journal_record(
+        "reshard-commit",
+        f"{n_old} -> {n_new} ranks committed (generation {new_gen})",
+        persist=True,
+        n_old=n_old,
+        n_new=n_new,
+        generation=new_gen,
+        bytes_ferried=bytes_moved,
+    )
     return {
         "plan": {
             "n_old": n_old,

@@ -722,6 +722,7 @@ class SegmentRunner:
             out = self._run_compiled(t, batch, inputs)
         except NotCompilable as nc:
             _metrics()[3].labels(nc.reason[:60]).inc()
+            self._journal_fallback(t, nc.reason[:60])
             return self._interpret(t, inputs)
         except Exception:
             # any real failure disables the segment permanently: the
@@ -735,11 +736,36 @@ class SegmentRunner:
             )
             self.broken = True
             _metrics()[3].labels("error").inc()
+            self._journal_fallback(t, "error", permanent=True)
             return self._interpret(t, inputs)
         if out is None:
             return self._interpret(t, inputs)
         self.compiled_ticks += 1
         return out
+
+    def _journal_fallback(
+        self, t: int, reason: str, permanent: bool = False
+    ) -> None:
+        """Incident-journal a compiled-segment fallback ONCE per
+        (segment, reason) — the fallback counter ticks every tick, the
+        journal records the state transition."""
+        seen = getattr(self, "_journaled_reasons", None)
+        if seen is None:
+            seen = self._journaled_reasons = set()
+        if reason in seen:
+            return
+        seen.add(reason)
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            "compile-fallback",
+            f"segment {self.seg_id} fell back to the interpreter "
+            f"({reason})",
+            tick=t,
+            segment=self.seg_id,
+            reason=reason,
+            permanent=permanent,
+        )
 
     # --- paths ------------------------------------------------------------
 

@@ -520,6 +520,22 @@ class DecodeScheduler:
             self._waiting = [
                 r for r in self._waiting if r.deadline >= now
             ]
+        if dead:
+            # Fleet Lens: a mid-decode deadline drop is an incident (a
+            # client saw a 504 after tokens had already been minted) —
+            # one journal event per sweep, not per sequence
+            from pathway_tpu.observability.journal import (
+                record as journal_record,
+            )
+
+            journal_record(
+                "mid-decode-drop",
+                f"{len(dead)} generation(s) dropped mid-decode by "
+                "deadline propagation",
+                replica=self.label,
+                dropped=len(dead),
+                tokens_lost=sum(len(s.generated) for s in dead),
+            )
         for s in dead:
             self._m_dropped.inc()
             self._finish_seq(

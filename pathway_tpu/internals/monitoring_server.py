@@ -10,7 +10,11 @@ BENCH_r05 hung-probe investigation couldn't: ``/debug/threads``
 (all-thread stack dump), ``/debug/graph`` (per-node rows/ns/backlog as
 JSON), ``/debug/profile?seconds=N`` (on-demand jax profiler trace),
 ``/debug/trace?seconds=N`` (the Trace Weaver span ring as Chrome
-trace-event JSON, loadable in Perfetto).
+trace-event JSON, loadable in Perfetto), ``/debug/signals`` (Fleet Lens
+SLO signal rings + burn rates; ``?series=N`` includes trailing points),
+and ``/debug/events`` (the incident journal). Arming the server also
+arms the per-process signal sampler (disable with ``PATHWAY_SIGNALS=0``)
+and installs the crash hooks that write the postmortem bundle.
 
 Bind host comes from PATHWAY_MONITORING_HOST (default 127.0.0.1 — set
 0.0.0.0 for multi-host scrape); a taken port falls back to an ephemeral
@@ -206,8 +210,19 @@ def start_http_server(
     if runtime is not None:
         bridge.attach(runtime)
     install_jax_metrics(REGISTRY)
+    # Fleet Lens: a monitored process samples its own SLO signals and
+    # keeps an incident journal with crash hooks — both opt-out
+    # (PATHWAY_SIGNALS=0) and cheap when idle
+    from pathway_tpu.observability.journal import install_crash_hooks
+    from pathway_tpu.observability.signals import arm_sampler
+
+    arm_sampler()
+    install_crash_hooks()
     with _servers_lock:
-        existing = _servers.get((host, port))
+        # port 0 asks for a FRESH ephemeral server (multi-member fleet
+        # drivers start several in one process) — only canonical ports
+        # participate in the reuse registry
+        existing = _servers.get((host, port)) if port else None
         if existing is not None and existing.socket.fileno() == -1:
             # closed without going through the shutdown wrapper
             del _servers[(host, port)]
@@ -275,6 +290,16 @@ def start_http_server(
                     self._profile(parse_qs(parsed.query))
                 elif route == "/debug/trace":
                     self._trace(parse_qs(parsed.query))
+                elif route == "/debug/signals":
+                    self._signals(parse_qs(parsed.query))
+                elif route == "/debug/events":
+                    self._events(parse_qs(parsed.query))
+                elif route in (
+                    "/fleet/metrics",
+                    "/fleet/events",
+                    "/fleet/trace",
+                ):
+                    self._fleet(route, parse_qs(parsed.query))
                 else:
                     self._reply(404, b"not found")
             except BrokenPipeError:
@@ -309,6 +334,114 @@ def start_http_server(
             self._reply(
                 200, json.dumps(doc).encode(), "application/json"
             )
+
+        def _signals(self, query: dict) -> None:
+            """Fleet Lens SLO signal rings (observability/signals.py):
+            the feed the autoscaler consumes. ``series=N`` includes the
+            trailing N ring points per signal."""
+            from pathway_tpu.observability.signals import get_sampler
+
+            sampler = get_sampler()
+            if sampler is None:
+                self._reply(
+                    200,
+                    json.dumps(
+                        {"enabled": False, "signals": {}, "slo": {}}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            raw = query.get("series", ["0"])[0]
+            try:
+                series_points = int(raw)
+            except ValueError:
+                self._reply(400, b"series must be an integer")
+                return
+            snap = sampler.snapshot(series_points=series_points)
+            snap["enabled"] = True
+            self._reply(200, json.dumps(snap).encode(), "application/json")
+
+        def _events(self, query: dict) -> None:
+            """Incident journal (observability/journal.py). ``kind=a,b``
+            filters; ``n=N`` caps at the trailing N events."""
+            from pathway_tpu.observability.journal import journal
+
+            j = journal()
+            kinds_raw = query.get("kind", [""])[0]
+            kinds = (
+                [k for k in kinds_raw.split(",") if k] or None
+            )
+            events = j.events(kinds=kinds)
+            raw = query.get("n", ["0"])[0]
+            try:
+                n = int(raw)
+            except ValueError:
+                self._reply(400, b"n must be an integer")
+                return
+            if n > 0:
+                events = events[-n:]
+            self._reply(
+                200,
+                json.dumps(
+                    {"member": j.member, "events": events}
+                ).encode(),
+                "application/json",
+            )
+
+        def _fleet(self, route: str, query: dict) -> None:
+            """Fleet Lens federation over PATHWAY_FLEET_MEMBERS (the
+            group supervisor stamps the rank -> monitoring-port map into
+            every rank's env): one member-labeled exposition, one merged
+            incident timeline, one stitched cross-member trace."""
+            from pathway_tpu.observability.fleet import (
+                federate_events,
+                federate_metrics,
+                members_from_env,
+                stitch_traces,
+            )
+            from pathway_tpu.observability.journal import journal
+
+            members = members_from_env()
+            me = journal().member
+            # this process serves its own view inline — a member entry
+            # naming OUR port would double-count us in the merge
+            port = self.server.server_address[1]
+
+            def _is_self(u: str) -> bool:
+                p = urlparse(u)
+                return p.port == port and p.hostname in (
+                    "127.0.0.1", "localhost", host,
+                )
+
+            members = [(n, u) for n, u in members if not _is_self(u)]
+            if route == "/fleet/metrics":
+                # fetch errors are already encoded in the body as
+                # pathway_fleet_member_up{member=...} 0
+                text, _errors = federate_metrics(
+                    members, local=(me, _render_metrics(current_runtime()))
+                )
+                self._reply(
+                    200, text.encode(), "text/plain; version=0.0.4"
+                )
+            elif route == "/fleet/events":
+                merged = federate_events(
+                    members, local=journal().events()
+                )
+                self._reply(
+                    200, json.dumps(merged).encode(), "application/json"
+                )
+            else:
+                from pathway_tpu.observability.tracing import get_tracer
+
+                trace_id = query.get("trace_id", [""])[0] or None
+                doc = stitch_traces(
+                    members,
+                    trace_id=trace_id,
+                    local=(me, get_tracer().chrome_trace()),
+                )
+                self._reply(
+                    200, json.dumps(doc).encode(), "application/json"
+                )
 
         def _profile(self, query: dict) -> None:
             try:
@@ -351,11 +484,17 @@ def start_http_server(
         )
     server._pw_set_runtime = set_runtime  # type: ignore[attr-defined]
     real_shutdown = server.shutdown
+    # canonical asks key by the REQUESTED port (the next run asking for
+    # that port reuses this server even when a foreign process forced
+    # the ephemeral fallback); a requested port of 0 keys by the BOUND
+    # port instead, so it stays visible to the doctor's armed check but
+    # can never be handed to a second port-0 caller
+    reg_key = (host, port or server.server_address[1])
 
     def shutdown_and_deregister() -> None:
         with _servers_lock:
-            if _servers.get((host, port)) is server:
-                del _servers[(host, port)]
+            if _servers.get(reg_key) is server:
+                del _servers[reg_key]
         real_shutdown()
         # shutdown() only stops serve_forever; the listening socket
         # would stay bound and its backlog would swallow scrapes of the
@@ -364,10 +503,7 @@ def start_http_server(
 
     server.shutdown = shutdown_and_deregister  # type: ignore[method-assign]
     with _servers_lock:
-        # keyed by the REQUESTED port: the next run asking for the
-        # canonical port reuses this server even when a foreign process
-        # forced the ephemeral fallback
-        _servers[(host, port)] = server
+        _servers[reg_key] = server
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     if runtime is not None:

@@ -8,8 +8,14 @@ the debug surfaces (``/debug/threads``, ``/debug/graph``,
 ``/debug/profile``, ``/debug/trace``). The Trace Weaver
 (``observability/tracing.py``) adds end-to-end request tracing on top:
 a built-in span ring buffer with W3C traceparent propagation across
-every serving hop and the host mesh. See README "Observability" for the
-metric inventory, scrape config, and tracing guide.
+every serving hop and the host mesh. Fleet Lens (PR 17) extends the
+plane fleet-wide: SLO signal rings (``observability/signals.py``,
+``/debug/signals``), the crash-surviving incident journal
+(``observability/journal.py``, ``/debug/events``), and federation
+(``observability/fleet.py``: ``/fleet/metrics``, ``/fleet/events``,
+``/fleet/trace`` on the router). See README "Observability" for the
+metric inventory, signal/SLO knobs, journal event schema, and tracing
+guide.
 """
 
 from pathway_tpu.observability.registry import (
@@ -34,6 +40,28 @@ from pathway_tpu.observability.debug import (
     thread_stack_dump,
 )
 from pathway_tpu.observability.jax_metrics import install_jax_metrics
+from pathway_tpu.observability.journal import (
+    IncidentJournal,
+    JournalEvent,
+    install_crash_hooks,
+    journal,
+    reset_journal,
+)
+from pathway_tpu.observability.signals import (
+    SignalRing,
+    SignalSampler,
+    arm_sampler,
+    get_sampler,
+    reset_sampler,
+    slo_targets,
+)
+from pathway_tpu.observability.fleet import (
+    federate_events,
+    federate_metrics,
+    members_from_env,
+    stitch_traces,
+    window_from_events,
+)
 from pathway_tpu.observability.tracing import (
     SpanContext,
     Tracer,
@@ -49,23 +77,39 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "IncidentJournal",
+    "JournalEvent",
     "MetricsRegistry",
     "ProfilerUnavailable",
+    "SignalRing",
+    "SignalSampler",
     "SpanContext",
     "Tracer",
+    "arm_sampler",
     "current_traceparent",
     "escape_label_value",
+    "federate_events",
+    "federate_metrics",
+    "members_from_env",
     "get_registry",
+    "get_sampler",
     "get_tracer",
     "graph_table",
+    "install_crash_hooks",
     "install_jax_metrics",
+    "journal",
     "log_linear_buckets",
     "otel_sdk_provider_active",
     "parse_exposition",
     "parse_traceparent",
+    "reset_journal",
+    "reset_sampler",
     "sanitize_metric_name",
+    "slo_targets",
+    "stitch_traces",
     "take_profile",
     "thread_stack_dump",
     "validate_chrome_trace",
     "validate_exposition",
+    "window_from_events",
 ]

@@ -85,6 +85,8 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from pathway_tpu.observability.journal import record as _journal_record
+from pathway_tpu.observability.tracing import get_tracer
 from pathway_tpu.parallel import wire
 from pathway_tpu.parallel.host_exchange import (
     _MAC_LEN,
@@ -403,6 +405,16 @@ class DeltaStreamServer:
             n_new,
             self.incarnation,
         )
+        _journal_record(
+            "writer-reshard",
+            f"shard map {old} -> {n_new}",
+            tick=self._newest,
+            incarnation=self.incarnation,
+            persist=True,
+            old_shards=old,
+            new_shards=n_new,
+            subscribers_dropped=len(subs),
+        )
         return {"old": old, "new": n_new, "incarnation": self.incarnation}
 
     @staticmethod
@@ -421,6 +433,10 @@ class DeltaStreamServer:
         ring and fan out per shard.  Engine-thread hot path:
         O(subscribers) queue puts, no I/O (sender threads own the
         sockets)."""
+        with get_tracer().span("repl.publish", tick=tick):
+            self._publish(tick, batches)
+
+    def _publish(self, tick: int, batches: list) -> None:
         per_shard = self._split_shards(batches)
         fresh_tick = False
         with self._lock:
@@ -504,6 +520,14 @@ class DeltaStreamServer:
                 "delta stream: dropped replica %d subscription (%s)",
                 sub.replica_id,
                 reason,
+            )
+            _journal_record(
+                "sub-dropped",
+                reason,
+                tick=self._newest,
+                incarnation=self.incarnation,
+                replica_id=sub.replica_id,
+                shard=sub.shard,
             )
         try:
             sub.outbox.put_nowait(None)  # sender exit sentinel
@@ -860,10 +884,22 @@ class DeltaStreamClient:
             return max(0.0, time.monotonic() - self._fresh_at)
 
     def _note_progress(self) -> None:
+        became_fresh = False
         with self._lock:
             if self.newest_known <= self.applied_tick:
+                became_fresh = not self.caught_up
                 self.caught_up = True
                 self._fresh_at = time.monotonic()
+        if became_fresh:
+            # the takeover/reshard window's END edge in /fleet/events:
+            # this subscription reached the stream head
+            _journal_record(
+                "caught-up",
+                f"replica {self.replica_id} reached the stream head",
+                tick=self.applied_tick,
+                incarnation=max(self.writer_incarnation, 0),
+                replica_id=self.replica_id,
+            )
 
     # --- lifecycle --------------------------------------------------------
 
@@ -1043,6 +1079,17 @@ class DeltaStreamClient:
                 self._conn = None
                 # reconnect from whatever we applied last
                 self.from_tick = self.applied_tick
+                if not self._closed:
+                    # the takeover window's START edge in /fleet/events:
+                    # replicas see the writer's death as stream EOF
+                    # within milliseconds of the kill
+                    _journal_record(
+                        "stream-disconnect",
+                        f"replica {self.replica_id} lost the delta stream",
+                        tick=self.applied_tick,
+                        incarnation=max(self.writer_incarnation, 0),
+                        replica_id=self.replica_id,
+                    )
 
     def _read_stream(self, conn: socket.socket) -> None:
         recv_seq = 0
@@ -1087,13 +1134,35 @@ class DeltaStreamClient:
                         self.writer_incarnation,
                         self.endpoints[self._ep_idx % len(self.endpoints)],
                     )
+                    # persist=True: this record is how a SIGKILLed zombie
+                    # is reconstructed from its peers' journals
+                    _journal_record(
+                        "zombie-fenced",
+                        f"writer incarnation {srv_inc} < "
+                        f"{self.writer_incarnation}",
+                        tick=self.applied_tick,
+                        incarnation=self.writer_incarnation,
+                        persist=True,
+                        replica_id=self.replica_id,
+                        zombie_incarnation=srv_inc,
+                    )
                     self._ep_idx += 1
                     time.sleep(0.2)  # a persistent zombie must not
                     # hot-loop dial->fence->dial
                     return
                 with self._lock:
+                    prev_inc = self.writer_incarnation
                     self.writer_incarnation = max(
                         self.writer_incarnation, srv_inc
+                    )
+                if srv_inc > max(prev_inc, 0):
+                    _journal_record(
+                        "incarnation-seen",
+                        f"writer incarnation {prev_inc} -> {srv_inc}",
+                        tick=self.applied_tick,
+                        incarnation=srv_inc,
+                        replica_id=self.replica_id,
+                        previous=prev_inc,
                     )
                 torn = (
                     self.expect_shards and srv_shards != self.expect_shards
@@ -1132,6 +1201,15 @@ class DeltaStreamClient:
 
                     logging.getLogger("pathway_tpu").error(
                         "replica %d: %s", self.replica_id, self.config_error
+                    )
+                    _journal_record(
+                        "config-error",
+                        self.config_error,
+                        tick=self.applied_tick,
+                        incarnation=max(self.writer_incarnation, 0),
+                        replica_id=self.replica_id,
+                        writer_shards=srv_shards,
+                        expected_shards=self.expect_shards,
                     )
                     time.sleep(0.5)
                     return
@@ -1181,7 +1259,13 @@ class DeltaStreamClient:
                 # idempotent state ops, so re-applying is safe and
                 # skipping would lose the merged tail
                 try:
-                    self.on_deltas(tick, batches)
+                    with get_tracer().span(
+                        "repl.apply",
+                        tick=tick,
+                        replica_id=self.replica_id,
+                        batches=len(batches),
+                    ):
+                        self.on_deltas(tick, batches)
                 except Exception:
                     # an apply failure must not kill the reader thread
                     # (the replica would zombie: alive, serving ever-

@@ -178,6 +178,19 @@ class StandbyWriter:
 
     def _event(self, kind: str, detail: str) -> None:
         self.events.append((time.monotonic(), kind, detail))
+        # mirror into the Fleet Lens incident journal: standby-start /
+        # failure-notified / takeover are exactly the records peers use
+        # to reconstruct a SIGKILLed primary's death (persist=True — a
+        # takeover record must survive the standby dying right after)
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            f"standby-{kind}" if not kind.startswith("standby") else kind,
+            detail,
+            tick=self.applied_tick if self.applied_tick >= 0 else None,
+            incarnation=self.seen_incarnation,
+            persist=kind in ("takeover", "failure-notified"),
+        )
 
     def start(self) -> "StandbyWriter":
         self._client = DeltaStreamClient(
@@ -268,6 +281,14 @@ class StandbyWriter:
             if self.took_over or self._closed:
                 return
             self.took_over = True
+        from pathway_tpu.observability.tracing import get_tracer
+
+        with get_tracer().span(
+            "standby.takeover", root=True, reason=reason
+        ):
+            self._takeover_locked(reason)
+
+    def _takeover_locked(self, reason: str) -> None:
         self.takeover_count += 1
         inc = self.seen_incarnation + 1
         self.takeover_incarnation = inc

@@ -149,8 +149,21 @@ class GroupSupervisor:
 
     def _event(self, kind: str, detail: str) -> None:
         self.events.append((time.monotonic(), kind, detail))
+        # mirror into the Fleet Lens incident journal — rank-died /
+        # group-restart / group-resize are the supervisor's side of the
+        # fleet timeline (rank-died persists: it is a peer's record of a
+        # SIGKILLed member)
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            f"group-{kind}" if not kind.startswith(("group", "rank")) else kind,
+            detail,
+            persist=kind in ("rank-died", "gave-up", "resize-rollback"),
+        )
 
     def _spawn_group(self, incarnation: int) -> list[subprocess.Popen]:
+        from pathway_tpu.internals.monitoring_server import BASE_PORT
+
         procs: list[subprocess.Popen] = []
         for pid in range(self.n):
             env = dict(os.environ)
@@ -158,6 +171,16 @@ class GroupSupervisor:
             env["PATHWAY_PROCESSES"] = str(self.n)
             env["PATHWAY_PROCESS_ID"] = str(pid)
             env["PATHWAY_MESH_INCARNATION"] = str(incarnation)
+            # Fleet Lens: every rank knows the whole group's monitoring
+            # ports, so ANY rank's /fleet/* federates the group (an
+            # explicit member map wins)
+            env.setdefault(
+                "PATHWAY_FLEET_MEMBERS",
+                ",".join(
+                    f"rank-{i}=http://127.0.0.1:{BASE_PORT + i}"
+                    for i in range(self.n)
+                ),
+            )
             if self.rank_env is not None:
                 env.update(self.rank_env(pid) or {})
             stdout = None

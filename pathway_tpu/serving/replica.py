@@ -53,6 +53,9 @@ from typing import Any, Callable
 
 import numpy as np
 
+from pathway_tpu.observability.journal import record as _journal_record
+from pathway_tpu.observability.tracing import get_tracer
+
 _STALE_AFTER_MS_ENV = "PATHWAY_REPLICA_STALE_AFTER_MS"
 
 
@@ -403,22 +406,35 @@ class ReplicaServer:
         the load, so resident memory is ~1/S of the writer's corpus."""
         if self.store_root is None:
             return self.hydrated_tick
-        got = hydrate_index_state(self._open_store())
-        if got is None:
-            return self.hydrated_tick
-        index_state, tick, gen = got
-        fresh = self.index_factory()
-        kind, payload = index_state
-        if kind == "dict":
-            fresh.load_state(payload)
-        else:
-            fresh = payload
-        if self.shard >= 0:
-            self._filter_to_shard(fresh)
-        with self._index_lock:
-            self.index = fresh
-            self.hydrated_tick = tick
-            self.hydrated_gen = gen
+        with get_tracer().span(
+            "replica.hydrate", root=True, replica=self.replica_id
+        ) as span:
+            got = hydrate_index_state(self._open_store())
+            if got is None:
+                return self.hydrated_tick
+            index_state, tick, gen = got
+            fresh = self.index_factory()
+            kind, payload = index_state
+            if kind == "dict":
+                fresh.load_state(payload)
+            else:
+                fresh = payload
+            if self.shard >= 0:
+                self._filter_to_shard(fresh)
+            with self._index_lock:
+                self.index = fresh
+                self.hydrated_tick = tick
+                self.hydrated_gen = gen
+            span.set_attribute("tick", tick)
+            span.set_attribute("generation", gen)
+        _journal_record(
+            "replica-hydrated",
+            f"replica {self.replica_id} hydrated generation {gen}",
+            tick=tick,
+            incarnation=self.incarnation,
+            replica_id=self.replica_id,
+            generation=gen,
+        )
         return tick
 
     def _filter_to_shard(self, index: Any) -> None:
@@ -460,6 +476,13 @@ class ReplicaServer:
         writer's bounded ring — beyond it, full re-hydrate (tentpole
         contract (c))."""
         self._m_resyncs.inc()
+        _journal_record(
+            "replica-resync",
+            f"replica {self.replica_id} fell off the delta ring",
+            tick=self.applied_tick,
+            incarnation=self.incarnation,
+            replica_id=self.replica_id,
+        )
         return self.hydrate()
 
     # --- live resharding (Shard Flux) -------------------------------------
@@ -535,6 +558,20 @@ class ReplicaServer:
             n_shards,
             prev_shard,
             prev_n,
+        )
+        # the reshard window's member-side edge in /fleet/events
+        _journal_record(
+            "shard-map-adopt",
+            f"replica {self.replica_id} now owns shard {shard}/{n_shards} "
+            f"(was {prev_shard}/{prev_n})",
+            tick=from_tick,
+            incarnation=self.incarnation,
+            persist=True,
+            replica_id=self.replica_id,
+            shard=shard,
+            n_shards=n_shards,
+            prev_shard=prev_shard,
+            prev_n_shards=prev_n,
         )
 
     def _apply_deltas(self, tick: int, batches: list) -> None:
@@ -656,8 +693,60 @@ class _ReplicaHttp:
         async def handle_health(request: web.Request) -> web.Response:
             return web.json_response(srv.health())
 
+        # Fleet Lens: the GET surfaces that make this replica a fleet
+        # member — the router's /fleet/* federation scrapes these
+        async def handle_metrics(request: web.Request) -> web.Response:
+            from pathway_tpu.observability import REGISTRY
+
+            return web.Response(
+                text=REGISTRY.render(), content_type="text/plain"
+            )
+
+        async def handle_events(request: web.Request) -> web.Response:
+            from pathway_tpu.observability.journal import journal
+
+            j = journal()
+            return web.json_response(
+                {"member": j.member, "events": j.events()}
+            )
+
+        async def handle_signals(request: web.Request) -> web.Response:
+            from pathway_tpu.observability.signals import get_sampler
+
+            sampler = get_sampler()
+            if sampler is None:
+                return web.json_response(
+                    {"enabled": False, "signals": {}, "slo": {}}
+                )
+            try:
+                series = int(request.query.get("series", "0"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "series must be an integer"}, status=400
+                )
+            snap = sampler.snapshot(series_points=series)
+            snap["enabled"] = True
+            return web.json_response(snap)
+
+        async def handle_trace(request: web.Request) -> web.Response:
+            from pathway_tpu.observability.tracing import get_tracer as _gt
+
+            try:
+                seconds = float(request.query.get("seconds", "0"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "seconds must be a number"}, status=400
+                )
+            return web.json_response(
+                _gt().chrome_trace(seconds=seconds if seconds > 0 else None)
+            )
+
         app.router.add_post(srv.route, handle_read)
         app.router.add_get("/replica/health", handle_health)
+        app.router.add_get("/metrics", handle_metrics)
+        app.router.add_get("/debug/events", handle_events)
+        app.router.add_get("/debug/signals", handle_signals)
+        app.router.add_get("/debug/trace", handle_trace)
         for path, fn in srv.extra_post_routes.items():
 
             async def handle_extra(request: web.Request, _fn=fn):
@@ -901,6 +990,15 @@ def main() -> int:
         from pathway_tpu.generate.serving import attach_generate
 
         attach_generate(server)
+    # Fleet Lens: the subprocess replica role samples its own SLO
+    # signals (served at /debug/signals) and writes a postmortem bundle
+    # on unhandled exceptions — both opt-out via PATHWAY_SIGNALS=0 /
+    # unset PATHWAY_POSTMORTEM_DIR
+    from pathway_tpu.observability.journal import install_crash_hooks
+    from pathway_tpu.observability.signals import arm_sampler
+
+    arm_sampler()
+    install_crash_hooks()
     server.start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_a: stop.set())

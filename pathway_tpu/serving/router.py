@@ -338,6 +338,15 @@ class FailoverRouter:
         self._m_ejections.labels(ep.name, reason.split(":")[0]).inc()
         import logging
 
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            "router-eject",
+            reason,
+            persist=True,
+            replica=ep.name,
+            shard=ep.shard,
+        )
         logging.getLogger("pathway_tpu").warning(
             "router: ejected %s (%s)", ep.name, reason
         )
@@ -355,6 +364,15 @@ class FailoverRouter:
             ep.eject_reason = ""
         import logging
 
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            "router-readmit",
+            f"fresh at tick {ep.applied_tick}",
+            tick=ep.applied_tick,
+            replica=ep.name,
+            shard=ep.shard,
+        )
         logging.getLogger("pathway_tpu").info(
             "router: re-admitted %s (fresh at tick %d)",
             ep.name,
@@ -566,8 +584,22 @@ class FailoverRouter:
             )
         live = {ep.name for ep in new_eps}
         for name in self._gauge_names - live:
-            # retired series report 0 and drop their object reference
-            self._m_inflight.labels(name).set_function(lambda: 0)
+            # cardinality bound: a retired replica's series is REMOVED,
+            # not zeroed forever — reshard churn must not grow the
+            # exposition without bound (one series per name that ever
+            # existed)
+            self._m_inflight.remove(name)
+        self._gauge_names &= live
+        from pathway_tpu.observability.journal import record as journal_record
+
+        journal_record(
+            "shard-swap",
+            f"{len(shards)} shard(s) x "
+            f"{'/'.join(str(len(m)) for m in shards)} member(s)",
+            persist=True,
+            n_shards=len(shards),
+            members=[len(m) for m in shards],
+        )
 
     async def _swap_async(self, shards: list[list[str]]) -> None:
         known = {ep.url for ep in self.endpoints}
@@ -699,11 +731,75 @@ class FailoverRouter:
             dt_ms = (time.perf_counter() - t0) * 1000.0
             ep.ewma_ms = 0.8 * ep.ewma_ms + 0.2 * dt_ms
 
+    # --- Fleet Lens federation --------------------------------------------
+
+    async def _fleet_get(self, request):
+        """One observability plane for the whole mesh: the router is the
+        process that already knows every member's base URL, so it
+        federates their `/metrics`, `/debug/events` and `/debug/trace`
+        into member-labeled fleet views.  The blocking urllib fetches
+        run on the default executor — the proxy loop keeps serving."""
+        from aiohttp import web
+
+        route = request.path
+        members = [(ep.name, ep.url) for ep in self.endpoints]
+        loop = asyncio.get_event_loop()
+        if route == "/debug/events":
+            from pathway_tpu.observability.journal import journal
+
+            j = journal()
+            return web.json_response(
+                {"member": j.member, "events": j.events()}
+            )
+        if route == "/fleet/metrics":
+            from pathway_tpu.observability import REGISTRY
+            from pathway_tpu.observability.fleet import federate_metrics
+
+            local = ("router", REGISTRY.render())
+            text, errors = await loop.run_in_executor(
+                None, lambda: federate_metrics(members, local=local)
+            )
+            headers = (
+                {"x-pathway-fleet-errors": str(len(errors))}
+                if errors
+                else {}
+            )
+            return web.Response(
+                text=text, content_type="text/plain", headers=headers
+            )
+        if route == "/fleet/events":
+            from pathway_tpu.observability.fleet import federate_events
+            from pathway_tpu.observability.journal import journal
+
+            local = journal().events()
+            merged = await loop.run_in_executor(
+                None, lambda: federate_events(members, local=local)
+            )
+            return web.json_response(merged)
+        # /fleet/trace
+        from pathway_tpu.observability.fleet import stitch_traces
+        from pathway_tpu.observability.tracing import get_tracer
+
+        trace_id = request.query.get("trace_id") or None
+        local = ("router", get_tracer().chrome_trace())
+        data = await loop.run_in_executor(
+            None,
+            lambda: stitch_traces(members, trace_id=trace_id, local=local),
+        )
+        return web.json_response(data)
+
     async def _handle(self, request):
         from aiohttp import web
 
         from pathway_tpu.observability import tracing
 
+        if request.method == "GET" and request.path in (
+            "/fleet/metrics",
+            "/fleet/events",
+            "/fleet/trace",
+            "/debug/events",
+        ):
+            return await self._fleet_get(request)
         body = await request.read()
         deadline = time.monotonic() + self._deadline_budget_s(request)
         max_st = self._max_staleness_ms(request)

@@ -295,6 +295,19 @@ class FaultPlan:
         logging.getLogger("pathway_tpu").warning(
             "fault forge: injected death (%s) on process %d", what, self.pid
         )
+        # Fleet Lens: an injected FAULT_EXIT drops a postmortem bundle
+        # (journal tail + spans + metrics + thread dump) exactly like a
+        # real crash would — chaos runs exercise the forensics path too
+        try:
+            from pathway_tpu.observability.journal import journal
+
+            j = journal()
+            j.record(
+                "fault-exit", f"injected death ({what})", persist=True
+            )
+            j.postmortem(f"fault-exit:{what}")
+        except Exception:
+            pass  # forensics must never block the injected death
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(FAULT_EXIT)

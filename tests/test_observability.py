@@ -1,0 +1,749 @@
+"""Fleet Lens tests — SLO signal rings, the crash-surviving incident
+journal, and fleet federation (/fleet/metrics, /fleet/events,
+/fleet/trace).
+
+Tier-1 coverage of the PR-17 acceptance bars, in-process and fast:
+
+* journal ring semantics, tmp+rename persistence + restore, and the
+  postmortem bundle;
+* signal sampler counter-delta rates, histogram quantiles and SLO burn
+  rates against a synthetic registry;
+* metrics federation: a 3-member plane's merged exposition passes
+  ``validate_exposition`` (member label injected, one HELP/TYPE per
+  family, dead member -> ``pathway_fleet_member_up 0``);
+* event federation: (incarnation, wall, tick)-ordered merge and the
+  ``window_from_events`` takeover/reshard window math the chaos bench
+  now derives its windows from;
+* trace stitching: one trace id cut across router -> replica -> writer
+  documents, Perfetto-loadable (``validate_chrome_trace`` clean);
+* the real writer -> replicas -> router plane serving /fleet/* live;
+* router metric label cardinality bounded across shard-map swaps;
+* the Graph Doctor ``observability-coverage`` rule.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw  # noqa: F401 — parse-graph fixture parity
+
+
+@pytest.fixture(autouse=True)
+def _lens_env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "fleet-lens-test-secret")
+    for var in (
+        "PATHWAY_JOURNAL_PATH",
+        "PATHWAY_JOURNAL_MEMBER",
+        "PATHWAY_POSTMORTEM_DIR",
+        "PATHWAY_FLEET_MEMBERS",
+        "PATHWAY_SERVING_REPLICAS",
+        "PATHWAY_SERVING_SHARD_MAP",
+        "PATHWAY_REPL_PORT",
+        "PATHWAY_MESH_INCARNATION",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    for name in _SLO_VARS:
+        monkeypatch.delenv(name, raising=False)
+    from pathway_tpu.observability.journal import reset_journal
+    from pathway_tpu.observability.signals import reset_sampler
+
+    reset_journal()
+    reset_sampler()
+    yield
+    reset_sampler()
+    reset_journal()
+
+
+_SLO_VARS = (
+    "PATHWAY_SLO_SHED_RATE",
+    "PATHWAY_SLO_TTFT_P99_MS",
+    "PATHWAY_SLO_STALENESS_S",
+    "PATHWAY_SLO_TOK_S",
+)
+
+
+def _wait(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# incident journal
+
+
+def test_journal_ring_bound_filter_and_ordering():
+    from pathway_tpu.observability.journal import IncidentJournal
+
+    j = IncidentJournal(capacity=8, member="m0")
+    for i in range(20):
+        j.record("tick-event", f"e{i}", tick=i, extra=i)
+    evs = j.events()
+    assert len(evs) == 8  # bounded ring
+    assert [e["detail"] for e in evs] == [f"e{i}" for i in range(12, 20)]
+    assert all(e["member"] == "m0" for e in evs)
+    assert evs[-1]["data"]["extra"] == 19
+    j.record("takeover", "the one that matters")
+    assert [e["kind"] for e in j.events(kinds=["takeover"])] == ["takeover"]
+    assert len(j.tail(3)) == 3
+    # seq strictly increases and stamps ride along
+    seqs = [e["seq"] for e in j.events()]
+    assert seqs == sorted(seqs)
+    assert all(e["wall"] > 0 and e["mono"] > 0 for e in j.events())
+
+
+def test_journal_persistence_roundtrip_marks_restored(tmp_path):
+    from pathway_tpu.observability.journal import IncidentJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = IncidentJournal(capacity=32, path=path, member="writer")
+    j.record("zombie-fenced", "inc 3 outranked", persist=True, incarnation=3)
+    j.record("caught-up", tick=7, persist=True)
+    # a fresh process (same path) picks its past back up, marked restored
+    j2 = IncidentJournal(capacity=32, path=path, member="writer")
+    evs = j2.events()
+    assert [e["kind"] for e in evs] == ["zombie-fenced", "caught-up"]
+    assert all(e["data"]["restored"] for e in evs)
+    assert evs[0]["incarnation"] == 3
+    assert evs[1]["tick"] == 7
+    # new events append after the restored tail
+    j2.record("takeover")
+    assert [e["kind"] for e in j2.events()][-1] == "takeover"
+
+
+def test_postmortem_bundle_layout(tmp_path):
+    from pathway_tpu.observability.journal import IncidentJournal
+
+    j = IncidentJournal(capacity=16, member="replica-1")
+    j.record("router-eject", "liveness", replica="s0.replica1")
+    path = j.postmortem(
+        "unhandled-exception",
+        ValueError("boom"),
+        directory=str(tmp_path),
+    )
+    assert path is not None
+    bundle = json.loads((tmp_path / path.split("/")[-1]).read_text())
+    assert bundle["reason"] == "unhandled-exception"
+    assert bundle["member"] == "replica-1"
+    assert bundle["exception"]["type"] == "ValueError"
+    assert "boom" in bundle["exception"]["message"]
+    assert [e["kind"] for e in bundle["journal"]] == ["router-eject"]
+    assert isinstance(bundle["spans"], list)
+    assert isinstance(bundle["metrics"], str)  # a registry render
+    assert "MainThread" in bundle["threads"]
+    # nowhere to write -> explicit None, never a throw
+    assert j.postmortem("nowhere") is None
+
+
+def test_crash_hooks_record_and_chain(monkeypatch, tmp_path):
+    import importlib
+
+    # the package re-exports the journal() accessor under the same name
+    # as the submodule, so fetch the module itself
+    jmod = importlib.import_module("pathway_tpu.observability.journal")
+
+    monkeypatch.setenv("PATHWAY_POSTMORTEM_DIR", str(tmp_path))
+    jmod.reset_journal()
+    import sys
+
+    seen = []
+    monkeypatch.setattr(sys, "excepthook", lambda *a: seen.append(a))
+    monkeypatch.setattr(jmod, "_hooks_installed", False)
+    jmod.install_crash_hooks()
+    sys.excepthook(ValueError, ValueError("kapow"), None)
+    assert seen, "previous hook must still run"
+    evs = jmod.journal().events(kinds=["unhandled-exception"])
+    assert evs and "kapow" in evs[0]["detail"]
+    assert list(tmp_path.glob("postmortem-*.json")), "bundle written"
+
+
+# ---------------------------------------------------------------------------
+# signal sampler
+
+
+def test_signal_sampler_rates_quantiles_and_burn(monkeypatch):
+    from pathway_tpu.observability.registry import MetricsRegistry
+    from pathway_tpu.observability.signals import SignalSampler
+
+    reg = MetricsRegistry()
+    shed = reg.counter(
+        "pathway_serving_shed_total", "sheds", labelnames=("route", "reason")
+    )
+    admitted = reg.counter(
+        "pathway_serving_admitted_total", "admits", labelnames=("route",)
+    )
+    queue = reg.gauge("pathway_serving_queue_depth", "queue")
+    ttft = reg.histogram(
+        "pathway_generate_ttft_seconds",
+        "ttft",
+        labelnames=("replica",),
+        buckets=(0.05, 0.1, 0.5),
+    )
+    # materialize the children so the baseline sample snapshots zeros
+    shed.labels("/query", "occupancy").inc(0)
+    admitted.labels("/query").inc(0)
+    s = SignalSampler(interval_s=0.1, depth=16, registry=reg)
+    s.sample_once()  # baseline counter snapshot
+    shed.labels("/query", "occupancy").inc(10)
+    admitted.labels("/query").inc(90)
+    queue.set(7)
+    for _ in range(50):
+        ttft.labels("0").observe(0.08)
+    s.sample_once()
+    assert s.rings["shed_rate"].last() == pytest.approx(0.1)
+    assert s.rings["wfq_backlog"].last() == 7.0
+    # p99 interpolates inside the (0.05, 0.1] bucket
+    assert 50.0 < s.rings["ttft_p99_ms"].last() <= 100.0
+    monkeypatch.setenv("PATHWAY_SLO_SHED_RATE", "0.05")
+    burns = s.burn_rates()
+    assert burns["shed_rate"]["target"] == pytest.approx(0.05)
+    assert burns["shed_rate"]["burn"] == pytest.approx(2.0)
+    snap = s.snapshot(series_points=4)
+    assert snap["signals"]["shed_rate"]["last"] == pytest.approx(0.1)
+    assert len(snap["signals"]["shed_rate"]["series"]) >= 1
+    assert "shed_rate" in snap["slo"]
+
+
+def test_signal_ring_window_math():
+    from pathway_tpu.observability.signals import SignalRing
+
+    r = SignalRing(depth=8)
+    now = time.monotonic()
+    for i in range(6):
+        r.append(1000.0 + i, now - (5 - i), float(i))
+    assert r.last() == 5.0
+    # only the last ~3 seconds: values 3, 4, 5
+    assert r.window_avg(2.5, now_mono=now) == pytest.approx(4.0)
+    assert r.window_max(2.5, now_mono=now) == 5.0
+    assert len(r.series(3)) == 3
+
+
+# ---------------------------------------------------------------------------
+# federation against fake members
+
+
+class _FakeMember:
+    """Minimal HTTP member serving canned /metrics, /debug/events and
+    /debug/trace bodies."""
+
+    def __init__(self, metrics="", events=None, trace=None):
+        self.bodies = {
+            "/metrics": (metrics, "text/plain"),
+            "/debug/events": (
+                json.dumps({"member": "ignored", "events": events or []}),
+                "application/json",
+            ),
+            "/debug/trace": (
+                json.dumps(trace or {"traceEvents": []}),
+                "application/json",
+            ),
+        }
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                body, ctype = outer.bodies.get(path, ("nope", "text/plain"))
+                raw = body.encode()
+                self.send_response(200 if path in outer.bodies else 404)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_federate_metrics_three_members_passes_validator():
+    from pathway_tpu.observability import validate_exposition
+    from pathway_tpu.observability.exposition import parse_exposition
+    from pathway_tpu.observability.fleet import federate_metrics
+
+    body = (
+        "# HELP pathway_replica_requests_total reqs\n"
+        "# TYPE pathway_replica_requests_total counter\n"
+        'pathway_replica_requests_total{replica="0",status="2xx"} 4\n'
+        "# HELP pathway_replica_staleness_seconds s\n"
+        "# TYPE pathway_replica_staleness_seconds gauge\n"
+        'pathway_replica_staleness_seconds{replica="0"} 0.25\n'
+    )
+    members = [_FakeMember(metrics=body) for _ in range(3)]
+    try:
+        text, errors = federate_metrics(
+            [(f"replica-{i}", m.url) for i, m in enumerate(members)]
+        )
+    finally:
+        for m in members:
+            m.close()
+    assert errors == {}
+    assert validate_exposition(text) == [], text
+    families, perrs = parse_exposition(text)
+    assert perrs == []
+    reqs = families["pathway_replica_requests_total"]
+    assert {s.labels["member"] for s in reqs.samples} == {
+        "replica-0", "replica-1", "replica-2",
+    }
+    up = families["pathway_fleet_member_up"]
+    assert all(s.value == 1.0 for s in up.samples)
+
+
+def test_federate_metrics_dead_member_degrades_not_raises():
+    from pathway_tpu.observability import validate_exposition
+    from pathway_tpu.observability.exposition import parse_exposition
+    from pathway_tpu.observability.fleet import federate_metrics
+
+    alive = _FakeMember(metrics="pathway_x_total 1\n")
+    try:
+        text, errors = federate_metrics(
+            [("alive", alive.url), ("dead", "http://127.0.0.1:9")],
+            timeout=0.5,
+        )
+    finally:
+        alive.close()
+    assert "dead" in errors
+    assert validate_exposition(text) == [], text
+    families, _ = parse_exposition(text)
+    up = {
+        s.labels["member"]: s.value
+        for s in families["pathway_fleet_member_up"].samples
+    }
+    assert up == {"alive": 1.0, "dead": 0.0}
+
+
+def test_federate_events_orders_and_window_from_events():
+    from pathway_tpu.observability.fleet import (
+        federate_events,
+        window_from_events,
+    )
+
+    t0 = 1000.0
+    writer_events = [
+        {"seq": 1, "kind": "writer-reshard", "wall": t0, "tick": 5,
+         "incarnation": 0},
+    ]
+    replica_events = [
+        {"seq": 1, "kind": "stream-disconnect", "wall": t0 + 1.0,
+         "tick": None, "incarnation": 0},
+        {"seq": 2, "kind": "caught-up", "wall": t0 + 3.5, "tick": 9,
+         "incarnation": 1},
+    ]
+    w = _FakeMember(events=writer_events)
+    r = _FakeMember(events=replica_events)
+    try:
+        merged = federate_events([("writer", w.url), ("replica-0", r.url)])
+    finally:
+        w.close()
+        r.close()
+    assert merged["errors"] == {}
+    kinds = [e["kind"] for e in merged["events"]]
+    # incarnation orders before wall: the inc-1 caught-up sorts last
+    assert kinds == ["writer-reshard", "stream-disconnect", "caught-up"]
+    assert merged["events"][0]["member"] == "writer"
+    win = window_from_events(
+        merged["events"], ["stream-disconnect"], ["caught-up"],
+        min_incarnation=0,
+    )
+    assert win is not None
+    assert win["seconds"] == pytest.approx(2.5)
+    assert win["end_event"]["incarnation"] == 1
+    # no end edge -> None, never a bogus window
+    assert window_from_events(
+        merged["events"], ["stream-disconnect"], ["never-happens"]
+    ) is None
+
+
+def test_fleet_trace_stitch_one_trace_id_across_three_members():
+    """Satellite: the stitched multi-member /fleet/trace export is
+    Perfetto-loadable and cuts ONE trace id across router -> replica ->
+    writer."""
+    from pathway_tpu.observability.fleet import stitch_traces
+    from pathway_tpu.observability.tracing import validate_chrome_trace
+
+    tid = "aa" * 16
+    other = "bb" * 16
+
+    def doc(name, ts, span_id, parent=None, trace=tid):
+        args = {"trace_id": trace, "span_id": span_id}
+        if parent:
+            args["parent_id"] = parent
+        return {
+            "name": name, "ph": "X", "ts": ts, "dur": 100.0,
+            "pid": 1, "tid": 1, "args": args,
+        }
+
+    router_doc = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0, "ts": 0,
+         "args": {"name": "should-be-replaced"}},
+        doc("router.request", 10.0, "r1"),
+        doc("router.attempt", 20.0, "r2", parent="r1"),
+        doc("unrelated", 5.0, "x1", trace=other),
+    ]}
+    replica_doc = {"traceEvents": [
+        doc("replica.serve", 30.0, "p1", parent="r2"),
+    ]}
+    writer_doc = {"traceEvents": [
+        doc("repl.publish", 40.0, "w1"),
+        doc("noise", 1.0, "x2", trace=other),
+    ]}
+    m_rep = _FakeMember(trace=replica_doc)
+    m_wr = _FakeMember(trace=writer_doc)
+    try:
+        stitched = stitch_traces(
+            [("replica-0", m_rep.url), ("writer", m_wr.url)],
+            trace_id=tid,
+            local=("router", router_doc),
+        )
+    finally:
+        m_rep.close()
+        m_wr.close()
+    assert validate_chrome_trace(stitched) == [], stitched
+    evs = stitched["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    # one process_name per member, distinct pids
+    assert {m["args"]["name"] for m in meta} == {
+        "router", "replica-0", "writer",
+    }
+    assert len({m["pid"] for m in meta}) == 3
+    # the other trace id is cut away; the requested one survives whole
+    assert {s["name"] for s in spans} == {
+        "router.request", "router.attempt", "replica.serve", "repl.publish",
+    }
+    assert all(s["args"]["trace_id"] == tid for s in spans)
+    # members' own metadata got replaced, not duplicated
+    assert sum(m["args"]["name"] == "router" for m in meta) == 1
+    # spans are ts-ordered after the metadata block
+    ts = [s["ts"] for s in spans]
+    assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# the real plane: writer -> 3 replicas -> router serving /fleet/*
+
+
+def _corpus_responder(server, values):
+    return {"keys": sorted(int(k) for k in server.index.d)}
+
+
+class _ToyIndex:
+    def __init__(self):
+        self.d = {}
+
+    def keys(self):
+        return list(self.d)
+
+    def upsert(self, key, data, meta):
+        self.d[int(key)] = data
+
+    def remove(self, key):
+        self.d.pop(int(key), None)
+
+    def search(self, triples):
+        return [
+            tuple((k, 1.0) for k in sorted(self.d)[: int(kk)])
+            for _q, kk, _f in triples
+        ]
+
+
+def test_three_member_plane_fleet_endpoints_live():
+    """Acceptance bar: /fleet/metrics scraped from a live 3-member
+    plane passes validate_exposition; /fleet/events carries the
+    hydration story; /fleet/trace passes the Chrome-trace validator;
+    each member's own /metrics body is contract-clean too."""
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.observability import validate_exposition
+    from pathway_tpu.observability.exposition import parse_exposition
+    from pathway_tpu.observability.tracing import validate_chrome_trace
+    from pathway_tpu.parallel.replicate import DeltaStreamServer
+    from pathway_tpu.serving.replica import ReplicaServer
+    from pathway_tpu.serving.router import FailoverRouter
+
+    srv = DeltaStreamServer(0)
+    reps = [
+        ReplicaServer(
+            replica_id=i,
+            index_factory=_ToyIndex,
+            writer_port=srv.port,
+            responder=_corpus_responder,
+        ).start()
+        for i in range(3)
+    ]
+    router = None
+    try:
+        srv.publish(
+            0,
+            [DiffBatch.from_rows(
+                [(k, 1, (f"v{k}", None)) for k in range(5)],
+                ("_data", "_meta"),
+            )],
+        )
+        assert _wait(lambda: all(r.ready for r in reps), timeout=20)
+        router = FailoverRouter(
+            replicas=[f"http://127.0.0.1:{r.http_port}" for r in reps],
+            health_interval_ms=100,
+        ).start()
+        assert _wait(
+            lambda: all(ep.ready for ep in router.endpoints), timeout=10
+        )
+        # satellite: each member's own exposition passes the validator
+        for r in reps:
+            body = _get(f"http://127.0.0.1:{r.http_port}/metrics")
+            assert validate_exposition(body) == [], body[:2000]
+        # the federated view passes too, member-labeled
+        text = _get(f"http://127.0.0.1:{router.port}/fleet/metrics")
+        assert validate_exposition(text) == [], text[:2000]
+        families, perrs = parse_exposition(text)
+        assert perrs == []
+        up = {
+            s.labels["member"]: s.value
+            for s in families["pathway_fleet_member_up"].samples
+        }
+        assert up == {
+            "router": 1.0,
+            "replica0": 1.0, "replica1": 1.0, "replica2": 1.0,
+        }
+        stale = families["pathway_replica_staleness_seconds"]
+        assert {s.labels["member"] for s in stale.samples} >= {
+            "replica0", "replica1", "replica2",
+        }
+        # the merged incident timeline tells the hydration story
+        merged = json.loads(
+            _get(f"http://127.0.0.1:{router.port}/fleet/events")
+        )
+        kinds = {e["kind"] for e in merged["events"]}
+        assert "caught-up" in kinds  # every replica's bootstrap edge
+        assert all("member" in e for e in merged["events"])
+        # the stitched trace is Perfetto-loadable
+        doc = json.loads(
+            _get(f"http://127.0.0.1:{router.port}/fleet/trace")
+        )
+        assert validate_chrome_trace(doc) == []
+        # router's own journal rides /debug/events
+        own = json.loads(
+            _get(f"http://127.0.0.1:{router.port}/debug/events")
+        )
+        assert isinstance(own["events"], list)
+    finally:
+        if router is not None:
+            router.stop()
+        for r in reps:
+            r.stop()
+        srv.close()
+
+
+def test_router_eject_journals_and_swap_bounds_gauge_cardinality():
+    from pathway_tpu.observability import REGISTRY
+    from pathway_tpu.observability.journal import journal
+    from pathway_tpu.serving.router import FailoverRouter
+
+    router = FailoverRouter(
+        shards=[["http://127.0.0.1:1"], ["http://127.0.0.1:2"]]
+    )
+    gauge = REGISTRY.get("pathway_router_replica_inflight")
+
+    def names():
+        with gauge._lock:
+            return {k[0] for k in gauge._children}
+
+    assert {"s0.replica0", "s1.replica0"} <= names()
+    # swap down to one shard: the retired series is REMOVED, not zeroed
+    router.swap_shard_map([["http://127.0.0.1:1"]])
+    assert "s1.replica0" not in names()
+    assert "s0.replica0" in names()
+    assert router._gauge_names == {"s0.replica0"}
+    # repeated churn does not grow the label space
+    for port in (3, 4, 5):
+        router.swap_shard_map([[f"http://127.0.0.1:{port}"]])
+    assert names() & {"s0.replica0"} == {"s0.replica0"}
+    assert len(router._gauge_names) == 1
+    # the swap journaled the topology change (the reshard window's
+    # router-side edge)
+    swaps = journal().events(kinds=["shard-swap"])
+    assert swaps and swaps[-1]["data"]["n_shards"] == 1
+    ep = router.endpoints[0]
+    router._eject(ep, "liveness: test")
+    ej = journal().events(kinds=["router-eject"])
+    assert ej and ej[-1]["data"]["replica"] == ep.name
+    router._readmit(ep)
+    assert journal().events(kinds=["router-readmit"])
+
+
+# ---------------------------------------------------------------------------
+# monitoring server surfaces + supervisor-side federation
+
+
+def test_monitoring_server_signals_events_and_fleet(monkeypatch):
+    from pathway_tpu.internals.monitoring_server import start_http_server
+    from pathway_tpu.observability import validate_exposition
+    from pathway_tpu.observability.exposition import parse_exposition
+    from pathway_tpu.observability.journal import record
+    from pathway_tpu.observability.tracing import validate_chrome_trace
+
+    monkeypatch.setenv("PATHWAY_SIGNALS_INTERVAL_MS", "50")
+    peer = _FakeMember(
+        metrics="pathway_peer_thing_total 3\n",
+        events=[{"seq": 1, "kind": "standby-takeover", "wall": 1.0,
+                 "incarnation": 1}],
+    )
+    server = start_http_server(None, port=0)
+    port = server.server_address[1]
+    try:
+        monkeypatch.setenv(
+            "PATHWAY_FLEET_MEMBERS",
+            f"peer={peer.url},self=http://127.0.0.1:{port}",
+        )
+        record("group-start", "incarnation 0")
+        # /debug/signals: the sampler armed by start_http_server fills
+        assert _wait(
+            lambda: json.loads(
+                _get(f"http://127.0.0.1:{port}/debug/signals")
+            ).get("samples", 0) > 1,
+            timeout=10,
+        )
+        snap = json.loads(
+            _get(f"http://127.0.0.1:{port}/debug/signals?series=2")
+        )
+        assert snap["enabled"] is True
+        assert "tick_ms" in snap["signals"]
+        # /debug/events with kind filter
+        evs = json.loads(
+            _get(f"http://127.0.0.1:{port}/debug/events?kind=group-start")
+        )
+        assert [e["kind"] for e in evs["events"]] == ["group-start"]
+        # /fleet/metrics: peer + local merged, self-entry skipped
+        text = _get(f"http://127.0.0.1:{port}/fleet/metrics")
+        assert validate_exposition(text) == [], text[:2000]
+        families, _ = parse_exposition(text)
+        assert "pathway_peer_thing_total" in families
+        members = {
+            s.labels["member"]
+            for s in families["pathway_fleet_member_up"].samples
+        }
+        assert "peer" in members and "self" not in members
+        # /fleet/events merges the peer's takeover with our own journal
+        merged = json.loads(_get(f"http://127.0.0.1:{port}/fleet/events"))
+        kinds = {e["kind"] for e in merged["events"]}
+        assert {"standby-takeover", "group-start"} <= kinds
+        # /fleet/trace is validator-clean
+        doc = json.loads(_get(f"http://127.0.0.1:{port}/fleet/trace"))
+        assert validate_chrome_trace(doc) == []
+    finally:
+        server.shutdown()
+        peer.close()
+
+
+def test_ephemeral_monitoring_servers_are_distinct(monkeypatch):
+    """A requested port of 0 means a FRESH server every time — fleet
+    drivers start several members in one process, and handing the first
+    server back to the second caller silently collapses the fleet into
+    one member (its peers self-exclude and vanish from /fleet/*)."""
+    import pathway_tpu.internals.monitoring_server as ms
+
+    a = ms.start_http_server(None, port=0)
+    b = ms.start_http_server(None, port=0)
+    try:
+        assert a is not b
+        assert a.server_address[1] != b.server_address[1]
+        # both stay visible to the doctor's armed check, under their
+        # BOUND ports (canonical reuse stays keyed by requested port)
+        with ms._servers_lock:
+            registered = set(ms._servers.values())
+        assert {a, b} <= registered
+    finally:
+        b.shutdown()
+        a.shutdown()
+    with ms._servers_lock:
+        assert a not in ms._servers.values()
+        assert b not in ms._servers.values()
+
+
+def test_supervisor_stamps_fleet_members_into_rank_env():
+    from pathway_tpu.parallel.supervisor import GroupSupervisor
+
+    sup = GroupSupervisor(
+        ["python", "-c", "import os; print(os.environ['PATHWAY_FLEET_MEMBERS'])"],
+        n=2,
+        max_restarts=0,
+    )
+    rc = sup.run()
+    assert rc == 0
+    # the journal mirrors the supervisor's lifecycle events
+    from pathway_tpu.observability.journal import journal
+
+    kinds = [e["kind"] for e in journal().events()]
+    assert "group-start" in kinds and "group-done" in kinds
+
+
+# ---------------------------------------------------------------------------
+# doctor rule: observability-coverage
+
+
+def test_doctor_observability_coverage(monkeypatch):
+    from pathway_tpu.analysis import Severity, run_doctor
+    from pathway_tpu.internals import monitoring_server as ms
+    from pathway_tpu.observability.signals import arm_sampler, reset_sampler
+    from pathway_tpu.observability.tracing import get_tracer
+
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_REPLICAS", "http://127.0.0.1:9101"
+    )
+    monkeypatch.setattr(ms, "_servers", {})
+    found = run_doctor().by_rule("observability-coverage")
+    warn = [d for d in found if d.severity == Severity.WARNING]
+    assert warn, "unmonitored replicated plane must warn"
+    assert "monitoring" in warn[0].message
+    # arming a server clears the no-monitoring warning
+    monkeypatch.setattr(
+        ms, "_servers", {("127.0.0.1", 1): object()}
+    )
+    found = run_doctor().by_rule("observability-coverage")
+    assert not [
+        d
+        for d in found
+        if d.severity == Severity.WARNING and "monitoring" in d.message
+    ]
+    # tracing off on a replicated plane: its own warning
+    monkeypatch.setattr(get_tracer(), "enabled", False)
+    found = run_doctor().by_rule("observability-coverage")
+    assert [
+        d
+        for d in found
+        if d.severity == Severity.WARNING and "tracing" in d.message.lower()
+    ]
+    monkeypatch.setattr(get_tracer(), "enabled", True)
+    # sampler armed without SLO targets -> INFO; with a target -> clean
+    monkeypatch.delenv("PATHWAY_SERVING_REPLICAS", raising=False)
+    arm_sampler(start=False)
+    found = run_doctor().by_rule("observability-coverage")
+    assert [d for d in found if d.severity == Severity.INFO]
+    monkeypatch.setenv("PATHWAY_SLO_SHED_RATE", "0.01")
+    found = run_doctor().by_rule("observability-coverage")
+    assert not [d for d in found if d.severity == Severity.INFO]
+    reset_sampler()
