@@ -3564,8 +3564,14 @@ def _bench_obs_overhead(np):
     router plane serves the serve_chaos steady closed loop twice — OFF
     (no sampler, no scrape) and ON (signal sampler at 1 Hz, incident
     journal heartbeat, and a 1 Hz ``/fleet/metrics`` federated scrape
-    through the router) — and reports the p99 latency delta.  Target:
-    under 2% (`p99_delta_within_2pct`)."""
+    through the router) — and reports the p99 latency delta.  The bar
+    is one-sided: ``p99_regression_pct`` (= max(delta, 0)) must stay
+    under ``overhead_budget_pct`` (2.0); a faster-than-baseline arm
+    passes by those documented semantics, and the signed
+    ``p99_delta_pct`` is kept alongside for trajectory comparisons.
+    The Tick Scope flight recorder (PR 18) rides the same budget: it
+    is default-on in both arms, so its cost sits inside the baseline
+    this tier protects."""
     import secrets
     import threading
 
@@ -3777,7 +3783,21 @@ def _bench_obs_overhead(np):
                 float(np.percentile(on_lat, 50)), 3
             )
             out["p99_delta_pct"] = round(delta * 100, 2)
-            out["p99_delta_within_2pct"] = bool(delta < 0.02)
+            # Overhead-bar semantics (made explicit after OBS_r17
+            # recorded a -6.7% delta "passing" a <2% bar by accident):
+            # the bar is ONE-SIDED on the regression side.  A negative
+            # delta (observability arm faster — noise on a core-bound
+            # box) passes by definition, not by luck; only the
+            # max(delta, 0) regression side is compared against the
+            # documented budget.  Schema:
+            #   p99_delta_pct        signed delta, kept for trajectory
+            #                        comparability with OBS_r17
+            #   p99_regression_pct   max(delta, 0) — the judged side
+            #   overhead_budget_pct  the documented bar (2.0)
+            #   p99_delta_within_2pct = p99_regression_pct < budget
+            out["overhead_budget_pct"] = 2.0
+            out["p99_regression_pct"] = round(max(delta, 0.0) * 100, 2)
+            out["p99_delta_within_2pct"] = bool(max(delta, 0.0) < 0.02)
         out["error_served_total"] = sum(
             p[a].get("error_served", 1)
             for p in pairs
@@ -3807,6 +3827,403 @@ def _bench_obs_overhead(np):
         _tracing.get_tracer().enabled = _tracer_was
         if prior_secret is None:
             os.environ.pop("PATHWAY_DCN_SECRET", None)
+
+
+def _bench_tick_anatomy(np):
+    """Tick Scope tier (TICK_r18.json, ISSUE 18 acceptance): per-operator
+    tick anatomy on a linear compiled pipeline (per-exec wall/rows, a
+    critical-path decomposition whose stage sum must reconcile with the
+    measured tick wall within 10% — the pipeline is a chain run
+    single-threaded, so the critical path IS the full operator set), a
+    memory-ledger leg naming the top resident-byte owners (GroupBy
+    ledger doubling, KV host mirror, monolith snapshots — the ROADMAP's
+    memory claims, now with numbers), achieved-MFU roofline entries for
+    all three kernel families (compiled_tick / topk / paged_attention,
+    CPU-measured with the TPU peak table standing by), the recorder
+    on/off overhead delta, and a baseline comparator that diffs
+    per-operator timings against committed TICK_r*.json artifacts and
+    flags per-operator regressions (BENCH_r12 throughput rides along as
+    trajectory context)."""
+    import gc
+    import glob as _glob
+    import statistics
+
+    from pathway_tpu.engine.batch import DiffBatch
+    from pathway_tpu.engine.expression_eval import InternalColRef
+    from pathway_tpu.engine.nodes import (
+        FilterNode,
+        GroupByNode,
+        InputNode,
+        JoinNode,
+        OutputNode,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.reducers import ReducerSpec
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+    from pathway_tpu.observability import tickscope as ts
+
+    n_rows, tick_rows = 262_144, 16_384  # 16 equal ticks, one pad bucket
+
+    def ref(name):
+        return InternalColRef(0, name)
+
+    def obj_col(values):
+        out = np.empty(len(values), dtype=object)
+        out[:] = values
+        return out
+
+    class _Src(StaticSource):
+        def __init__(self, names, ticks):
+            super().__init__(names)
+            self._ticks = ticks
+
+        def events(self):
+            for i, b in enumerate(self._ticks):
+                yield i, b
+
+    rng = np.random.default_rng(18)
+    a_all = [int(v) for v in rng.integers(-1000, 1000, n_rows)]
+    b_all = [float(v) for v in rng.normal(size=n_rows)]
+
+    def numeric_ticks(n, per_tick, cols):
+        ticks = []
+        for lo in range(0, n, per_tick):
+            hi = min(n, lo + per_tick)
+            ticks.append(
+                DiffBatch(
+                    np.arange(lo, hi, dtype=np.uint64),
+                    np.ones(hi - lo, np.int64),
+                    {c: obj_col(vals[lo:hi]) for c, vals in cols.items()},
+                )
+            )
+        return ticks
+
+    def build_chain(sink):
+        # a LINEAR pipeline: input -> map -> filter -> groupby -> output.
+        # Single-threaded over a chain, the critical path covers every
+        # operator that ran, so its stage sum is the reconciliation
+        # target against the measured tick wall.
+        inp = InputNode(
+            _Src(
+                ["a", "b"],
+                numeric_ticks(
+                    n_rows, tick_rows, {"a": a_all, "b": b_all}
+                ),
+            ),
+            ["a", "b"],
+        )
+        m = RowwiseNode(
+            [inp],
+            {
+                "g": ref("a") & 63,
+                "v": ref("a") * 2 + 1,
+                "w": ref("b") * 0.5,
+            },
+        )
+        f = FilterNode(m, ref("v") > -1950)
+        gb = GroupByNode(
+            f,
+            ["g"],
+            {
+                "cnt": ReducerSpec(kind="count"),
+                "tot": ReducerSpec(kind="sum", arg_cols=("v",)),
+            },
+        )
+        return OutputNode(gb, sink)
+
+    def run_chain(recorder_on):
+        if recorder_on:
+            os.environ.pop("PATHWAY_TICKSCOPE", None)
+        else:
+            os.environ["PATHWAY_TICKSCOPE"] = "0"
+        try:
+            rows = [0]
+
+            def sink(t, b):
+                rows[0] += len(b)
+
+            rt = Runtime([build_chain(sink)], worker_threads=False)
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                rt.run()
+                dt = time.perf_counter() - t0
+            finally:
+                gc.enable()
+            return rt, dt, rows[0]
+        finally:
+            os.environ.pop("PATHWAY_TICKSCOPE", None)
+
+    out: dict = {
+        "rows": n_rows,
+        "tick_rows": tick_rows,
+        "cpu_cores": os.cpu_count(),
+    }
+
+    # --- anatomy + recorder overhead (alternating arms) -------------------
+    run_chain(True)  # untimed warmup: jit compiles + allocator growth
+    off_s, on_s = [], []
+    rt_on = out_rows = None
+    for _ in range(3):
+        _rt, dt, _ = run_chain(False)
+        off_s.append(dt)
+        rt_on, dt, out_rows = run_chain(True)
+        on_s.append(dt)
+    med_off, med_on = statistics.median(off_s), statistics.median(on_s)
+    overhead = (med_on - med_off) / med_off
+    out["recorder_off_s"] = round(med_off, 4)
+    out["recorder_on_s"] = round(med_on, 4)
+    out["recorder_overhead_pct"] = round(overhead * 100, 2)
+    # same one-sided semantics as obs_overhead: only the regression
+    # side is judged against the documented 2% budget
+    out["recorder_regression_pct"] = round(max(overhead, 0.0) * 100, 2)
+    out["recorder_within_budget"] = bool(max(overhead, 0.0) < 0.02)
+    out["rows_per_sec_on"] = round(n_rows / med_on)
+
+    scope = rt_on._tickscope
+    recs = scope.records()
+    busiest = max(recs, key=lambda r: sum(e[3] for e in r.entries))
+    stage_sum_ms = sum((e[2] - e[1]) for e in busiest.entries) / 1e6
+    tick_ms = busiest.tick_ns / 1e6
+    cp_total_s, cp_path = scope.record_critical_path(busiest)
+    rollup = scope.operator_rollup()
+    for name, d in rollup.items():
+        d["wall_s"] = round(d["wall_s"], 6)
+    recon = stage_sum_ms / tick_ms if tick_ms else 0.0
+    out["anatomy"] = {
+        "ticks_recorded": scope.ticks_recorded,
+        "out_rows": out_rows,
+        "compiled_entries": scope.compiled_entries,
+        "interpreted_entries": scope.interpreted_entries,
+        "operators": rollup,
+        "busiest_tick": {
+            "t": busiest.t,
+            "tick_wall_ms": round(tick_ms, 4),
+            "stage_sum_ms": round(stage_sum_ms, 4),
+            "stage_sum_over_tick": round(recon, 4),
+            "reconciles_within_10pct": bool(0.9 <= recon <= 1.001),
+            "critical_path_ms": round(cp_total_s * 1e3, 4),
+            "critical_path_stages": [
+                scope._names.get(nid, str(nid)) for nid in cp_path
+            ],
+        },
+    }
+
+    # --- memory ledger: the three ROADMAP owners, measured ----------------
+    n_mem, mem_tick = 65_536, 8_192
+    k_all = [int(v) for v in rng.integers(0, 256, n_mem)]
+    x_all = [float(v) for v in rng.normal(size=n_mem)]
+    y_all = [float(v) for v in rng.normal(size=n_mem)]
+    mrows = [0]
+
+    def msink(t, b):
+        mrows[0] += len(b)
+
+    inp1 = InputNode(
+        _Src(
+            ["k", "x"],
+            numeric_ticks(n_mem, mem_tick, {"k": k_all, "x": x_all}),
+        ),
+        ["k", "x"],
+    )
+    inp2 = InputNode(
+        _Src(
+            ["k", "y"],
+            numeric_ticks(n_mem, mem_tick, {"k": k_all, "y": y_all}),
+        ),
+        ["k", "y"],
+    )
+    j = JoinNode(inp1, inp2, ["k"], ["k"], "inner")
+    jm = RowwiseNode(
+        [j], {"k2": ref("l.k"), "s": ref("l.x") + ref("r.y")}
+    )
+    gb_ledger = GroupByNode(  # persistence ledger ON: doubled residency
+        jm,
+        ["k2"],
+        {"tot": ReducerSpec(kind="sum", arg_cols=("s",))},
+    )
+    gb_monolith = GroupByNode(  # ledger OFF: deep=1 prices the pickle
+        inp1, ["k"], {"cnt": ReducerSpec(kind="count")}
+    )
+    mem_rt = Runtime(
+        [OutputNode(gb_ledger, msink), OutputNode(gb_monolith, msink)],
+        worker_threads=False,
+    )
+    mem_rt.execs[gb_ledger.id].enable_state_ledger()
+    mem_rt.run()
+
+    from pathway_tpu.generate.kv_cache import KvLedger
+
+    kv = KvLedger()
+    page = np.zeros((2, 8, 4, 32), np.float32)  # [L, P, H, Dp] per page
+    for seq in range(4):
+        for p in range(8):
+            kv.put_page(seq, p, page, page)
+        kv.put_seq(seq, {"seq_id": seq, "prompt_len": 4})
+    ts.register_memory_provider("generate:bench", kv.resident_bytes)
+
+    mem_snap = ts.memory_snapshot(deep=True)
+    gb_name = f"GroupByNode_{gb_ledger.id}"
+    runtime_parts = mem_snap["owners"].get("runtime", {})
+    kv_parts = mem_snap["owners"].get("generate:bench", {})
+    out["memory_ledger"] = {
+        "total_bytes": mem_snap["total_bytes"],
+        "top3": mem_snap["top"][:3],
+        # the three owners the ROADMAP argues about, with numbers
+        "expected_owners_bytes": {
+            "groupby_ledger_doubling": (
+                runtime_parts.get(f"{gb_name}/ledger_blobs", 0)
+                + runtime_parts.get(f"{gb_name}/groups_dict", 0)
+            ),
+            "kv_host_mirror": kv_parts.get("host_mirror", 0),
+            "monolith_snapshots": sum(
+                v
+                for k, v in runtime_parts.items()
+                if k.endswith("/monolith_pickle")
+            ),
+        },
+        "owner_parts": {
+            owner: dict(
+                sorted(parts.items(), key=lambda kv_: -kv_[1])[:6]
+            )
+            for owner, parts in mem_snap["owners"].items()
+        },
+    }
+    ts.unregister_memory_provider("generate:bench")
+    del mem_rt  # drop its exec walk from later snapshots
+
+    # --- roofline: all three kernel families, CPU-measured ----------------
+    from pathway_tpu.stdlib.indexing._index_impls import TpuDenseKnnIndex
+
+    idx = TpuDenseKnnIndex(
+        dimensions=64, metric="cosine", kernel="pallas"
+    )
+    vecs = rng.normal(size=(2048, 64)).astype(np.float32)
+    for i in range(2048):
+        idx.upsert(i, vecs[i], None)
+    queries = [(vecs[i], 8, None) for i in range(16)]
+    for _ in range(5):
+        idx.search(queries)
+
+    from pathway_tpu.generate.scheduler import (
+        DecodeScheduler,
+        GenerateConfig,
+        GenerationRequest,
+    )
+
+    sched = DecodeScheduler(
+        GenerateConfig(
+            n_pages=32, page_size=8, max_batch=4, max_len=96,
+            max_new_tokens=8, dim=64, n_layers=1, n_heads=2,
+            head_dim=32, ffn_dim=128,
+        ),
+        replica_label="tickbench",
+    )
+    try:
+        reqs = [
+            GenerationRequest(
+                f"tick{i}",
+                [3, 1, 4, 1, 5],
+                deadline=time.monotonic() + 60,
+                max_new_tokens=6,
+            )
+            for i in range(3)
+        ]
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            r.wait(60)
+    finally:
+        sched.stop()
+
+    roof = ts.roofline().snapshot()
+    out["roofline"] = {
+        fam: {
+            "programs": f["programs"],
+            "calls": f["calls"],
+            "flops_total": f["flops_total"],
+            "wall_s": f["wall_s"],
+            "achieved_flops_s": round(f["achieved_flops_s"]),
+            "peak_flops_s": f["peak_flops_s"],
+            "mfu": f["mfu"],
+        }
+        for fam, f in roof.items()
+    }
+    out["roofline_families_complete"] = all(
+        roof.get(fam, {}).get("calls", 0) > 0
+        for fam in ("compiled_tick", "topk", "paged_attention")
+    )
+    out["peak_flops_source"] = (
+        "PATHWAY_PEAK_FLOPS"
+        if os.environ.get("PATHWAY_PEAK_FLOPS")
+        else "platform-table"
+    )
+
+    # --- baseline comparator: per-operator diffs vs committed artifacts ---
+    root = os.path.dirname(os.path.abspath(__file__))
+    scanned, flags = [], []
+    for path in sorted(_glob.glob(os.path.join(root, "TICK_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        base_ops = (doc.get("anatomy") or {}).get("operators") or {}
+        if not base_ops:
+            continue
+        scanned.append(os.path.basename(path))
+        for op, cur in rollup.items():
+            base = base_ops.get(op)
+            if not isinstance(base, dict) or not base.get("wall_s"):
+                continue
+            # generous slack on a noisy 2-core box: flag only >1.5x
+            # plus a 2 ms absolute floor — the comparator exists to
+            # catch real per-operator regressions the end-to-end
+            # rows/s number averages away
+            if cur["wall_s"] > base["wall_s"] * 1.5 + 0.002:
+                flags.append(
+                    {
+                        "operator": op,
+                        "baseline": os.path.basename(path),
+                        "baseline_wall_s": round(base["wall_s"], 6),
+                        "current_wall_s": round(cur["wall_s"], 6),
+                    }
+                )
+    trajectory = {}
+    bench12 = os.path.join(root, "BENCH_r12.json")
+    if os.path.exists(bench12):
+        try:
+            with open(bench12) as f:
+                b12 = json.load(f).get("groupby_chain", {})
+            trajectory["BENCH_r12_groupby_chain_warm_rows_per_sec"] = (
+                b12.get("compiled_warm_rows_per_sec")
+            )
+            trajectory["tick_anatomy_rows_per_sec"] = out[
+                "rows_per_sec_on"
+            ]
+            # cross-pipeline context only (different row mix and tick
+            # size) — flag the catastrophic case, not the noise
+            base_rps = b12.get("compiled_warm_rows_per_sec") or 0
+            if base_rps and out["rows_per_sec_on"] < 0.2 * base_rps:
+                flags.append(
+                    {
+                        "operator": "(end-to-end)",
+                        "baseline": "BENCH_r12.json",
+                        "baseline_wall_s": None,
+                        "current_wall_s": None,
+                        "note": "tick_anatomy throughput under 20% of "
+                        "the BENCH_r12 compiled groupby_chain",
+                    }
+                )
+        except Exception:
+            pass
+    out["baseline_comparison"] = {
+        "scanned": scanned,
+        "first_artifact": not scanned,
+        "regressions": flags,
+        "trajectory": trajectory,
+    }
+    return out
 
 
 def _bench_generate_serve(np):
@@ -4407,6 +4824,22 @@ if __name__ == "__main__":
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "OBS_r17.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["tick_anatomy"]:
+        # Tick Scope tier (ISSUE 18 acceptance artifact): per-operator
+        # tick anatomy + critical-path reconciliation, memory-ledger
+        # top owners, roofline MFU for all three kernel families,
+        # recorder on/off overhead, and the TICK_r*.json comparator
+        import numpy as _np
+
+        _tick = _bench_tick_anatomy(_np)
+        _doc = {"tier": "tick_anatomy", **_tick}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "TICK_r18.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

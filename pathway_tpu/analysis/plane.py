@@ -313,3 +313,52 @@ def knob_coherence(facts: GraphFacts) -> Iterable[Diagnostic]:
             "the rest_connector) to arm the gate tenancy rides on",
             data={"knob": "PATHWAY_TENANT_QOS"},
         )
+
+
+# ---------------------------------------------------------------------------
+# tick-scope coverage (PR 18: blind planes and silently-broken rooflines)
+
+
+@plane_rule("tickscope-coverage")
+def tickscope_coverage(facts: GraphFacts) -> Iterable[Diagnostic]:
+    """Flag planes flying blind or with a broken roofline hook.
+
+    INFO when a serving surface is live while the flight recorder is
+    disabled (PATHWAY_TICKSCOPE=0): the first slow-tick incident on
+    that plane will have no per-operator evidence to read back.
+    WARNING when a plane has executed compiled ticks but the roofline
+    has zero ``compiled_tick`` samples: the cost-analysis hook in
+    engine/compile.py is silently broken (its registration is
+    best-effort by design, so breakage shows up only here)."""
+    from pathway_tpu.observability import tickscope
+
+    status = tickscope.coverage_status()
+    if status["serving_active"] and not status["recorder_enabled"]:
+        yield Diagnostic(
+            "tickscope-coverage",
+            Severity.INFO,
+            "serving surface live with the tick flight recorder "
+            "disabled (PATHWAY_TICKSCOPE=0): slow-tick incidents on "
+            "this plane will have no per-operator attribution",
+            fix_hint="unset PATHWAY_TICKSCOPE (default-on) — the "
+            "recorder's hot-loop cost is one `is None` check per "
+            "node when idle and is covered by the obs_overhead bench "
+            "budget when recording",
+            data={"knob": "PATHWAY_TICKSCOPE"},
+        )
+    samples = status["roofline_samples"]
+    if status["compiled_ticks"] > 0 and samples.get("compiled_tick", 0) == 0:
+        yield Diagnostic(
+            "tickscope-coverage",
+            Severity.WARNING,
+            f"{status['compiled_ticks']} compiled ticks executed but "
+            "the roofline has zero compiled_tick samples: the "
+            "cost-analysis observe hook (engine/compile.py "
+            "_run_compiled) is silently broken and MFU attribution "
+            "reads as 'no compiled work'",
+            fix_hint="check that observability.tickscope imports "
+            "cleanly in this environment; the hook swallows "
+            "exceptions by contract, so an import/runtime error there "
+            "only surfaces through this rule",
+            data={"compiled_ticks": status["compiled_ticks"]},
+        )

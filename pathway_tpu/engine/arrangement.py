@@ -60,6 +60,29 @@ def mix_keys(jks: np.ndarray, keys: np.ndarray) -> np.ndarray:
     )
 
 
+def _col_bytes(col: np.ndarray) -> int:
+    """Resident bytes of one value column.  Object-dtype columns hold
+    pointers; walk the elements so pickled blobs / nested arrays report
+    their payload size (bytes -> len, ndarray -> nbytes, everything else
+    sys.getsizeof)."""
+    if col.dtype != object:
+        return int(col.nbytes)
+    import sys
+
+    total = int(col.nbytes)  # the pointer array itself
+    for v in col:
+        if isinstance(v, (bytes, bytearray)):
+            total += len(v)
+        elif isinstance(v, np.ndarray):
+            total += int(v.nbytes)
+        elif v is not None:
+            try:
+                total += sys.getsizeof(v)
+            except TypeError:
+                pass
+    return total
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
@@ -386,6 +409,30 @@ class Arrangement:
 
     def __len__(self) -> int:
         return self._entries
+
+    def resident_bytes(self) -> int:
+        """Host-resident byte footprint of the log: sealed segments plus
+        staged-but-uncommitted deltas. Numeric columns report ndarray
+        nbytes; object columns report payload bytes per element (a
+        pickled-blob column's 8-byte pointers would otherwise hide the
+        actual residency the memory ledger exists to expose). Feeds
+        Tick Scope's ``pathway_tickscope_resident_bytes`` families."""
+        total = 0
+        for seg in self.segments:
+            total += (
+                seg.jks.nbytes + seg.keys.nbytes + seg.diffs.nbytes
+                + seg.ages.nbytes
+            )
+            if seg.mix_sorted is not None:
+                total += seg.mix_sorted.nbytes
+            for c in seg.cols:
+                total += _col_bytes(c)
+        for staged in self._staged:
+            jks, keys, diffs, cols = staged[0], staged[1], staged[2], staged[3]
+            total += jks.nbytes + keys.nbytes + diffs.nbytes
+            for c in cols:
+                total += _col_bytes(np.asarray(c))
+        return total
 
     def __setstate__(self, state: dict) -> None:
         # monolith snapshots written before arrangements carried a

@@ -822,9 +822,25 @@ class SegmentRunner:
                 pad = np.zeros(bucket - n, dtype=col.dtype)
                 col = np.concatenate([col, pad])
             ins.append(col)
+        # roofline attribution (observability/tickscope.py): measured
+        # monotonic wall per program execution, against the FLOP estimate
+        # registered at build time in _program_for. The np.asarray calls
+        # stay inside the window — device->host sync is part of what the
+        # tick actually waits for.
+        _rt0 = time.perf_counter()
         with jax.experimental.enable_x64():
             res = prog.fn(*ins)
             outs = [np.asarray(r) for r in res]
+        try:
+            from pathway_tpu.observability import tickscope as _ts
+
+            _ts.roofline().observe(
+                "compiled_tick",
+                f"seg_{'-'.join(prog.in_cols)}_rows{bucket}",
+                time.perf_counter() - _rt0,
+            )
+        except Exception:  # pragma: no cover - defensive
+            pass
         pos = len(prog.dev_out)
         mask = None
         new_keys = None
@@ -902,6 +918,7 @@ class SegmentRunner:
         with self._lock:
             self._cache[key] = prog
         self._register_with_ledger(prog, bucket, dtypes)
+        self._register_roofline(prog, bucket, dtypes)
         return prog, key
 
     def _register_with_ledger(self, prog: _Program, bucket: int, dtypes):
@@ -930,6 +947,33 @@ class SegmentRunner:
                     "in_cols": list(prog.in_cols),
                     "out_cols": [c for c, _ in prog.dev_out],
                 },
+            )
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def _register_roofline(self, prog: _Program, bucket: int, dtypes):
+        """Register the program's per-call FLOP estimate (XLA cost
+        analysis over abstract args — no execution) with the Tick Scope
+        roofline, keyed exactly like _run_compiled's observe calls.
+        Best-effort: a backend without a cost model just means zero
+        registered FLOPs, which the tickscope-coverage doctor rule
+        surfaces rather than this path crashing a tick."""
+        try:
+            import jax
+
+            from pathway_tpu.observability import tickscope as _ts
+
+            args = tuple(
+                jax.ShapeDtypeStruct((bucket,), dtypes[c])
+                for c in prog.in_cols
+            )
+            with jax.experimental.enable_x64():
+                flops, nbytes = _ts.estimate_program_cost(prog.fn, *args)
+            _ts.roofline().register(
+                "compiled_tick",
+                f"seg_{'-'.join(prog.in_cols)}_rows{bucket}",
+                flops,
+                nbytes,
             )
         except Exception:  # pragma: no cover - defensive
             pass

@@ -178,6 +178,35 @@ class NodeExec:
         for name, arr in arrangements.items():
             setattr(self, name, arr)
 
+    # --- memory ledger (observability/tickscope.py) ---------------------
+
+    def memory_ledger(self, deep: bool = False) -> dict[str, int]:
+        """Resident bytes per state part.  Default: every Arrangement
+        attribute reports its segment/staged bytes; ``deep`` adds the
+        monolith-pickle size for execs still snapshotting via
+        state_dict() (the exact number the ROADMAP's "kill the last
+        monolith" item needs measured, but costs a pickle — never on
+        by default).  Execs with doubled state (GroupByExec's live dict
+        + pickled ledger) override to name both sides."""
+        from pathway_tpu.engine.arrangement import Arrangement
+
+        parts: dict[str, int] = {}
+        for k, v in self.__dict__.items():
+            if isinstance(v, Arrangement):
+                parts[f"arrangement:{k}"] = v.resident_bytes()
+        if deep and self.arranged_state() is None:
+            try:
+                state = self.state_dict()
+                if state:
+                    import pickle
+
+                    parts["monolith_pickle"] = len(
+                        pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+                    )
+            except Exception:
+                pass
+        return parts
+
 
 def _concat_inputs(batches: list[DiffBatch], names: Sequence[str]) -> DiffBatch:
     batches = [b for b in batches if len(b)]
@@ -574,6 +603,34 @@ class GroupByExec(NodeExec):
             # next incremental snapshot would persist only groups touched
             # since the restore and silently drop the rest
             self._ledger_append(list(self.groups))
+
+    def memory_ledger(self, deep: bool = False) -> dict[str, int]:
+        """Groupby's residency is DOUBLED when the state ledger is on:
+        the live ``groups`` dict (compute path) plus the pickled-blob
+        mirror in ``self.ledger`` (persistence path).  Name both sides
+        so Tick Scope's top-owners list can show the doubling the
+        ROADMAP's columnar-memory refactor wants to collapse.  The dict
+        side is estimated per group via sys.getsizeof on the state's
+        __dict__ values (cheap; exact would re-pickle every group)."""
+        import sys
+
+        parts = {"ledger_blobs": self.ledger.resident_bytes()}
+        dict_bytes = sys.getsizeof(self.groups)
+        for gs in self.groups.values():
+            dict_bytes += sys.getsizeof(gs)
+            d = getattr(gs, "__dict__", None)
+            if d:
+                dict_bytes += sum(
+                    sys.getsizeof(v)
+                    + (v.nbytes if isinstance(v, np.ndarray) else 0)
+                    for v in d.values()
+                )
+        parts["groups_dict"] = dict_bytes
+        if deep and not self._ledger_enabled:
+            base = super().memory_ledger(deep=True)
+            if "monolith_pickle" in base:
+                parts["monolith_pickle"] = base["monolith_pickle"]
+        return parts
 
     def _group_key(self, vals: tuple) -> int:
         gvals = tuple(vals[i] for i in self.g_idx)

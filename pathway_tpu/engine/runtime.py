@@ -405,12 +405,18 @@ class Runtime:
         # ~0-sample ticks don't swamp the distribution). The `/metrics`
         # endpoint serves these as pathway_operator_tick_seconds_bucket.
         from pathway_tpu.observability import REGISTRY
+        from pathway_tpu.observability.registry import log_linear_buckets
 
+        # sub-millisecond floor (1 us): Tick Forge compiled ticks finish
+        # in 10-100 us — the registry's default 0.1 ms floor flattened
+        # them all into the lowest bucket, hiding the 2.5-4.7x speedup
+        # from every quantile
         _tick_hist = REGISTRY.histogram(
             "pathway_operator_tick_seconds",
             "per-operator processing time per tick that moved rows, "
             "by operator type",
             labelnames=("operator",),
+            buckets=log_linear_buckets(lo=1e-6, hi=64.0, per_octave=4),
         )
         self._tick_hist_children = {
             n.id: _tick_hist.labels(self._node_names[n.id])
@@ -430,6 +436,15 @@ class Runtime:
         from pathway_tpu.testing import faults
 
         self._fault_plan = faults.active()
+        # Tick Scope (observability/tickscope.py): per-runtime flight
+        # recorder. Per-runtime, NOT process-global — iterate/interactive
+        # spin nested runtimes whose inner ticks would otherwise corrupt
+        # the outer tick's record. Disabled (PATHWAY_TICKSCOPE=0) the hot
+        # loop pays one `is None` check per node and nothing else.
+        from pathway_tpu.observability import tickscope as _tickscope_mod
+
+        self._tickscope = _tickscope_mod.make_recorder(self)
+        self._ts_entries: list | None = None
         # intra-tick worker parallelism (reference: PATHWAY_THREADS timely
         # workers, src/engine/dataflow/config.rs:63-86): independent nodes
         # of one topo level process concurrently on a thread pool. Each
@@ -511,6 +526,21 @@ class Runtime:
         has_injected = (
             isinstance(ex, InputExec) and injected and node.id in injected
         )
+        # Tick Scope: entries is None when the recorder is off — that
+        # one check is the entire disabled-path cost. compiled_ticks is
+        # sampled around the call to tag the entry compiled-vs-interpreted
+        # (SegmentRunner only bumps it on a successful jitted run).
+        ts_entries = self._ts_entries
+        seg_c0 = (
+            runner.compiled_ticks
+            if (ts_entries is not None and runner is not None)
+            else 0
+        )
+        # the operator clock starts BEFORE injection: batch tightening
+        # (expression_eval.tighten_batch) is the single biggest cost of
+        # an ingest tick and it belongs to the InputNode, not to the
+        # unattributed gap between stage sum and tick wall
+        t0 = _time.perf_counter_ns()
         if has_injected:
             for b in injected[node.id]:
                 ex.inject(b)
@@ -519,7 +549,6 @@ class Runtime:
             if runner is not None
             else [produced.get(inp.id, []) for inp in node.inputs]
         )
-        t0 = _time.perf_counter_ns()
         from pathway_tpu.internals.errors import set_exec_scope
 
         set_exec_scope(getattr(node, "_error_scope", None))
@@ -570,6 +599,19 @@ class Runtime:
                 self._otel_metrics.record_operator_latency(
                     self._node_names[node.id], node_ns
                 )
+            if ts_entries is not None:
+                # list.append is GIL-atomic — safe from pool threads
+                ts_entries.append(
+                    (
+                        node.id,
+                        t0,
+                        t0 + node_ns,
+                        sum(len(b) for b in inputs),
+                        nrows,
+                        runner is not None
+                        and runner.compiled_ticks > seg_c0,
+                    )
+                )
         if isinstance(ex, InputExec) and nrows:
             stats.rows_in[node.id] = stats.rows_in.get(node.id, 0) + nrows
 
@@ -602,6 +644,7 @@ class Runtime:
         if self._fault_plan is not None and not final:
             self._fault_plan.on_tick(t, "head")
         stats = self.stats
+        self._ts_entries = self._tickscope.begin_tick(t)
         tick_start = _time.perf_counter_ns()
         if self._pool is not None and self._levels is not None:
             import contextvars as _cv
@@ -650,6 +693,8 @@ class Runtime:
         stats.ticks += 1
         stats.current_time = t if not final else stats.current_time
         stats.last_tick_ns = _time.perf_counter_ns() - tick_start
+        self._tickscope.end_tick(self._ts_entries, stats.last_tick_ns)
+        self._ts_entries = None
         self._tick_count += 1
         if self._fault_plan is not None and not final:
             # "tail" kills land AFTER this tick's node processing but

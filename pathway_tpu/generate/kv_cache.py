@@ -216,6 +216,25 @@ class KvLedger:
                     list(cols),
                 )
 
+    def resident_bytes(self) -> dict[str, int]:
+        """Memory-ledger parts for Tick Scope: the two arrangements
+        (whose object columns hold the SAME ndarrays the shadow dict
+        points at — the +1 entry shares storage with ``_shadow_pages``,
+        only retract/insert churn adds copies) and the host mirror
+        counted by payload bytes."""
+        with self._lock:
+            mirror = 0
+            for k_page, v_page, ident in self._shadow_pages.values():
+                mirror += (
+                    int(k_page.nbytes) + int(v_page.nbytes)
+                    + int(ident.nbytes)
+                )
+        return {
+            "pages_arrangement": self.pages.resident_bytes(),
+            "seqs_arrangement": self.seqs.resident_bytes(),
+            "host_mirror": mirror,
+        }
+
     def live_seqs(self) -> dict[int, dict]:
         with self._lock:
             return dict(self._shadow_seqs)

@@ -334,6 +334,7 @@ class DecodeScheduler:
         self._waiting: list[GenerationRequest] = []
         self._seq_counter = 0
         self._step_count = 0
+        self._n_params: int | None = None  # roofline: counted on demand
         self._stopping = False
         # out-of-thread snapshot(): executed AT the step boundary by
         # the decode thread (the pools are donated into the jitted
@@ -366,6 +367,24 @@ class DecodeScheduler:
         # fairness from admission into decode batching.  None keeps the
         # plain-EDF plane byte-identical.
         self.tenant_ledger = ledger
+        # Tick Scope memory provider: the generate plane's resident
+        # bytes — device KV page pools, the KvLedger arrangements, and
+        # the host mirror — under owner "generate:<label>" (weakref: a
+        # dead scheduler drops out of the snapshot at the next pull)
+        from pathway_tpu.observability import tickscope as _ts
+
+        def _generate_memory(r=ref):
+            s = r()
+            if s is None:
+                return {}
+            parts = dict(s.ledger.resident_bytes())
+            parts["k_pool_device"] = int(s.k_pool.nbytes)
+            parts["v_pool_device"] = int(s.v_pool.nbytes)
+            return parts
+
+        _ts.register_memory_provider(
+            f"generate:{self.label}", _generate_memory
+        )
         self.batcher = MicroBatcher(
             self.qos,
             dispatch=self._dispatch,
@@ -630,6 +649,7 @@ class DecodeScheduler:
             bucket=bucket,
         )
         with span:
+            _rt0 = time.perf_counter()
             logits, self.k_pool, self.v_pool = self._dec.decode_step(
                 self.params,
                 jnp.asarray(tokens),
@@ -642,6 +662,41 @@ class DecodeScheduler:
                 kernel=self.kernel,
             )
             host_logits = np.asarray(logits)
+        # Tick Scope roofline, family "paged_attention": one decode step
+        # at this bucket. Analytic FLOPs (2 * params * batch for the
+        # matmuls + the attention read over the live context) — the
+        # pallas kernel has no XLA cost model off-TPU, and lower().
+        # compile() here would double every bucket's compile time.
+        try:
+            from pathway_tpu.observability import tickscope as _ts
+
+            _rl = _ts.roofline()
+            _key = f"decode_b{bucket}_{self.kernel}"
+            if not _rl.known("paged_attention", _key):
+                if self._n_params is None:
+                    import jax as _jax
+
+                    self._n_params = sum(
+                        l.size
+                        for l in _jax.tree_util.tree_leaves(self.params)
+                    )
+                ctx = int(seq_lens.sum())
+                _rl.register(
+                    "paged_attention",
+                    _key,
+                    2.0 * self._n_params * bucket
+                    + 4.0
+                    * self.dcfg.n_layers
+                    * self.dcfg.n_heads
+                    * self.dcfg.head_dim
+                    * ctx,
+                    source="analytic",
+                )
+            _rl.observe(
+                "paged_attention", _key, time.perf_counter() - _rt0
+            )
+        except Exception:  # pragma: no cover - defensive
+            pass
         self._m_batch.observe(len(batch))
         self._m_steps.inc()
         finished: list[tuple[_Seq, dict]] = []

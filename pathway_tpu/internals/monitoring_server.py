@@ -12,7 +12,9 @@ JSON), ``/debug/profile?seconds=N`` (on-demand jax profiler trace),
 ``/debug/trace?seconds=N`` (the Trace Weaver span ring as Chrome
 trace-event JSON, loadable in Perfetto), ``/debug/signals`` (Fleet Lens
 SLO signal rings + burn rates; ``?series=N`` includes trailing points),
-and ``/debug/events`` (the incident journal). Arming the server also
+``/debug/events`` (the incident journal), and ``/debug/tick`` (Tick
+Scope: per-operator tick anatomy, critical path, memory-ledger top
+owners, roofline MFU; ``?ticks=N&deep=1&trace=1``). Arming the server also
 arms the per-process signal sampler (disable with ``PATHWAY_SIGNALS=0``)
 and installs the crash hooks that write the postmortem bundle.
 
@@ -294,6 +296,8 @@ def start_http_server(
                     self._signals(parse_qs(parsed.query))
                 elif route == "/debug/events":
                     self._events(parse_qs(parsed.query))
+                elif route == "/debug/tick":
+                    self._tick(runtime, parse_qs(parsed.query))
                 elif route in (
                     "/fleet/metrics",
                     "/fleet/events",
@@ -334,6 +338,48 @@ def start_http_server(
             self._reply(
                 200, json.dumps(doc).encode(), "application/json"
             )
+
+        def _tick(self, runtime, query: dict) -> None:
+            """Tick Scope (observability/tickscope.py): last-tick
+            anatomy (per-operator wall/rows, compiled-vs-interpreted,
+            critical path), the memory ledger's top owners, roofline
+            MFU per kernel family, and per-channel wire bytes.
+            ``ticks=N`` adds a trailing-N operator rollup; ``deep=1``
+            includes monolith-pickle sizes (costs a pickle per
+            monolithic exec); ``trace=1`` returns the ring as Chrome
+            trace-event JSON instead (one Perfetto track per exec)."""
+            from pathway_tpu.observability import tickscope
+
+            scope = getattr(runtime, "_tickscope", None)
+            if scope is None:
+                scope = tickscope.recorder()
+            try:
+                ticks = int(query.get("ticks", ["1"])[0])
+            except ValueError:
+                self._reply(400, b"ticks must be an integer")
+                return
+            deep = query.get("deep", ["0"])[0] not in ("0", "")
+            if query.get("trace", ["0"])[0] not in ("0", ""):
+                doc = (
+                    scope.chrome_trace(n_ticks=ticks if ticks > 0 else None)
+                    if scope is not None
+                    else {"traceEvents": []}
+                )
+                self._reply(
+                    200, json.dumps(doc).encode(), "application/json"
+                )
+                return
+            if scope is None:
+                doc = {
+                    "enabled": tickscope.enabled_from_env(),
+                    "ticks_recorded": 0,
+                    "memory": tickscope.memory_snapshot(deep=deep),
+                    "roofline": tickscope.roofline().snapshot(),
+                    "wire": tickscope.wire_snapshot(),
+                }
+            else:
+                doc = scope.snapshot(ticks=max(ticks, 1), deep=deep)
+            self._reply(200, json.dumps(doc).encode(), "application/json")
 
         def _signals(self, query: dict) -> None:
             """Fleet Lens SLO signal rings (observability/signals.py):

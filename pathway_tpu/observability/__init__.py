@@ -13,9 +13,14 @@ plane fleet-wide: SLO signal rings (``observability/signals.py``,
 ``/debug/signals``), the crash-surviving incident journal
 (``observability/journal.py``, ``/debug/events``), and federation
 (``observability/fleet.py``: ``/fleet/metrics``, ``/fleet/events``,
-``/fleet/trace`` on the router). See README "Observability" for the
-metric inventory, signal/SLO knobs, journal event schema, and tracing
-guide.
+``/fleet/trace`` on the router). Tick Scope (PR 18,
+``observability/tickscope.py``, ``/debug/tick``) goes below the
+route-level spans: a per-runtime flight recorder attributing every
+tick to its operators (wall/rows/compiled-vs-interpreted + critical
+path), a resident-bytes memory ledger across execs/KV pools/replica
+indexes, and roofline MFU per kernel family. See README
+"Observability" for the metric inventory, signal/SLO knobs, journal
+event schema, tracing guide, and the tick-profiling contract.
 """
 
 from pathway_tpu.observability.registry import (
@@ -58,9 +63,24 @@ from pathway_tpu.observability.signals import (
 from pathway_tpu.observability.fleet import (
     federate_events,
     federate_metrics,
+    federate_ticks,
     members_from_env,
     stitch_traces,
     window_from_events,
+)
+from pathway_tpu.observability.tickscope import (
+    Roofline,
+    TickScope,
+    coverage_status,
+    critical_path,
+    estimate_program_cost,
+    memory_snapshot,
+    peak_flops,
+    recorder,
+    register_memory_provider,
+    roofline,
+    stitch_ranks,
+    wire_snapshot,
 )
 from pathway_tpu.observability.tracing import (
     SpanContext,
@@ -81,15 +101,21 @@ __all__ = [
     "JournalEvent",
     "MetricsRegistry",
     "ProfilerUnavailable",
+    "Roofline",
     "SignalRing",
     "SignalSampler",
     "SpanContext",
+    "TickScope",
     "Tracer",
     "arm_sampler",
+    "coverage_status",
+    "critical_path",
     "current_traceparent",
+    "estimate_program_cost",
     "escape_label_value",
     "federate_events",
     "federate_metrics",
+    "federate_ticks",
     "members_from_env",
     "get_registry",
     "get_sampler",
@@ -99,13 +125,19 @@ __all__ = [
     "install_jax_metrics",
     "journal",
     "log_linear_buckets",
+    "memory_snapshot",
     "otel_sdk_provider_active",
     "parse_exposition",
     "parse_traceparent",
+    "peak_flops",
+    "recorder",
+    "register_memory_provider",
     "reset_journal",
     "reset_sampler",
+    "roofline",
     "sanitize_metric_name",
     "slo_targets",
+    "stitch_ranks",
     "stitch_traces",
     "take_profile",
     "thread_stack_dump",

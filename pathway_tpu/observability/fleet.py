@@ -348,3 +348,78 @@ def stitch_traces(
             "exemplars": exemplars,
         },
     }
+
+
+def federate_ticks(
+    members: Mapping[str, str] | Iterable[tuple[str, str]],
+    timeout: float = DEFAULT_TIMEOUT_S,
+    local: tuple[str, dict[str, Any]] | None = None,
+    channel_edges: Iterable[
+        tuple[tuple[str, str], tuple[str, str], float]
+    ] = (),
+) -> dict[str, Any]:
+    """Tick Scope across the fleet: pull every member's ``/debug/tick``
+    and stitch the per-rank exec DAGs into one fleet-wide critical path
+    (observability/tickscope.py ``stitch_ranks``). ``channel_edges``
+    optionally adds exchange hops as
+    ``((member, node), (member, node), wait_seconds)`` — without them
+    the rank DAGs are disjoint and the fleet critical path is the
+    slowest member's chain, which is exactly the lockstep-tick answer
+    when channel waits are unmeasured."""
+    from pathway_tpu.observability.tickscope import stitch_ranks
+
+    members = _normalize_members(members)
+    errors: dict[str, str] = {}
+    docs: dict[str, dict[str, Any]] = {}
+    if local is not None:
+        docs[local[0]] = local[1]
+    for name, base in members:
+        try:
+            doc = json.loads(_fetch(f"{base}/debug/tick", timeout))
+        except Exception as exc:  # noqa: BLE001
+            errors[name] = f"{type(exc).__name__}: {exc}"
+            continue
+        if isinstance(doc, dict):
+            docs[name] = doc
+        else:
+            errors[name] = "malformed tick payload"
+
+    rank_names = sorted(docs)
+    rank_of = {name: i for i, name in enumerate(rank_names)}
+    rank_durations: dict[int, dict[str, float]] = {}
+    rank_edges: dict[int, list[tuple[str, str]]] = {}
+    per_member: dict[str, Any] = {}
+    for name in rank_names:
+        last = docs[name].get("last") or {}
+        ops = last.get("operators") or []
+        rank_durations[rank_of[name]] = {
+            op["node"]: float(op.get("wall_ms", 0.0)) / 1e3
+            for op in ops
+            if isinstance(op, dict) and "node" in op
+        }
+        rank_edges[rank_of[name]] = [
+            (s, d)
+            for e in (last.get("edges") or [])
+            if isinstance(e, (list, tuple)) and len(e) == 2
+            for s, d in [(str(e[0]), str(e[1]))]
+        ]
+        per_member[name] = {
+            "tick_wall_ms": last.get("wall_ms"),
+            "critical_path": last.get("critical_path"),
+        }
+    stitched = [
+        ((rank_of[sm], sn), (rank_of[dm], dn), float(w))
+        for (sm, sn), (dm, dn), w in channel_edges
+        if sm in rank_of and dm in rank_of
+    ]
+    total_s, path = stitch_ranks(rank_durations, rank_edges, stitched)
+    return {
+        "members": per_member,
+        "errors": errors,
+        "critical_path": {
+            "total_ms": round(total_s * 1e3, 6),
+            "stages": [
+                f"{rank_names[r]}:{node}" for r, node in path
+            ],
+        },
+    }

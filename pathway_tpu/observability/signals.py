@@ -391,6 +391,11 @@ class SignalSampler:
 
     def sample_once(self) -> None:
         """Take one snapshot of every signal (never raises)."""
+        # CLOCK CONTRACT (PR-18 audit): `wall` is display-only (the
+        # timestamp shown in /debug/signals and the journal); every
+        # rate/window/burn computation below uses `mono` deltas, so a
+        # stepped or frozen wall clock cannot distort a signal — see
+        # the frozen-wall-clock regression test in tests/test_tickscope.py
         wall = time.time()
         mono = time.monotonic()
         with self._lock:

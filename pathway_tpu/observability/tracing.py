@@ -42,7 +42,15 @@ logger = logging.getLogger("pathway_tpu")
 
 # wall-clock anchor for the monotonic clock: span timestamps are
 # perf_counter_ns offsets from one anchor, so they are strictly ordered
-# within the process and immune to wall-clock steps
+# within the process and immune to wall-clock steps.
+#
+# CLOCK CONTRACT (PR-18 audit): every DURATION in this module is a
+# difference of two perf_counter_ns reads; wall time appears only as
+# this one anchor, captured once at import, used for display/export
+# epochs (start_unix_ns, chrome_trace ts, trailing-window cutoffs
+# computed as anchored-monotonic). Freezing or stepping time.time()
+# after import must not change any measured duration — enforced by the
+# frozen-wall-clock regression test in tests/test_tickscope.py.
 _ANCHOR_NS = time.time_ns() - time.perf_counter_ns()
 
 _TRACEPARENT_RE = re.compile(

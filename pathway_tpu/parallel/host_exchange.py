@@ -845,6 +845,11 @@ class HostMesh:
                     self._m_ratio.labels(frame[2]).set(
                         stats["raw_bytes"] / max(len(body) - 1, 1)
                     )
+                if frame[0] == "data":
+                    # Tick Scope wire tap: per-channel encoded bytes —
+                    # the sender thread is off the tick hot loop, so the
+                    # tap's small lock is free concurrency-wise
+                    wire.tap_frame(str(frame[2]), len(body), stats)
                 for _ in range(repeats):
                     mac = _frame_mac(self._key, self.pid, dst, seq, body)
                     seq += 1

@@ -576,6 +576,25 @@ def encode_frame(
     )
 
 
+def tap_frame(channel: str, wire_bytes: int, stats: dict | None) -> None:
+    """Report one encoded data frame to Tick Scope's per-channel byte
+    ledger (observability/tickscope.py). Callers (the mesh sender loop,
+    replication shippers) pass the encoded body length plus the codec
+    stats from :func:`encode_frame`; pickle frames carry no row count.
+    Best-effort — accounting must never fail a send."""
+    try:
+        from pathway_tpu.observability import tickscope
+
+        tickscope.wire_tap(
+            channel,
+            wire_bytes,
+            raw_bytes=(stats or {}).get("raw_bytes", 0),
+            rows=(stats or {}).get("rows", 0),
+        )
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
 def decode_frame(body: bytes) -> tuple:
     """Inverse of :func:`encode_frame`; returns the mesh frame tuple."""
     tag = body[:1]

@@ -381,6 +381,28 @@ class ReplicaServer:
             self._client.start()
         self._http.start()
         self.http_port = self._http.port
+        # Tick Scope: a serving surface is now live — the
+        # tickscope-coverage doctor rule INFOs if the flight recorder is
+        # disabled while this replica serves. The memory provider hands
+        # the replica's index residency to the same ledger the engine
+        # execs report into (owner "replica:<id>").
+        from pathway_tpu.observability import tickscope as _ts
+
+        import weakref as _weakref
+
+        _r = _weakref.ref(self)
+
+        def _replica_memory():
+            rep = _r()
+            if rep is None or rep._closed:
+                return {}
+            _docs, nbytes = rep.corpus_stats()
+            return {"index": max(int(nbytes), 0)}
+
+        _ts.register_memory_provider(
+            f"replica:{self.replica_id}", _replica_memory
+        )
+        _ts.mark_serving(True)
         return self
 
     def stop(self) -> None:
@@ -390,6 +412,9 @@ class ReplicaServer:
         if self.generate_scheduler is not None:
             self.generate_scheduler.stop()
         self._http.stop()
+        from pathway_tpu.observability import tickscope as _ts
+
+        _ts.unregister_memory_provider(f"replica:{self.replica_id}")
 
     # --- hydrate + deltas -------------------------------------------------
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 import math
 import os
 import re
+import time as _time
 from collections import defaultdict
 from typing import Any, Sequence
 
@@ -201,6 +202,7 @@ class TpuDenseKnnIndex:
         eff_k = min(
             len(self.corpus), max_k * 4 if has_filter else max_k
         )
+        _rt0 = _time.perf_counter()
         if self.mesh is not None:
             corpus_arr, valid = self.corpus.device_arrays()
             scores, idx = sharded_topk(
@@ -244,6 +246,26 @@ class TpuDenseKnnIndex:
                 )
         scores = np.asarray(scores, dtype=np.float64)[:n_q]
         idx = np.asarray(idx)[:n_q]
+        # Tick Scope roofline, family "topk": analytic FLOPs (the score
+        # matmul dominates: 2*B*N*D per call) over measured wall with the
+        # host sync included. Registered analytically because the pallas
+        # kernel's interpret-mode lowering has no XLA cost model.
+        try:
+            from pathway_tpu.observability import tickscope as _ts
+
+            _n, _d = len(self.corpus), qmat.shape[1]
+            _key = f"topk_b{qmat.shape[0]}_n{_n}_d{_d}_k{eff_k}"
+            _rl = _ts.roofline()
+            if not _rl.known("topk", _key):
+                _rl.register(
+                    "topk",
+                    _key,
+                    2.0 * qmat.shape[0] * _n * _d,
+                    source="analytic",
+                )
+            _rl.observe("topk", _key, _time.perf_counter() - _rt0)
+        except Exception:  # pragma: no cover - defensive
+            pass
         if self.metric == "cosine":
             # reference USearch COS scores are -(1 - cos): negative
             # distances, not raw similarities

@@ -1,0 +1,690 @@
+"""Tick Scope tests — per-operator flight recorder, memory ledger,
+roofline attribution (observability/tickscope.py + engine hooks).
+
+Tier-1 coverage of the PR-18 acceptance bars:
+
+* critical-path property test: random DAGs checked against a
+  brute-force path enumeration (node weights + edge weights), cycle
+  detection, and the cross-rank ``stitch_ranks`` composition;
+* memory-ledger conservation: the runtime provider's parts equal the
+  per-exec ``exec_memory_ledger`` sums, ``deep=True`` adds monolith
+  pickle bytes and never shrinks the total;
+* frozen-wall-clock regression (the PR-18 clock audit): with
+  ``time.time`` pinned, tracer span durations, signal sampling and
+  tick records all stay correct — every duration is a monotonic delta,
+  wall is display-only;
+* recorder on/off contract: ``PATHWAY_TICKSCOPE=0`` means
+  ``begin_tick`` returns None and nothing is recorded; default-on
+  records per-operator entries that reconcile with the tick wall;
+* sub-millisecond buckets for the per-operator tick histogram
+  (compiled ticks finish in 10-100 us — the old 0.1 ms floor flattened
+  them into one bucket);
+* roofline MFU math against a pinned PATHWAY_PEAK_FLOPS + XLA cost
+  analysis on a real jitted program;
+* the ``tickscope-coverage`` plane-doctor rule (INFO and WARNING);
+* the ``/debug/tick`` surface (anatomy, deep ledger, Chrome trace) and
+  ``federate_ticks`` fleet stitching over fake members.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw  # noqa: F401 — parse-graph fixture parity
+from pathway_tpu.observability import tickscope
+
+
+@pytest.fixture(autouse=True)
+def _tickscope_env(monkeypatch):
+    for var in (
+        "PATHWAY_TICKSCOPE",
+        "PATHWAY_TICKSCOPE_RING",
+        "PATHWAY_PEAK_FLOPS",
+        "PATHWAY_COMPILED_MIN_ROWS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    tickscope.reset()
+    yield
+    tickscope.reset()
+
+
+# --- pipeline fixture ------------------------------------------------------
+
+
+def _ref(name):
+    from pathway_tpu.engine.expression_eval import InternalColRef
+
+    return InternalColRef(0, name)
+
+
+def _obj_col(values):
+    out = np.empty(len(values), dtype=object)
+    out[:] = values
+    return out
+
+
+def _ticks(n, per, cols):
+    from pathway_tpu.engine.batch import DiffBatch
+
+    out = []
+    for lo in range(0, n, per):
+        hi = min(n, lo + per)
+        out.append(
+            DiffBatch(
+                np.arange(lo, hi, dtype=np.uint64),
+                np.ones(hi - lo, np.int64),
+                {c: _obj_col(vals[lo:hi]) for c, vals in cols.items()},
+            )
+        )
+    return out
+
+
+def _chain_runtime(n=512, per=128, worker_threads=False):
+    """input -> rowwise -> filter -> groupby -> output over n rows."""
+    from pathway_tpu.engine.nodes import (
+        FilterNode,
+        GroupByNode,
+        InputNode,
+        OutputNode,
+        RowwiseNode,
+    )
+    from pathway_tpu.engine.reducers import ReducerSpec
+    from pathway_tpu.engine.runtime import Runtime, StaticSource
+
+    class _Src(StaticSource):
+        def __init__(self, names, ticks):
+            super().__init__(names)
+            self._ticks = ticks
+
+        def events(self):
+            for i, b in enumerate(self._ticks):
+                yield i, b
+
+    rng = np.random.default_rng(7)
+    a = [int(v) for v in rng.integers(-100, 100, n)]
+    rows = [0]
+
+    def sink(t, b):
+        rows[0] += len(b)
+
+    inp = InputNode(_Src(["a"], _ticks(n, per, {"a": a})), ["a"])
+    m = RowwiseNode([inp], {"g": _ref("a") & 7, "v": _ref("a") * 2})
+    f = FilterNode(m, _ref("v") > -195)
+    gb = GroupByNode(f, ["g"], {"cnt": ReducerSpec(kind="count")})
+    rt = Runtime(
+        [OutputNode(gb, sink)], worker_threads=worker_threads
+    )
+    return rt, rows
+
+
+# --- critical path (satellite 4: property test) ----------------------------
+
+
+def _brute_force_longest(durations, edges, edge_weights):
+    """Independent oracle: enumerate every path (small DAGs only)."""
+    succs = {}
+    for s, d in edges:
+        succs.setdefault(s, []).append(d)
+    nodes = set(durations) | {x for e in edges for x in e}
+    best = 0.0
+    if nodes:
+        best = max(durations.get(n, 0.0) for n in nodes)
+
+    def walk(n, total):
+        nonlocal best
+        best = max(best, total)
+        for d in succs.get(n, ()):
+            walk(
+                d,
+                total
+                + edge_weights.get((n, d), 0.0)
+                + durations.get(d, 0.0),
+            )
+
+    for n in nodes:
+        walk(n, durations.get(n, 0.0))
+    return best
+
+
+def test_critical_path_random_dags_match_brute_force():
+    for seed in range(25):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(2, 9))
+        durations = {
+            i: float(rng.uniform(0.0, 10.0)) for i in range(n)
+        }
+        # i < j only: acyclic by construction
+        edges = [
+            (i, j)
+            for i in range(n)
+            for j in range(i + 1, n)
+            if rng.uniform() < 0.4
+        ]
+        weights = (
+            {e: float(rng.uniform(0.0, 3.0)) for e in edges}
+            if seed % 2
+            else {}
+        )
+        total, path = tickscope.critical_path(
+            durations, edges, weights or None
+        )
+        expect = _brute_force_longest(durations, edges, weights)
+        assert total == pytest.approx(expect), (seed, edges)
+        # the returned path re-sums to the total
+        resum = durations.get(path[0], 0.0) if path else 0.0
+        for s, d in zip(path, path[1:]):
+            assert (s, d) in edges
+            resum += weights.get((s, d), 0.0) + durations.get(d, 0.0)
+        assert resum == pytest.approx(total)
+
+
+def test_critical_path_cycle_raises():
+    with pytest.raises(ValueError, match="cycle"):
+        tickscope.critical_path({0: 1.0, 1: 1.0}, [(0, 1), (1, 0)])
+
+
+def test_critical_path_empty():
+    assert tickscope.critical_path({}, []) == (0.0, [])
+
+
+def test_stitch_ranks_cross_rank_edge():
+    total, path = tickscope.stitch_ranks(
+        {0: {"a": 1.0, "b": 2.0}, 1: {"c": 0.5, "d": 0.25}},
+        {0: [("a", "b")], 1: [("c", "d")]},
+        [((0, "b"), (1, "c"), 0.3)],
+    )
+    assert total == pytest.approx(1.0 + 2.0 + 0.3 + 0.5 + 0.25)
+    assert path == [(0, "a"), (0, "b"), (1, "c"), (1, "d")]
+
+
+def test_stitch_ranks_disjoint_is_slowest_member():
+    # no channel edges: the fleet answer is the slowest rank's chain —
+    # exactly right for a lockstep tick with unmeasured channel waits
+    total, path = tickscope.stitch_ranks(
+        {0: {"a": 1.0}, 1: {"c": 5.0}}, {0: [], 1: []}
+    )
+    assert total == pytest.approx(5.0)
+    assert path == [(1, "c")]
+
+
+# --- flight recorder on/off ------------------------------------------------
+
+
+def test_recorder_disabled_is_none_and_records_nothing(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TICKSCOPE", "0")
+    rt, rows = _chain_runtime()
+    assert rt._tickscope.enabled is False
+    assert rt._tickscope.begin_tick(0) is None
+    rt.run()
+    assert rows[0] > 0
+    assert rt._tickscope.ticks_recorded == 0
+    assert rt._tickscope.records() == []
+
+
+def test_recorder_records_per_operator_entries():
+    rt, rows = _chain_runtime(n=512, per=128)
+    rt.run()
+    scope = rt._tickscope
+    assert scope.enabled
+    assert scope.ticks_recorded >= 4
+    rec = scope.records()[0]
+    names = {scope._names[e[0]] for e in rec.entries}
+    assert any(n.startswith("InputNode") for n in names)
+    assert any(n.startswith("GroupByNode") for n in names)
+    for nid, t0, t1, rin, rout, compiled in rec.entries:
+        assert t1 >= t0
+        assert rin >= 0 and rout >= 0
+    # stage sum can never exceed the single-threaded tick wall, and
+    # after the ingest-attribution fix it accounts for nearly all of it
+    stage_ns = sum(e[2] - e[1] for e in rec.entries)
+    assert stage_ns <= rec.tick_ns
+    total, path = scope.record_critical_path(rec)
+    assert 0 < total <= rec.tick_ns / 1e9 + 1e-9
+    assert path  # the chain orders input before output
+    rollup = scope.operator_rollup()
+    assert sum(d["rows_in"] for d in rollup.values()) > 0
+    snap = scope.snapshot(ticks=4)
+    assert snap["last"]["critical_path"]["coverage"] > 0
+    assert snap["last"]["edges"]  # name-pair DAG for fleet stitching
+    assert "rollup" in snap
+
+
+def test_ring_bound(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TICKSCOPE_RING", "2")
+    rt, _ = _chain_runtime(n=512, per=64)
+    rt.run()
+    scope = rt._tickscope
+    assert scope.ticks_recorded >= 8
+    assert len(scope.records()) == 2
+
+
+def test_chrome_trace_one_track_per_exec():
+    from pathway_tpu.observability.tracing import validate_chrome_trace
+
+    rt, _ = _chain_runtime()
+    rt.run()
+    doc = rt._tickscope.chrome_trace()
+    assert validate_chrome_trace(doc) == []
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert slices
+    # one thread_name metadata event per distinct exec track
+    assert len(meta) == len({e["tid"] for e in slices})
+
+
+# --- memory ledger (satellite 4: conservation) -----------------------------
+
+
+def test_memory_ledger_conservation():
+    rt, _ = _chain_runtime(n=512, per=128)
+    gb_execs = [
+        ex
+        for ex in rt.execs.values()
+        if type(ex).__name__ == "GroupByExec"
+    ]
+    assert gb_execs
+    gb_execs[0].enable_state_ledger()
+    rt.run()
+    snap = tickscope.memory_snapshot()
+    parts = snap["owners"]["runtime"]
+    # conservation: the provider's parts are exactly the per-exec
+    # ledgers, re-derived independently here
+    expect = {}
+    for nid, ex in rt.execs.items():
+        for part, nbytes in tickscope.exec_memory_ledger(ex).items():
+            if nbytes:
+                expect[f"{rt._tickscope._names[nid]}/{part}"] = nbytes
+    assert parts == expect
+    assert snap["total_bytes"] == sum(parts.values())
+    assert any("ledger_blobs" in k for k in parts)
+    # top list is sorted descending and drawn from the parts
+    tops = [b for _, b in snap["top"]]
+    assert tops == sorted(tops, reverse=True)
+
+
+def test_memory_ledger_deep_adds_monolith_pickle():
+    rt, _ = _chain_runtime(n=256, per=64)
+    rt.run()  # GroupBy ledger NOT enabled: monolithic state
+    shallow = tickscope.memory_snapshot(deep=False)
+    deep = tickscope.memory_snapshot(deep=True)
+    deep_parts = deep["owners"]["runtime"]
+    assert any(k.endswith("/monolith_pickle") for k in deep_parts)
+    assert not any(
+        k.endswith("/monolith_pickle")
+        for k in shallow["owners"].get("runtime", {})
+    )
+    assert deep["total_bytes"] >= shallow["total_bytes"]
+
+
+def test_memory_provider_registry_and_errors():
+    tickscope.register_memory_provider("good", lambda: {"x": 10})
+    tickscope.register_memory_provider(
+        "bad", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    snap = tickscope.memory_snapshot()
+    assert snap["owners"]["good"] == {"x": 10}
+    assert "bad" not in snap["owners"]  # exceptions swallowed
+    tickscope.unregister_memory_provider("good")
+    assert "good" not in tickscope.memory_snapshot()["owners"]
+
+
+def test_kv_ledger_resident_bytes():
+    from pathway_tpu.generate.kv_cache import KvLedger
+
+    kv = KvLedger()
+    page = np.zeros((1, 4, 2, 8), np.float32)
+    kv.put_page(0, 0, page, page)
+    kv.put_seq(0, {"seq_id": 0})
+    parts = kv.resident_bytes()
+    assert parts["host_mirror"] >= 2 * page.nbytes
+    assert parts["pages_arrangement"] > 0
+    assert parts["seqs_arrangement"] > 0
+
+
+def test_arrangement_resident_bytes_lower_bound():
+    from pathway_tpu.engine.arrangement import Arrangement
+
+    arr = Arrangement(n_cols=1)
+    n = 64
+    arr.append(
+        np.arange(n, dtype=np.uint64),
+        np.arange(n, dtype=np.uint64),
+        np.ones(n, np.int64),
+        [_obj_col([float(i) for i in range(n)])],
+    )
+    # at least the three u64/i64 index arrays' raw bytes
+    assert arr.resident_bytes() >= 3 * n * 8
+
+
+# --- clock audit (satellite 2: frozen wall clock) --------------------------
+
+
+def test_frozen_wall_clock_durations_unaffected(monkeypatch):
+    """Pin time.time: spans, signals and tick records must keep
+    working — every duration is a perf_counter delta (the PR-18 clock
+    audit contract in tracing.py / signals.py)."""
+    from pathway_tpu.observability.signals import SignalSampler
+    from pathway_tpu.observability.tracing import Tracer
+
+    frozen = 1_700_000_000.0
+    monkeypatch.setattr(time, "time", lambda: frozen)
+
+    tr = Tracer(capacity=16, enabled=True)
+    with tr.span("frozen-op"):
+        time.sleep(0.02)
+    rec = tr.spans()[-1]
+    assert rec.duration_ns >= 15_000_000  # ~20 ms slept
+
+    sampler = SignalSampler(interval_s=0.05)
+    sampler.sample_once()
+    time.sleep(0.01)
+    sampler.sample_once()  # mono deltas: no div-by-zero, no negatives
+    snap = sampler.snapshot()
+    assert snap["samples"] >= 2
+
+    rt, _ = _chain_runtime(n=128, per=64)
+    rt.run()
+    rec = rt._tickscope.last()
+    assert rec is not None
+    assert rec.tick_ns > 0
+    assert all(e[2] >= e[1] for e in rec.entries)
+
+
+# --- sub-millisecond buckets (satellite 3) ---------------------------------
+
+
+def test_operator_tick_histogram_has_sub_ms_buckets():
+    from pathway_tpu.observability.registry import REGISTRY
+
+    rt, _ = _chain_runtime()  # construction registers the family
+    fam = REGISTRY._metrics["pathway_operator_tick_seconds"]
+    assert fam.bounds[0] <= 2e-6
+    # enough resolution below the old 1e-4 floor to separate 10 us
+    # compiled ticks from 100 us ones
+    assert sum(1 for b in fam.bounds if b < 1e-4) >= 8
+    del rt
+
+
+def test_kernel_seconds_histogram_sub_ms():
+    r = tickscope.Roofline()
+    r.observe("compiled_tick", "k", 5e-5)  # drives the histogram too
+    from pathway_tpu.observability.registry import REGISTRY
+
+    fam = REGISTRY._metrics["pathway_tickscope_kernel_seconds"]
+    assert fam.bounds[0] <= 2e-6
+
+
+# --- roofline --------------------------------------------------------------
+
+
+def test_roofline_mfu_math(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PEAK_FLOPS", "1e9")
+    r = tickscope.Roofline()
+    r.register("fam", "k1", flops=1e6, bytes_accessed=4e6)
+    r.observe("fam", "k1", 1e-3)
+    r.observe("fam", "k1", 1e-3)
+    snap = r.snapshot()["fam"]
+    assert snap["calls"] == 2
+    assert snap["flops_total"] == pytest.approx(2e6)
+    assert snap["achieved_flops_s"] == pytest.approx(1e9, rel=1e-6)
+    assert snap["mfu"] == pytest.approx(1.0, rel=1e-6)
+    assert r.known("fam", "k1") and not r.known("fam", "nope")
+    assert r.samples("fam") == 2
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PEAK_FLOPS", "123.5e12")
+    assert tickscope.peak_flops() == pytest.approx(123.5e12)
+    monkeypatch.delenv("PATHWAY_PEAK_FLOPS")
+    assert tickscope.peak_flops() > 0  # CPU table fallback
+
+
+def test_estimate_program_cost_real_program():
+    import jax
+
+    fn = jax.jit(lambda x: x @ x)
+    flops, nbytes = tickscope.estimate_program_cost(
+        fn, jax.ShapeDtypeStruct((16, 16), np.float32)
+    )
+    # 16^3 multiply-adds = 8192 flops at minimum
+    assert flops >= 4096
+    assert nbytes >= 0
+
+
+def test_compiled_tick_roofline_hook(monkeypatch):
+    """The engine/compile.py hook registers + observes compiled_tick
+    programs when segments actually run jitted."""
+    monkeypatch.setenv("PATHWAY_COMPILED_MIN_ROWS", "1")
+    rt, _ = _chain_runtime(n=512, per=128)
+    rt.run()
+    assert rt.compiled_plan is not None and rt.compiled_plan.segments
+    assert tickscope.roofline().samples("compiled_tick") > 0
+    snap = tickscope.roofline().snapshot()["compiled_tick"]
+    assert snap["flops_total"] > 0
+    assert snap["wall_s"] > 0
+    # and the recorder tagged at least one entry compiled
+    assert rt._tickscope.compiled_entries > 0
+
+
+# --- wire taps -------------------------------------------------------------
+
+
+def test_wire_tap_accounting():
+    tickscope.wire_tap("exch:0", 100, raw_bytes=240, rows=5)
+    tickscope.wire_tap("exch:0", 50, raw_bytes=120, rows=2)
+    snap = tickscope.wire_snapshot()["exch:0"]
+    assert snap == {
+        "wire_bytes": 150,
+        "raw_bytes": 360,
+        "rows": 7,
+        "frames": 2,
+    }
+
+
+def test_tap_frame_best_effort():
+    from pathway_tpu.parallel import wire
+
+    wire.tap_frame("ch9", 64, {"raw_bytes": 128, "rows": 3})
+    assert tickscope.wire_snapshot()["ch9"]["frames"] == 1
+    wire.tap_frame("ch9", 32, None)  # stats-less frame: still counted
+    assert tickscope.wire_snapshot()["ch9"]["wire_bytes"] == 96
+
+
+# --- plane-doctor rule (satellite 5) ---------------------------------------
+
+
+def _coverage_diags():
+    from pathway_tpu.analysis import run_plane_doctor
+
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        a | 1
+        """
+    )
+    pw.io.null.write(t)
+    return run_plane_doctor().by_rule("tickscope-coverage")
+
+
+def test_coverage_rule_info_when_serving_blind(monkeypatch):
+    from pathway_tpu.analysis import Severity
+
+    monkeypatch.setenv("PATHWAY_TICKSCOPE", "0")
+    tickscope.mark_serving(True)
+    diags = [
+        d for d in _coverage_diags() if d.severity == Severity.INFO
+    ]
+    assert diags
+    assert "PATHWAY_TICKSCOPE" in diags[0].message
+
+
+def test_coverage_rule_quiet_when_recording(monkeypatch):
+    from pathway_tpu.analysis import Severity
+
+    tickscope.mark_serving(True)  # serving AND recording: no INFO
+    assert not [
+        d for d in _coverage_diags() if d.severity == Severity.INFO
+    ]
+
+
+def test_coverage_rule_warns_on_zero_roofline_samples(monkeypatch):
+    from pathway_tpu.analysis import Severity
+
+    monkeypatch.setenv("PATHWAY_COMPILED_MIN_ROWS", "1")
+    rt, _ = _chain_runtime(n=256, per=64)
+    rt.run()
+    assert tickscope.coverage_status()["compiled_ticks"] > 0
+    # samples exist -> quiet
+    assert not [
+        d
+        for d in _coverage_diags()
+        if d.severity == Severity.WARNING
+    ]
+    # wipe the roofline (reset) while the compiled runtime lives on:
+    # compiled ticks with zero samples = silently-broken hook
+    tickscope.reset()
+    diags = [
+        d
+        for d in _coverage_diags()
+        if d.severity == Severity.WARNING
+    ]
+    assert diags
+    assert "compiled_tick" in diags[0].message
+    del rt
+
+
+# --- /debug/tick + fleet federation ----------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_debug_tick_endpoint():
+    from pathway_tpu.internals.monitoring_server import start_http_server
+
+    rt, _ = _chain_runtime(n=512, per=128)
+    rt.run()
+    port = _free_port()
+    server = start_http_server(rt, port=port)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        doc = _get_json(f"{base}/debug/tick?ticks=4&deep=1")
+        assert doc["enabled"] is True
+        assert doc["ticks_recorded"] >= 4
+        ops = doc["last"]["operators"]
+        assert ops and all("wall_ms" in o for o in ops)
+        assert doc["last"]["critical_path"]["stages"]
+        assert "rollup" in doc
+        assert any(
+            k.endswith("/monolith_pickle")
+            for k in doc["memory"]["owners"].get("runtime", {})
+        )
+        trace = _get_json(f"{base}/debug/tick?trace=1")
+        assert trace["traceEvents"]
+        assert _get_json(f"{base}/debug/tick")["ring"] >= 1
+    finally:
+        server.shutdown()
+
+
+class _TickMember(BaseHTTPRequestHandler):
+    doc: dict = {}
+
+    def do_GET(self):  # noqa: N802
+        body = json.dumps(type(self).doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def _member(doc):
+    handler = type("_H", (_TickMember,), {"doc": doc})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _tick_doc(ops, edges, wall_ms):
+    return {
+        "enabled": True,
+        "last": {
+            "t": 3,
+            "wall_ms": wall_ms,
+            "operators": [
+                {"node": n, "wall_ms": ms} for n, ms in ops
+            ],
+            "edges": edges,
+            "critical_path": {
+                "total_ms": sum(ms for _, ms in ops),
+                "stages": [n for n, _ in ops],
+            },
+        },
+    }
+
+
+def test_federate_ticks_stitches_fleet_critical_path():
+    from pathway_tpu.observability.fleet import federate_ticks
+
+    srv_a, url_a = _member(
+        _tick_doc(
+            [("In_1", 2.0), ("Out_2", 1.0)], [["In_1", "Out_2"]], 3.5
+        )
+    )
+    srv_b, url_b = _member(
+        _tick_doc(
+            [("In_1", 4.0), ("Out_2", 0.5)], [["In_1", "Out_2"]], 5.0
+        )
+    )
+    try:
+        res = federate_ticks({"a": url_a, "b": url_b})
+        assert res["errors"] == {}
+        assert set(res["members"]) == {"a", "b"}
+        # disjoint DAGs: the slowest member's chain wins (4.5 ms on b)
+        assert res["critical_path"]["total_ms"] == pytest.approx(4.5)
+        assert res["critical_path"]["stages"] == [
+            "b:In_1",
+            "b:Out_2",
+        ]
+        # a channel hop from a's output into b's input stitches one
+        # cross-rank path: 2.0 + 1.0 + wait 1.0 + 4.0 + 0.5 = 8.5
+        res2 = federate_ticks(
+            {"a": url_a, "b": url_b},
+            channel_edges=[(("a", "Out_2"), ("b", "In_1"), 1e-3)],
+        )
+        assert res2["critical_path"]["total_ms"] == pytest.approx(8.5)
+        assert res2["critical_path"]["stages"][0] == "a:In_1"
+        # dead member: reported, not fatal
+        res3 = federate_ticks(
+            {"a": url_a, "dead": "http://127.0.0.1:9"}, timeout=0.5
+        )
+        assert "dead" in res3["errors"]
+        assert "a" in res3["members"]
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_coverage_status_names_serving_providers():
+    tickscope.register_memory_provider("replica:7", lambda: {"x": 1})
+    assert tickscope.coverage_status()["serving_active"] is True
+    tickscope.unregister_memory_provider("replica:7")
