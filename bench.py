@@ -3829,6 +3829,314 @@ def _bench_obs_overhead(np):
             os.environ.pop("PATHWAY_DCN_SECRET", None)
 
 
+def _bench_autoscale_diurnal(np):
+    """Flux Pilot tier (SCALE_r19.json, ISSUE 19 acceptance): the
+    SLO-driven autoscaler against a compressed diurnal load curve,
+    versus the two static provisioning baselines.
+
+    The model: offered load follows a squared-sine diurnal arch
+    (base 60 req/s, peak 380 req/s, period 240 virtual seconds), one
+    rank serves 200 req/s, and anything over capacity is shed.  Three
+    legs run the identical curve for one unscored warmup cycle plus
+    two scored cycles:
+
+    * ``static_min`` — pinned at 1 rank (cheap, sheds every surge),
+    * ``static_max`` — pinned at 2 ranks (never sheds, pays double),
+    * ``flux_pilot`` — a real :class:`AutoscaleController` +
+      :class:`LoadForecaster` closed loop.  The forecaster is seeded
+      from the warmup cycle's burn ring (the ``seed`` path), so the
+      diurnal profile is complete before the scored window opens and
+      scale-ups fire AHEAD of the surge edge.
+
+    Everything is virtual-clock: the controller's ``step(now)`` takes
+    the sim clock directly (no wall sleeps), which is what compresses
+    a full diurnal day into well under a second of wall time.  The
+    burn source mirrors ``SignalSampler.burn_rates`` over a real
+    ``SignalRing`` stamped with sim time.
+
+    Acceptance bars (recorded in ``acceptance``):
+      * flux_pilot rank-seconds <= 0.8 x static_max rank-seconds,
+      * flux_pilot shed within 10% of static_max's (and strictly
+        under static_min's),
+      * <= 2 resizes per modeled surge edge,
+      * ``error_served_total == 0`` on every leg,
+      * actuation windows derived from ``autoscale-decision`` ->
+        ``autoscale-applied`` journal events, never stopwatches.
+    """
+    import math as _math
+
+    from pathway_tpu.autoscale import (
+        AutoscaleConfig,
+        AutoscaleController,
+        CallbackActuator,
+        LoadForecaster,
+    )
+    from pathway_tpu.observability.fleet import window_from_events
+    from pathway_tpu.observability.journal import journal
+    from pathway_tpu.observability.registry import MetricsRegistry
+    from pathway_tpu.observability.signals import SignalRing
+
+    PERIOD = 240.0          # one virtual "day"
+    WARMUP_CYCLES = 1       # unscored; seeds the forecaster profile
+    SCORED_CYCLES = 2
+    DT = 1.0                # virtual seconds per sim step
+    RANK_CAPACITY = 200.0   # req/s one rank serves
+    BASE, AMP = 60.0, 320.0  # offered: 60 .. 380 req/s
+    SHED_TARGET = 0.01      # the shed_rate SLO (PATHWAY_SLO_SHED_RATE)
+    BURN_WINDOW_S = 8.0
+    SURGE_EDGES = 2 * SCORED_CYCLES  # one rising + one falling per cycle
+
+    def _offered(t):
+        s = _math.sin(2.0 * _math.pi * t / PERIOD)
+        return BASE + AMP * max(0.0, s) ** 2
+
+    class _RingBurn:
+        """``SignalSampler.burn_rates``-shaped burn source over a real
+        SignalRing, stamped with the sim's virtual clock so the whole
+        day compresses into one wall second."""
+
+        def __init__(self):
+            self.ring = SignalRing(4096)
+            self.now = 0.0
+
+        def push(self, mono, shed_rate):
+            self.ring.append(mono, mono, shed_rate)
+            self.now = mono
+
+        def burn_rates(self):
+            avg = self.ring.window_avg(BURN_WINDOW_S, self.now)
+            burn = None if avg is None else avg / SHED_TARGET
+            return {
+                "shed_rate": {
+                    "target": SHED_TARGET,
+                    "direction": "max",
+                    "window_avg": avg,
+                    "burn": burn,
+                }
+            }
+
+    def _run_leg(mode):
+        horizon = PERIOD * (WARMUP_CYCLES + SCORED_CYCLES)
+        scored_from = PERIOD * WARMUP_CYCLES
+        burnsrc = _RingBurn()
+        ctrl = None
+        sim = {"ranks": 1}
+        jseq0 = max(
+            [int(e.get("seq") or 0) for e in journal().events()] or [0]
+        )
+        if mode == "flux_pilot":
+            cfg = AutoscaleConfig(
+                min_ranks=1,
+                max_ranks=2,
+                up_window_s=6.0,
+                down_window_s=30.0,
+                cooldown_s=20.0,
+                low_water=0.5,
+                step=1,
+                horizon_s=30.0,
+            )
+            predictor = LoadForecaster(
+                tau_s=20.0, period_s=PERIOD, buckets=48
+            )
+
+            def _actuate(m):
+                sim["ranks"] = m
+
+            ctrl = AutoscaleController(
+                CallbackActuator(_actuate, label="diurnal-sim"),
+                ranks=1,
+                config=cfg,
+                policy=None,
+                predictor=predictor,
+                sampler=burnsrc,
+                registry=MetricsRegistry(),
+            )
+        elif mode == "static_max":
+            sim["ranks"] = 2
+        tally = {
+            "offered": 0.0,
+            "served": 0.0,
+            "shed": 0.0,
+            "rank_seconds": 0.0,
+            "lat_ms": [],
+        }
+        t = 0.0
+        seed_points = []
+        while t < horizon:
+            r = ctrl.ranks if ctrl is not None else sim["ranks"]
+            cap = r * RANK_CAPACITY
+            off = _offered(t)
+            served = min(off, cap)
+            shed = off - served
+            rate = shed / off if off > 0.0 else 0.0
+            burnsrc.push(t, rate)
+            # M/M/1-flavoured latency proxy: saturated ranks queue
+            util = served / cap if cap > 0.0 else 1.0
+            lat_ms = 5.0 / max(1.0 - min(util, 0.995), 0.005)
+            if t >= scored_from:
+                tally["offered"] += off * DT
+                tally["served"] += served * DT
+                tally["shed"] += shed * DT
+                tally["rank_seconds"] += r * DT
+                tally["lat_ms"].append(lat_ms)
+            elif ctrl is not None:
+                # warmup cycle: the controller holds (no actuation)
+                # while the burn series accrues for seed()
+                br = burnsrc.burn_rates()["shed_rate"]["burn"]
+                if br is not None:
+                    seed_points.append((t, br))
+            if ctrl is not None:
+                if t >= scored_from:
+                    if t == scored_from and seed_points:
+                        ctrl.predictor.seed(seed_points)
+                    ctrl.step(t)
+            t += DT
+        lat = sorted(tally.pop("lat_ms"))
+        leg = {
+            "ranks_policy": mode,
+            "offered_reqs": round(tally["offered"], 1),
+            "served_reqs": round(tally["served"], 1),
+            "shed_reqs": round(tally["shed"], 1),
+            "shed_rate": round(
+                tally["shed"] / tally["offered"], 6
+            )
+            if tally["offered"] > 0
+            else 0.0,
+            "rank_seconds": round(tally["rank_seconds"], 1),
+            "p99_latency_model_ms": round(
+                lat[min(int(0.99 * len(lat)), len(lat) - 1)], 2
+            ),
+            # the sim has no error path by construction; the serving
+            # plane's live error evidence is the serve_chaos tier's job
+            "error_served_total": 0,
+        }
+        if ctrl is not None:
+            evs = journal().events(
+                kinds=[
+                    "autoscale-decision",
+                    "autoscale-applied",
+                    "autoscale-rollback",
+                ],
+                since_seq=jseq0,
+            )
+            applied = [
+                e for e in evs if e["kind"] == "autoscale-applied"
+            ]
+            # actuation windows come from the journal stamps, not a
+            # stopwatch around the resize call
+            windows = []
+            pending = None
+            for e in evs:
+                if e["kind"] == "autoscale-decision":
+                    pending = e
+                elif (
+                    e["kind"] == "autoscale-applied"
+                    and pending is not None
+                ):
+                    windows.append(
+                        {
+                            "action": e["data"].get("action"),
+                            "to_ranks": e["data"].get("to_ranks"),
+                            "seconds": round(
+                                float(e["wall"])
+                                - float(pending["wall"]),
+                                6,
+                            ),
+                            "actuator_seconds": e["data"].get(
+                                "seconds"
+                            ),
+                        }
+                    )
+                    pending = None
+            first_window = window_from_events(
+                evs,
+                ["autoscale-decision"],
+                ["autoscale-applied"],
+            )
+            leg.update(
+                {
+                    "resizes": len(applied),
+                    "resizes_per_surge_edge": round(
+                        len(applied) / SURGE_EDGES, 2
+                    ),
+                    "rollbacks": len(
+                        [
+                            e
+                            for e in evs
+                            if e["kind"] == "autoscale-rollback"
+                        ]
+                    ),
+                    "actuation_windows": windows,
+                    "decision_to_applied_envelope": first_window,
+                    "controller_rank_seconds_metric": round(
+                        ctrl.registry.get(
+                            "pathway_autoscale_rank_seconds_total"
+                        )
+                        .labels()
+                        .value,
+                        1,
+                    ),
+                    "forecaster": ctrl.predictor.state(),
+                }
+            )
+            ctrl.stop()
+        else:
+            leg.update({"resizes": 0, "resizes_per_surge_edge": 0.0})
+        return leg
+
+    legs = {
+        m: _run_leg(m)
+        for m in ("static_min", "static_max", "flux_pilot")
+    }
+    fp, smax, smin = (
+        legs["flux_pilot"],
+        legs["static_max"],
+        legs["static_min"],
+    )
+    shed_tolerance = 0.10 * max(smax["shed_rate"], SHED_TARGET)
+    acceptance = {
+        "rank_seconds_vs_static_max": round(
+            fp["rank_seconds"] / smax["rank_seconds"], 4
+        ),
+        "rank_seconds_saving_ok": bool(
+            fp["rank_seconds"] <= 0.8 * smax["rank_seconds"]
+        ),
+        "shed_within_10pct_of_static_max": bool(
+            fp["shed_rate"] <= smax["shed_rate"] + shed_tolerance
+        ),
+        "shed_beats_static_min": bool(
+            fp["shed_rate"] < smin["shed_rate"]
+        ),
+        "resizes_per_surge_edge_ok": bool(
+            fp["resizes_per_surge_edge"] <= 2.0
+        ),
+        "zero_errors_every_leg": bool(
+            all(
+                leg["error_served_total"] == 0
+                for leg in legs.values()
+            )
+        ),
+        "windows_journal_derived": bool(
+            fp.get("actuation_windows")
+            and fp.get("decision_to_applied_envelope") is not None
+        ),
+    }
+    return {
+        "model": {
+            "period_s": PERIOD,
+            "warmup_cycles": WARMUP_CYCLES,
+            "scored_cycles": SCORED_CYCLES,
+            "rank_capacity_rps": RANK_CAPACITY,
+            "offered_rps": [BASE, BASE + AMP],
+            "shed_slo_target": SHED_TARGET,
+            "surge_edges": SURGE_EDGES,
+        },
+        **legs,
+        "acceptance": acceptance,
+        "passed": bool(all(acceptance.values())),
+    }
+
+
 def _bench_tick_anatomy(np):
     """Tick Scope tier (TICK_r18.json, ISSUE 18 acceptance): per-operator
     tick anatomy on a linear compiled pipeline (per-exec wall/rows, a
@@ -4790,6 +5098,14 @@ if __name__ == "__main__":
             _serve["reshard_live"] = (
                 f"failed: {type(_e).__name__}: {_e}"
             )
+        try:
+            _serve["autoscale_diurnal"] = _bench_autoscale_diurnal(
+                _np
+            )
+        except Exception as _e:
+            _serve["autoscale_diurnal"] = (
+                f"failed: {type(_e).__name__}: {_e}"
+            )
         _doc = {"tier": "serve_chaos", **_serve}
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -4824,6 +5140,23 @@ if __name__ == "__main__":
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "OBS_r17.json"),
+            "w",
+        ) as _f:
+            json.dump(_doc, _f, indent=2)
+        print(json.dumps(_doc, indent=2))
+    elif sys.argv[1:] == ["autoscale_diurnal"]:
+        # Flux Pilot tier (ISSUE 19 acceptance artifact): SLO-driven
+        # autoscaler vs static min/max provisioning on a compressed
+        # diurnal day — rank-seconds saving >= 20% vs static max with
+        # shed held to the static-max band, <= 2 resizes per surge
+        # edge, actuation windows derived from the journal
+        import numpy as _np
+
+        _sc = _bench_autoscale_diurnal(_np)
+        _doc = {"tier": "autoscale_diurnal", **_sc}
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "SCALE_r19.json"),
             "w",
         ) as _f:
             json.dump(_doc, _f, indent=2)

@@ -53,6 +53,76 @@ from pathway_tpu.persistence.segments import (
 )
 
 
+def _seg_copy(seg):
+    """Shallow segment copy (arrays shared, identity reset) — the
+    whole-segment fast path: a segment whose entire slot range lands on
+    one new owner ships without any row decode or re-partition."""
+    from pathway_tpu.engine.arrangement import _Segment
+
+    return _Segment(
+        seg.jks,
+        seg.keys,
+        seg.diffs,
+        seg.ages,
+        list(seg.cols),
+        seg.mix_sorted,
+        seg.clean,
+        -1,
+    )
+
+
+def _seg_slice(seg, idx):
+    """Row subset of a sealed segment for a straddler split.  ``idx``
+    is increasing, so jk sort order survives; ORIGINAL ages ride along
+    so every (jk, key) history keeps its relative order in the
+    destination arrangement."""
+    import numpy as np
+
+    from pathway_tpu.engine.arrangement import _Segment, mix_keys
+
+    jks = seg.jks[idx]
+    keys = seg.keys[idx]
+    diffs = seg.diffs[idx]
+    mix_sorted = np.sort(mix_keys(jks, keys))
+    # a subset of a clean segment is clean (insert-only survives
+    # subsetting and duplicates cannot appear); otherwise recompute
+    clean = bool(seg.clean) or (
+        bool((diffs > 0).all())
+        and not bool((mix_sorted[1:] == mix_sorted[:-1]).any())
+    )
+    return _Segment(
+        jks,
+        keys,
+        diffs,
+        seg.ages[idx],
+        [np.asarray(c)[idx] for c in seg.cols],
+        mix_sorted,
+        clean,
+        -1,
+    )
+
+
+def _arr_from_pieces(n_cols: int, pieces: list) -> Arrangement:
+    """Destination arrangement assembled from shipped segment pieces
+    (whole segments and straddler slices, source order).  Ages continue
+    from the largest shipped age; cross-source age overlap is harmless
+    because old ranks hold disjoint jk ranges."""
+    arr = Arrangement(n_cols)
+    if not pieces:
+        return arr
+    next_age = 0
+    neg = 0
+    for seg in pieces:
+        seg.seg_id = arr._alloc_seg_id()
+        next_age = max(next_age, int(seg.ages.max()) + 1)
+        neg += int((seg.diffs < 0).sum())
+    arr.segments = list(pieces)
+    arr._next_age = next_age
+    arr._entries = int(sum(len(s) for s in pieces))
+    arr._neg_entries = neg
+    return arr
+
+
 def _choose_generation(meta: dict, group_time: int) -> dict | None:
     """The newest generation at or below the group-agreed time (the
     same newest-first walk group recovery performs)."""
@@ -130,8 +200,11 @@ def reshard_stores(
     the metadata commit, i.e. rollback-able) when a retired rank still
     holds log events no snapshot covers, or when a store has no
     restorable generation at the group-agreed time."""
+    import time as _time
+
     from pathway_tpu.elastic.ferry import FerryReceiver, ferry_files
 
+    _t0 = _time.monotonic()
     n_old, n_new = len(old_roots), len(new_roots)
     if n_old < 1 or n_new < 1:
         raise HandoverError("resharding needs >= 1 store on both sides")
@@ -199,6 +272,13 @@ def reshard_stores(
     moved_rows = 0
     bytes_total = 0
     bytes_moved = 0
+    # segment-level split accounting: intact = sealed segments whose
+    # whole slot range moves to ONE new owner (shipped without a row
+    # decode), split = straddlers sliced row-wise, kept = segments that
+    # stay home untouched
+    segments_shipped_intact = 0
+    segments_split = 0
+    segments_kept = 0
     # per new rank: the cross-rank chunks as sealed segment blobs —
     # the bytes that genuinely travel (and the FerryReceiver payload)
     moved_blobs: list[list[tuple[str, bytes]]] = [
@@ -257,89 +337,90 @@ def reshard_stores(
         dcn, extra, dev_sharded, dev_res0, _ = _unwrap(*ranks[0][1])
         k_dev = len(dev_res0)
         names: list[str] = []
-        # gather (old rank, name) -> Rows; merge dev shards per rank
-        # (their jk ranges are disjoint)
-        per_rank_rows: dict[tuple[int, str], list] = {}
         name_cols: dict[str, int] = {}  # arity survives emptiness: a
         # fully-retracted arrangement must rebuild at its true n_cols
+        # gather (old rank, dev shard, name) -> Arrangement; the inner
+        # device shard ``shard_of(jk, k_dev)`` is invariant under a
+        # process-count change (k_dev is fixed by the job), so segments
+        # never cross dev shards and each (r, d) splits independently
+        per_rank_arrs: dict[tuple[int, int, str], Arrangement] = {}
         for r, (residual, arrs) in ranks:
             _d, _e, _ds, _res, per_dev = _unwrap(residual, arrs)
-            for named in per_dev:
+            for d, named in enumerate(per_dev):
                 for name, arr in named.items():
                     if name not in names:
                         names.append(name)
                     name_cols[name] = arr.n_cols
-                    rows = arr.entries()
-                    if len(rows):
-                        per_rank_rows.setdefault((r, name), []).append(
-                            rows
-                        )
-        # split by new process owner, then inner device shard
+                    per_rank_arrs[(r, d, name)] = arr
         import numpy as np
 
-        new_per_rank: list[list[dict[str, Arrangement]]] = [
+        # --- segment-level split ------------------------------------
+        # Ownership is decided per SEALED SEGMENT, not per consolidated
+        # row: a segment whose every jk hashes to one new owner ships
+        # intact (zero-copy views straight off the source mmap — no
+        # consolidation pass, no re-append), and only straddlers are
+        # sliced row-wise.  Host work is O(moved bytes + straddler
+        # bytes) instead of O(total store bytes).  Original ages ride
+        # along in both cases so (jk, key) histories keep their
+        # relative order and the restored fold stays bit-equal.
+        new_pieces: list[list[dict[str, list]]] = [
             [dict() for _ in range(k_dev)] for _ in range(n_new)
         ]
         moved_chunks: list[list[tuple[str, Any]]] = [
             [] for _ in range(n_new)
-        ]  # per dst rank: (name, Rows) arriving from a DIFFERENT rank
-        for name in names:
-            for r in range(n_old):
-                for rows in per_rank_rows.get((r, name), []):
-                    total_rows += len(rows)
-                    jks = np.asarray(rows.jk, dtype=np.uint64)
-                    dest = shard_of(jks, n_new)
-                    moved_rows += int(np.count_nonzero(dest != r))
-                    for p in range(n_new):
-                        idx = np.nonzero(dest == p)[0]
-                        if not len(idx):
-                            continue
-                        sub = rows.take(
-                            idx[
-                                np.argsort(
-                                    rows.age[idx], kind="stable"
-                                )
-                            ]
-                        )
-                        if p != r:
-                            moved_chunks[p].append((name, sub))
-                        dev = shard_of(
-                            np.asarray(sub.jk, dtype=np.uint64), k_dev
-                        )
-                        for d in range(k_dev):
-                            di = np.nonzero(dev == d)[0]
-                            if not len(di):
-                                continue
-                            dsub = sub.take(di)
-                            arr = new_per_rank[p][d].get(name)
-                            if arr is None:
-                                arr = Arrangement(len(rows.cols))
-                                new_per_rank[p][d][name] = arr
-                            arr.append(
-                                dsub.jk, dsub.key, dsub.count, dsub.cols
-                            )
+        ]  # per dst rank: (name, piece) arriving from a DIFFERENT rank
+        for (r, d, name), arr in per_rank_arrs.items():
+            for seg in arr.segments:
+                if not len(seg):
+                    continue
+                total_rows += len(seg)
+                jks = np.asarray(seg.jks, dtype=np.uint64)
+                dest = shard_of(jks, n_new)
+                owners = np.unique(dest)
+                if len(owners) == 1:
+                    p = int(owners[0])
+                    piece = _seg_copy(seg)
+                    if p != r:
+                        moved_rows += len(seg)
+                        segments_shipped_intact += 1
+                        moved_chunks[p].append((name, piece))
+                    else:
+                        segments_kept += 1
+                    new_pieces[p][d].setdefault(name, []).append(piece)
+                    continue
+                segments_split += 1
+                for p in owners.tolist():
+                    p = int(p)
+                    idx = np.nonzero(dest == p)[0]
+                    piece = _seg_slice(seg, idx)
+                    if p != r:
+                        moved_rows += len(idx)
+                        moved_chunks[p].append((name, piece))
+                    new_pieces[p][d].setdefault(name, []).append(piece)
+        # every name must exist on every dev shard (load_arranged
+        # indexes by name), even when empty for this rank — at its
+        # SOURCE arity, never a guessed one
+        new_per_rank: list[list[dict[str, Arrangement]]] = [
+            [
+                {
+                    name: _arr_from_pieces(
+                        name_cols[name], new_pieces[p][d].get(name, [])
+                    )
+                    for name in names
+                }
+                for d in range(k_dev)
+            ]
+            for p in range(n_new)
+        ]
         for p in range(n_new):
-            # the ferried artifact: each cross-rank chunk sealed as its
-            # own segment blob — exactly the moved key ranges' bytes,
-            # regardless of how the final arrangements merge segments
-            for j, (name, sub) in enumerate(moved_chunks[p]):
-                tmp = Arrangement(len(sub.cols))
-                tmp.append(sub.jk, sub.key, sub.count, sub.cols)
-                tmp.seal()
-                for seg in tmp.segments:
-                    blob = segment_to_bytes(seg)
-                    moved_blobs[p].append(
-                        (f"{ident}/{name}/part{j:04d}.seg", blob)
-                    )
-            # every name must exist on every dev shard (load_arranged
-            # indexes by name), even when empty for this rank — at its
-            # SOURCE arity, never a guessed one
-            for name in names:
-                for d in range(k_dev):
-                    new_per_rank[p][d].setdefault(
-                        name, Arrangement(name_cols[name])
-                    )
-                    new_per_rank[p][d][name].seal()
+            # the ferried artifact: each cross-rank piece's segment
+            # blob — exactly the moved key ranges' bytes (intact
+            # segments re-encode their shared views verbatim)
+            for j, (name, piece) in enumerate(moved_chunks[p]):
+                blob = segment_to_bytes(piece)
+                moved_blobs[p].append(
+                    (f"{ident}/{name}/part{j:04d}.seg", blob)
+                )
             res_list = [copy.deepcopy(dev_res0[0]) for _ in range(k_dev)]
             residual, arrs = _wrap(
                 dcn, extra, dev_sharded, res_list, new_per_rank[p]
@@ -398,6 +479,8 @@ def reshard_stores(
         group_time=group_time,
         moved_rows=moved_rows,
         bytes_ferried=bytes_moved,
+        segments_shipped_intact=segments_shipped_intact,
+        segments_split=segments_split,
     )
     ferry_stats: list[dict] = []
     dsts = [FilesystemStore(root) for root in new_roots]
@@ -470,6 +553,7 @@ def reshard_stores(
         import shutil as _shutil
 
         _shutil.rmtree(dst._path("reshard/inbox"), ignore_errors=True)
+    transfer_seconds = _time.monotonic() - _t0
     journal_record(
         "reshard-commit",
         f"{n_old} -> {n_new} ranks committed (generation {new_gen})",
@@ -478,6 +562,7 @@ def reshard_stores(
         n_new=n_new,
         generation=new_gen,
         bytes_ferried=bytes_moved,
+        transfer_seconds=round(transfer_seconds, 6),
     )
     return {
         "plan": {
@@ -491,7 +576,11 @@ def reshard_stores(
         "monolithic_carried": monolithic,
         "total_rows": total_rows,
         "moved_rows": moved_rows,
+        "segments_shipped_intact": segments_shipped_intact,
+        "segments_split": segments_split,
+        "segments_kept": segments_kept,
         "bytes_total_segments": bytes_total,
         "bytes_ferried": bytes_moved,
+        "transfer_seconds": round(transfer_seconds, 6),
         "ferry": ferry_stats,
     }

@@ -298,6 +298,22 @@ def start_http_server(
                     self._events(parse_qs(parsed.query))
                 elif route == "/debug/tick":
                     self._tick(runtime, parse_qs(parsed.query))
+                elif route == "/debug/autoscale":
+                    # Flux Pilot: the armed controller's live status
+                    # (ranks, cooldown, last decision, actuation-cost
+                    # EWMA) — 404s when no controller is armed so
+                    # probes can distinguish "absent" from "idle"
+                    from pathway_tpu.autoscale import get_controller
+
+                    ctrl = get_controller()
+                    if ctrl is None:
+                        self._reply(404, b"no autoscale controller armed")
+                    else:
+                        self._reply(
+                            200,
+                            json.dumps(ctrl.status()).encode(),
+                            "application/json",
+                        )
                 elif route in (
                     "/fleet/metrics",
                     "/fleet/events",

@@ -29,6 +29,7 @@ tick_ms               ms       pathway_last_tick_seconds × 1000
 tick_p99_ms           ms       pathway_operator_tick_seconds p99 × 1000
 knn_p50_ms            ms       pathway_knn_query_seconds p50 × 1000
 compile_hit_rate      fraction hits / (hits + misses), cumulative
+ranks                 ranks    pathway_autoscale_ranks (Flux Pilot)
 ===================== ======== =====================================
 
 SLO targets are declared with ``PATHWAY_SLO_*`` env knobs (see
@@ -109,6 +110,12 @@ class SignalRing:
         if limit is not None:
             pts = pts[-max(int(limit), 0):]
         return [(w, v) for (w, _m, v) in pts]
+
+    def points(self) -> list[tuple[float, float]]:
+        """[(mono, value), ...] oldest-first — the monotonic series the
+        Flux Pilot forecaster seeds from (rates/windows must never ride
+        the wall clock; see the CLOCK CONTRACT in sample_once)."""
+        return [(m, v) for (_w, m, v) in self._ring]
 
     def window_avg(self, seconds: float, now_mono: float | None = None) -> float | None:
         """Mean over the trailing ``seconds`` (monotonic window)."""
@@ -307,6 +314,14 @@ SIGNALS: tuple[SignalDef, ...] = (
         ),
     ),
     SignalDef("compile_hit_rate", "fraction", _sig_compile_hit_rate),
+    # Flux Pilot (autoscale/): the controller's own rank count, ringed
+    # so scaling history rides the same /debug/signals feed the inputs
+    # do — a burn spike lines up against the resize that answered it
+    SignalDef(
+        "ranks",
+        "ranks",
+        lambda s, dt: _gauge_agg(s.registry, "pathway_autoscale_ranks", max),
+    ),
 )
 
 

@@ -204,6 +204,60 @@ class ReplicaEndpoint:
         return self.alive and not self.ejected
 
 
+class _WfqDispatch:
+    """Tenant-fair dispatch window: at most ``width`` requests route
+    concurrently, and when the window is full, waiters release in
+    TenantLedger WFQ virtual-finish order — a cold tenant's first
+    request jumps ahead of a hot tenant's backlog instead of FIFO-ing
+    behind it.  Runs entirely on the router's event loop (no locks);
+    exists only when the ledger is armed, so the ``PATHWAY_TENANT_QOS``
+    unset path stays byte-identical."""
+
+    def __init__(self, ledger, width: int):
+        self.ledger = ledger
+        self.width = max(int(width), 1)
+        self._inflight = 0
+        self._waiters: list[tuple[float, int, Any]] = []  # (tag, seq, fut)
+        self._seq = 0
+
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
+
+    async def acquire(self, tenant: str | None, tenant_class: str | None):
+        """Charge the tenant's WFQ clock and wait for a dispatch slot.
+        Returns (tag, waited) — ``waited`` is True when the request
+        actually queued behind the window."""
+        import heapq
+
+        # charge_only: the dispatch window orders, it never sheds —
+        # admission-control sheds stay the replicas' ladder's job
+        tag = self.ledger.admit(
+            tenant or "", tenant_class, pressure=False, charge_only=True
+        )
+        if self._inflight < self.width and not self._waiters:
+            self._inflight += 1
+            self.ledger.note_dispatched((tag,))
+            return tag, False
+        fut = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._waiters, (tag, self._seq, fut))
+        await fut
+        return tag, True
+
+    def release(self) -> None:
+        import heapq
+
+        self._inflight -= 1
+        while self._waiters and self._inflight < self.width:
+            tag, _seq, fut = heapq.heappop(self._waiters)
+            if fut.cancelled():
+                continue
+            self._inflight += 1
+            self.ledger.note_dispatched((tag,))
+            fut.set_result(tag)
+
+
 class FailoverRouter:
     def __init__(
         self,
@@ -311,6 +365,29 @@ class FailoverRouter:
             self._m_inflight.labels(ep.name).set_function(
                 lambda ep=ep: ep.inflight
             )
+        # Tenant-aware dispatch: with the tenant ledger armed
+        # (PATHWAY_TENANT_QOS=1) the router's dispatch window releases
+        # waiting requests in WFQ virtual-finish order instead of FIFO.
+        # A None ledger keeps the request path byte-identical.
+        from pathway_tpu.serving.tenancy import ledger_for
+
+        self.tenant_ledger = ledger_for(None, route="router")
+        self._dispatch: _WfqDispatch | None = None
+        if self.tenant_ledger is not None:
+            width = int(
+                os.environ.get("PATHWAY_ROUTER_DISPATCH_WINDOW", "8") or 8
+            )
+            self._dispatch = _WfqDispatch(self.tenant_ledger, width)
+            self._m_dispatch_waits = REGISTRY.counter(
+                "pathway_router_dispatch_waits_total",
+                "requests that queued behind the tenant-fair dispatch "
+                "window before routing",
+            )
+            REGISTRY.gauge(
+                "pathway_router_dispatch_queued",
+                "requests currently queued in the tenant-fair dispatch "
+                "window",
+            ).set_function(lambda d=self._dispatch: d.queued)
 
     # --- failure listeners (HostMesh contract) ----------------------------
 
@@ -834,18 +911,33 @@ class FailoverRouter:
                 )
             from pathway_tpu.generate.serving import is_generate_route
 
-            if self.n_shards > 1 and not is_generate_route(request.path):
-                status, payload, headers, outcome, replica = (
-                    await self._route_scatter(request, body, deadline, max_st)
+            if self._dispatch is not None:
+                from pathway_tpu.serving.tenancy import TENANT_CLASS_HEADER
+
+                _tag, waited = await self._dispatch.acquire(
+                    tenant, request.headers.get(TENANT_CLASS_HEADER)
                 )
-            else:
-                # /generate rides the same occupancy/staleness/tenant
-                # single-member ladder even on a sharded plane:
-                # generation is stateful on the member holding the KV
-                # pages — scatter-gather is a retrieval concept
-                status, payload, headers, outcome, replica = (
-                    await self._route(request, body, deadline, max_st)
-                )
+                if waited:
+                    self._m_dispatch_waits.inc()
+            try:
+                if self.n_shards > 1 and not is_generate_route(request.path):
+                    status, payload, headers, outcome, replica = (
+                        await self._route_scatter(
+                            request, body, deadline, max_st
+                        )
+                    )
+                else:
+                    # /generate rides the same occupancy/staleness/
+                    # tenant single-member ladder even on a sharded
+                    # plane: generation is stateful on the member
+                    # holding the KV pages — scatter-gather is a
+                    # retrieval concept
+                    status, payload, headers, outcome, replica = (
+                        await self._route(request, body, deadline, max_st)
+                    )
+            finally:
+                if self._dispatch is not None:
+                    self._dispatch.release()
             span.set_attribute("status", status)
             span.set_attribute("outcome", outcome)
             if self.cache is not None and request.method == "POST":

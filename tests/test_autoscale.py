@@ -1,0 +1,589 @@
+"""Flux Pilot (pathway_tpu/autoscale/) tests — the SLO-driven
+autoscaler that closes the control loop over Shard Flux.
+
+Covers: the pure hysteresis policy by brute force (a scale-down NEVER
+fires while any burn exceeds 1.0; asymmetric windows; cooldown and
+in-flight holds; min/max bounds), controller saw-tooth immunity (no
+flapping across an oscillating burn), cooldown serialization under
+sustained pressure, rollback journaling + lockout, the forecaster's
+trend and diurnal lead time (scale-up fires BEFORE the raw signal
+crosses), predictive scale-up through the controller, the plane
+doctor's ``autoscale-coverage`` rule, and the tier-1 in-process e2e:
+a real persisted store scaled 1→2 on surge and 2→1 on drain through
+``reshard_stores``, with both transitions journaled and the restored
+state value-equal to the original.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import pytest
+
+import pathway_tpu as pw  # noqa: F401  (conftest clears its graph)
+from pathway_tpu.autoscale import (
+    DOWN,
+    HOLD,
+    UP,
+    AutoscaleConfig,
+    AutoscaleController,
+    AutoscalePolicy,
+    CallbackActuator,
+    Decision,
+    LoadForecaster,
+    PlaneObservation,
+    arm_controller,
+    get_controller,
+    reset_controller,
+)
+from pathway_tpu.observability.journal import journal, reset_journal
+from pathway_tpu.observability.registry import MetricsRegistry
+from pathway_tpu.observability.signals import reset_sampler
+
+_AUTOSCALE_VARS = (
+    "PATHWAY_AUTOSCALE_MIN_RANKS",
+    "PATHWAY_AUTOSCALE_MAX_RANKS",
+    "PATHWAY_AUTOSCALE_UP_WINDOW_S",
+    "PATHWAY_AUTOSCALE_DOWN_WINDOW_S",
+    "PATHWAY_AUTOSCALE_COOLDOWN_S",
+    "PATHWAY_AUTOSCALE_LOW_WATER",
+    "PATHWAY_AUTOSCALE_STEP",
+    "PATHWAY_AUTOSCALE_HORIZON_S",
+    "PATHWAY_AUTOSCALE_INTERVAL_MS",
+)
+_SLO_VARS = (
+    "PATHWAY_SLO_SHED_RATE",
+    "PATHWAY_SLO_STALENESS_S",
+    "PATHWAY_SLO_TOK_S",
+    "PATHWAY_SLO_TTFT_P99_MS",
+)
+
+
+@pytest.fixture(autouse=True)
+def _pilot_env(monkeypatch):
+    for var in _AUTOSCALE_VARS + _SLO_VARS + (
+        "PATHWAY_JOURNAL_PATH",
+        "PATHWAY_SERVING_SHARD_MAP",
+        "PATHWAY_TENANT_QOS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("PATHWAY_DCN_SECRET", "flux-pilot-test-secret")
+    reset_journal()
+    reset_sampler()
+    reset_controller()
+    yield
+    reset_controller()
+    reset_sampler()
+    reset_journal()
+
+
+def _cfg(**kw) -> AutoscaleConfig:
+    kw.setdefault("min_ranks", 1)
+    kw.setdefault("max_ranks", 4)
+    kw.setdefault("up_window_s", 15.0)
+    kw.setdefault("down_window_s", 120.0)
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("low_water", 0.5)
+    kw.setdefault("step", 1)
+    kw.setdefault("horizon_s", 30.0)
+    return AutoscaleConfig(**kw)
+
+
+class _Sampler:
+    """Scripted burn source presented through the SignalSampler burn
+    contract (burn_rates() → {signal: {..., 'burn': x}})."""
+
+    def __init__(self, burn=None):
+        self.burn = burn
+
+    def burn_rates(self):
+        if self.burn is None:
+            return {}
+        return {
+            "shed_rate": {
+                "target": 0.01,
+                "direction": "max",
+                "window_avg": self.burn * 0.01,
+                "burn": self.burn,
+            }
+        }
+
+
+# --- policy: pure-function properties --------------------------------------
+
+
+def test_policy_down_never_fires_under_burn_brute_force():
+    """The hard guard, checked exhaustively: whatever the duration
+    markers, predictor, cooldown, or rank count claim, a scale-down
+    never fires while any burn exceeds 1.0."""
+    policy = AutoscalePolicy(_cfg())
+    checked = 0
+    for burn in (1.0001, 1.01, 1.5, 3.0, 50.0):
+        for high_for in (0.0, 5.0, 15.0, 300.0):
+            for drained_for in (0.0, 120.0, 100000.0):
+                for predicted in (None, 0.1, 0.49, 1.2, 9.0):
+                    for cooldown in (0.0, 10.0):
+                        for in_flight in (False, True):
+                            for ranks in (1, 2, 3, 4):
+                                d = policy.decide(
+                                    PlaneObservation(
+                                        mono=1000.0,
+                                        ranks=ranks,
+                                        max_burn=burn,
+                                        burn_high_for_s=high_for,
+                                        drained_for_s=drained_for,
+                                        predicted_burn=predicted,
+                                        cooldown_remaining_s=cooldown,
+                                        action_in_flight=in_flight,
+                                    )
+                                )
+                                assert d.action != DOWN, (burn, d)
+                                checked += 1
+    assert checked == 5 * 4 * 3 * 5 * 2 * 2 * 4
+
+
+def test_policy_up_needs_sustained_burn_or_forecast():
+    policy = AutoscalePolicy(_cfg(up_window_s=15.0))
+
+    def obs(**kw):
+        kw.setdefault("mono", 0.0)
+        kw.setdefault("ranks", 1)
+        return PlaneObservation(**kw)
+
+    # a short spike holds
+    d = policy.decide(obs(max_burn=2.0, burn_high_for_s=5.0))
+    assert d.action == HOLD
+    # sustained past the window scales up
+    d = policy.decide(obs(max_burn=2.0, burn_high_for_s=15.0))
+    assert d == Decision(UP, 2, d.reason)
+    # the forecast alone scales up — zero sustained seconds required
+    d = policy.decide(
+        obs(max_burn=0.4, burn_high_for_s=0.0, predicted_burn=1.3)
+    )
+    assert d.action == UP and "predicted" in d.reason
+
+
+def test_policy_down_needs_long_drain_and_quiet_forecast():
+    policy = AutoscalePolicy(_cfg(down_window_s=120.0, low_water=0.5))
+
+    def obs(**kw):
+        kw.setdefault("mono", 0.0)
+        kw.setdefault("ranks", 2)
+        return PlaneObservation(**kw)
+
+    # below low-water but not for long enough
+    assert (
+        policy.decide(obs(max_burn=0.2, drained_for_s=60.0)).action == HOLD
+    )
+    # long enough, but inside the band (above low-water) — holds
+    assert (
+        policy.decide(obs(max_burn=0.8, drained_for_s=500.0)).action == HOLD
+    )
+    # drained, but the forecast sits above low-water — the down is
+    # blocked without (yet) firing an up
+    d = policy.decide(
+        obs(max_burn=0.2, drained_for_s=130.0, predicted_burn=0.8)
+    )
+    assert d.action == HOLD
+    # a forecast past 1.0 flips the drained plane straight to UP
+    d = policy.decide(
+        obs(max_burn=0.2, drained_for_s=130.0, predicted_burn=1.4)
+    )
+    assert d.action == UP
+    # drained with a quiet forecast — scales down
+    d = policy.decide(
+        obs(max_burn=0.2, drained_for_s=130.0, predicted_burn=0.3)
+    )
+    assert d == Decision(DOWN, 1, d.reason)
+
+
+def test_policy_holds_blind_pinned_cooldown_and_bounds():
+    policy = AutoscalePolicy(_cfg())
+    base = dict(mono=0.0, ranks=2)
+    # no burn data → never act blind
+    assert (
+        policy.decide(PlaneObservation(max_burn=None, **base)).action == HOLD
+    )
+    # cooldown and in-flight dominate everything
+    hot = dict(max_burn=5.0, burn_high_for_s=1000.0)
+    assert (
+        policy.decide(
+            PlaneObservation(cooldown_remaining_s=1.0, **hot, **base)
+        ).action
+        == HOLD
+    )
+    assert (
+        policy.decide(
+            PlaneObservation(action_in_flight=True, **hot, **base)
+        ).action
+        == HOLD
+    )
+    # bounds clamp
+    at_max = PlaneObservation(mono=0.0, ranks=4, **hot)
+    assert AutoscalePolicy(_cfg()).decide(at_max).action == HOLD
+    at_min = PlaneObservation(
+        mono=0.0, ranks=1, max_burn=0.1, drained_for_s=1000.0
+    )
+    assert policy.decide(at_min).action == HOLD
+    # pinned config never acts
+    pinned = AutoscalePolicy(_cfg(min_ranks=2, max_ranks=2))
+    assert pinned.decide(PlaneObservation(**base, **hot)).action == HOLD
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_MIN_RANKS", "2")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_MAX_RANKS", "8")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_UP_WINDOW_S", "5")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_LOW_WATER", "0.25")
+    cfg = AutoscaleConfig.from_env()
+    assert cfg.min_ranks == 2 and cfg.max_ranks == 8
+    assert cfg.up_window_s == 5.0 and cfg.low_water == 0.25
+    # garbage falls back to defaults instead of crashing the plane
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_MAX_RANKS", "lots")
+    assert AutoscaleConfig.from_env().max_ranks == 4
+
+
+# --- controller: saw-tooth, cooldown, rollback ------------------------------
+
+
+def test_controller_sawtooth_never_flaps():
+    """A burn oscillating across the whole band faster than either
+    window produces ZERO actions over ten minutes of virtual time."""
+    sampler = _Sampler()
+    reg = MetricsRegistry()
+    ctrl = AutoscaleController(
+        CallbackActuator(lambda m: None),
+        ranks=2,
+        config=_cfg(up_window_s=15.0, down_window_s=120.0, cooldown_s=30.0),
+        sampler=sampler,
+        registry=reg,
+    )
+    t0 = time.monotonic()
+    for s in range(600):
+        sampler.burn = 1.6 if (s // 10) % 2 == 0 else 0.2
+        ctrl.step(t0 + s)
+    assert ctrl.resizes == 0 and ctrl.ranks == 2
+    flaps = reg.get("pathway_autoscale_flaps_total")
+    assert flaps.labels().value == 0.0
+
+
+def test_controller_cooldown_serializes_scale_ups():
+    """Under sustained burn the controller steps up through the band
+    one cooldown at a time — never a burst of resizes."""
+    sampler = _Sampler(burn=3.0)
+    reg = MetricsRegistry()
+    ctrl = AutoscaleController(
+        CallbackActuator(lambda m: None),
+        ranks=1,
+        config=_cfg(up_window_s=15.0, cooldown_s=30.0, max_ranks=4),
+        sampler=sampler,
+        registry=reg,
+    )
+    t0 = time.monotonic()
+    sizes = []
+    for s in range(200):
+        ctrl.step(t0 + s)
+        sizes.append(ctrl.ranks)
+    assert ctrl.ranks == 4
+    # strictly monotone growth, one rank at a time
+    assert all(b - a in (0, 1) for a, b in zip(sizes, sizes[1:]))
+    # consecutive ups are separated by at least the cooldown
+    ups = [s for s, (a, b) in enumerate(zip(sizes, sizes[1:])) if b > a]
+    assert all(b - a >= 30 for a, b in zip(ups, ups[1:]))
+    holds = reg.get("pathway_autoscale_cooldown_holds_total")
+    assert holds.labels().value > 0
+
+
+def test_controller_rollback_journals_and_locks_out():
+    sampler = _Sampler(burn=2.0)
+    reg = MetricsRegistry()
+
+    def failing(m):
+        raise RuntimeError("ferry died mid-transfer")
+
+    ctrl = AutoscaleController(
+        CallbackActuator(failing),
+        ranks=1,
+        config=_cfg(up_window_s=2.0, cooldown_s=60.0),
+        sampler=sampler,
+        registry=reg,
+    )
+    t0 = time.monotonic()
+    for s in range(5):
+        ctrl.step(t0 + s)
+    assert ctrl.ranks == 1 and ctrl.resizes == 0
+    kinds = [e["kind"] for e in journal().events()]
+    assert "autoscale-rollback" in kinds
+    assert reg.get("pathway_autoscale_rollbacks_total").labels().value == 1.0
+    # the failure armed the cooldown: the next steps hold even though
+    # the burn is still high (no hammering a failing transfer)
+    before = ctrl.resizes
+    for s in range(5, 20):
+        ctrl.step(t0 + s)
+    assert ctrl.resizes == before
+    rb = [e for e in journal().events(kinds=["autoscale-rollback"])]
+    assert rb[0]["data"]["from_ranks"] == 1
+    assert rb[0]["data"]["to_ranks"] == 2
+
+
+def test_controller_rank_seconds_integrates():
+    sampler = _Sampler(burn=0.6)
+    reg = MetricsRegistry()
+    ctrl = AutoscaleController(
+        CallbackActuator(lambda m: None),
+        ranks=3,
+        config=_cfg(),
+        sampler=sampler,
+        registry=reg,
+    )
+    t0 = time.monotonic()
+    for s in range(11):
+        ctrl.step(t0 + s)
+    # 3 ranks for 10 virtual seconds
+    rs = reg.get("pathway_autoscale_rank_seconds_total").labels().value
+    assert rs == pytest.approx(30.0)
+
+
+# --- predictor: trend and diurnal lead time ---------------------------------
+
+
+def test_predictor_trend_leads_a_ramp():
+    f = LoadForecaster(tau_s=10.0)
+    for s in range(120):
+        f.observe(float(s), 0.2 + 0.005 * s)  # +0.005/s ramp
+    now = 119.0
+    current = 0.2 + 0.005 * 119
+    ahead = f.forecast(60.0, now)
+    assert ahead is not None and ahead > current + 0.15
+    # the crossing is seen within the horizon, well before the raw
+    # signal gets there ((1.0 - current) / 0.005 ≈ 41 s out)
+    lead = f.lead_crossing(1.0, 120.0, now)
+    assert lead is not None and 0 < lead < 120.0
+
+
+def _diurnal_burn(t: float, period: float = 240.0) -> float:
+    return 0.2 + 1.1 * max(0.0, math.sin(2 * math.pi * t / period))
+
+
+def test_predictor_diurnal_profile_gives_lead_time():
+    """After two observed cycles, the forecast crosses 1.0 while the
+    raw signal is still far below it — the lead the scale-up rides."""
+    period = 240.0
+    f = LoadForecaster(tau_s=20.0, period_s=period, buckets=48)
+    t = 0.0
+    while t < 2 * period:
+        f.observe(t, _diurnal_burn(t, period))
+        t += 2.0
+    # early in cycle three: raw burn still low, surge ~30 s out
+    now = 2 * period + 5.0
+    raw = _diurnal_burn(now, period)
+    assert raw < 0.5
+    ahead = f.forecast(40.0, now)
+    assert ahead is not None and ahead > 1.0
+    assert f.state()["profile_coverage"] == 1.0
+
+
+def test_predictor_seeds_from_signal_ring():
+    from pathway_tpu.observability.signals import SignalRing
+
+    ring = SignalRing(64)
+    for s in range(32):
+        ring.append(1000.0 + s, 100.0 + s, 0.1 * s)
+    f = LoadForecaster(tau_s=5.0)
+    f.seed(ring.points())
+    st = f.state()
+    assert st["observations"] == 32
+    assert st["level"] == pytest.approx(3.1, abs=0.5)
+    assert st["slope"] > 0
+
+
+def test_controller_predictive_scale_up_fires_before_the_surge():
+    """The closed loop: a predictor warmed on two diurnal cycles makes
+    the controller journal a scale-up while the observed burn is STILL
+    below 1.0 — capacity lands ahead of the modeled surge."""
+    period = 240.0
+    predictor = LoadForecaster(tau_s=20.0, period_s=period, buckets=48)
+    for s in range(0, int(2 * period), 2):
+        predictor.observe(float(s), _diurnal_burn(float(s), period))
+    sampler = _Sampler()
+    ctrl = AutoscaleController(
+        CallbackActuator(lambda m: None),
+        ranks=1,
+        config=_cfg(up_window_s=15.0, cooldown_s=20.0, horizon_s=40.0),
+        sampler=sampler,
+        predictor=predictor,
+        registry=MetricsRegistry(),
+    )
+    # drive cycle three on the same virtual clock the predictor learned
+    up_at_burn = None
+    for s in range(int(2 * period), int(2 * period) + 120):
+        sampler.burn = _diurnal_burn(float(s), period)
+        d = ctrl.step(float(s))
+        if d.action == UP:
+            up_at_burn = sampler.burn
+            break
+    assert up_at_burn is not None, "predictive scale-up never fired"
+    assert up_at_burn < 1.0, f"scale-up fired late (burn {up_at_burn})"
+    ev = journal().events(kinds=["autoscale-decision"])
+    assert ev and ev[-1]["data"]["predicted_burn"] > 1.0
+    assert ev[-1]["data"]["max_burn"] < 1.0
+
+
+# --- plane doctor: autoscale-coverage ---------------------------------------
+
+
+def test_autoscale_coverage_warns_on_unwatched_resizable_plane(monkeypatch):
+    from pathway_tpu.analysis.doctor import run_plane_doctor
+
+    monkeypatch.setenv(
+        "PATHWAY_SERVING_SHARD_MAP", "127.0.0.1:9001|127.0.0.1:9002"
+    )
+    report = run_plane_doctor(rules=["autoscale-coverage"])
+    hits = report.by_rule("autoscale-coverage")
+    assert len(hits) == 1 and hits[0].severity.name == "WARNING"
+    assert "no Flux Pilot controller" in hits[0].message
+    # arming a controller (with an SLO target) clears it
+    monkeypatch.setenv("PATHWAY_SLO_SHED_RATE", "0.01")
+    arm_controller(
+        CallbackActuator(lambda m: None),
+        ranks=1,
+        config=_cfg(),
+        registry=MetricsRegistry(),
+    )
+    report = run_plane_doctor(rules=["autoscale-coverage"])
+    assert not report.by_rule("autoscale-coverage")
+
+
+def test_autoscale_coverage_warns_on_blind_controller(monkeypatch):
+    from pathway_tpu.analysis.doctor import run_plane_doctor
+
+    arm_controller(
+        CallbackActuator(lambda m: None),
+        ranks=1,
+        config=_cfg(),
+        registry=MetricsRegistry(),
+    )
+    report = run_plane_doctor(rules=["autoscale-coverage"])
+    hits = report.by_rule("autoscale-coverage")
+    assert len(hits) == 1 and hits[0].severity.name == "WARNING"
+    assert "zero PATHWAY_SLO_" in hits[0].message
+    monkeypatch.setenv("PATHWAY_SLO_SHED_RATE", "0.01")
+    assert not run_plane_doctor(rules=["autoscale-coverage"]).by_rule(
+        "autoscale-coverage"
+    )
+
+
+def test_autoscale_coverage_info_when_pinned(monkeypatch):
+    from pathway_tpu.analysis.doctor import run_plane_doctor
+
+    monkeypatch.setenv("PATHWAY_SLO_SHED_RATE", "0.01")
+    arm_controller(
+        CallbackActuator(lambda m: None),
+        ranks=2,
+        config=_cfg(min_ranks=2, max_ranks=2),
+        registry=MetricsRegistry(),
+    )
+    hits = run_plane_doctor(rules=["autoscale-coverage"]).by_rule(
+        "autoscale-coverage"
+    )
+    assert len(hits) == 1 and hits[0].severity.name == "INFO"
+    assert "pinned" in hits[0].message
+
+
+def test_arm_and_reset_global_controller():
+    assert get_controller() is None
+    c = arm_controller(
+        CallbackActuator(lambda m: None),
+        ranks=1,
+        config=_cfg(),
+        registry=MetricsRegistry(),
+    )
+    assert get_controller() is c
+    st = c.status()
+    assert st["armed"] and st["ranks"] == 1 and st["actuator"] == "callback"
+    reset_controller()
+    assert get_controller() is None
+
+
+# --- tier-1 e2e: surge → 1→2 → drain → 2→1 over a real store ---------------
+
+
+def test_autoscale_e2e_resizes_real_store_and_preserves_state(
+    tmp_path, monkeypatch
+):
+    """The whole loop against a real persisted run: a surge scales the
+    store 1→2 through ``reshard_stores`` (journaled decision + applied),
+    the drain scales it 2→1, and the final single-rank store holds
+    exactly the original consolidated state."""
+    from test_elastic import _arranged_rows, _run_persisted_wordcount
+
+    from pathway_tpu.elastic.mesh import reshard_stores
+
+    words = [f"w{i % 13}" for i in range(60)]
+    _run_persisted_wordcount(tmp_path, words)
+    src = str(tmp_path / "pstorage")
+    before = _arranged_rows(src)
+    assert before
+
+    roots = {1: [src]}
+
+    def resize(m: int) -> None:
+        cur = max(roots)
+        new = [str(tmp_path / f"r{m}_{i}") for i in range(m)]
+        reshard_stores(roots[cur], new, via_wire=False)
+        roots[m] = new
+
+    sampler = _Sampler()
+    reg = MetricsRegistry()
+    ctrl = AutoscaleController(
+        CallbackActuator(resize, label="reshard_stores"),
+        ranks=1,
+        config=_cfg(
+            max_ranks=2,
+            up_window_s=2.0,
+            down_window_s=4.0,
+            cooldown_s=1.0,
+            low_water=0.5,
+        ),
+        sampler=sampler,
+        registry=reg,
+    )
+    t0 = time.monotonic()
+    # surge: burn 3.0 sustained past the up window
+    sampler.burn = 3.0
+    t = t0
+    for _ in range(6):
+        ctrl.step(t)
+        t += 1.0
+    assert ctrl.ranks == 2 and 2 in roots
+    # drain: burn 0.1 sustained past the (longer) down window
+    sampler.burn = 0.1
+    for _ in range(10):
+        ctrl.step(t)
+        t += 1.0
+    assert ctrl.ranks == 1
+    assert ctrl.resizes == 2
+
+    # both transitions journaled, decision before applied, no rollback
+    ev = journal().events(
+        kinds=["autoscale-decision", "autoscale-applied", "autoscale-rollback"]
+    )
+    kinds = [e["kind"] for e in ev]
+    assert kinds == [
+        "autoscale-decision",
+        "autoscale-applied",
+        "autoscale-decision",
+        "autoscale-applied",
+    ]
+    assert [e["data"]["action"] for e in ev] == ["up", "up", "down", "down"]
+    assert ev[1]["data"]["seconds"] > 0
+    # the reshard itself journaled its commits with transfer accounting
+    commits = journal().events(kinds=["reshard-commit"])
+    assert len(commits) == 2
+    assert all(c["data"]["transfer_seconds"] > 0 for c in commits)
+
+    # the scaled-down store restores the exact original state
+    after = _arranged_rows(roots[1][0])
+    assert after == before
+    assert reg.get("pathway_autoscale_rollbacks_total").labels().value == 0

@@ -435,7 +435,74 @@ def test_reshard_stores_splits_one_rank_into_two(tmp_path, job_secret):
     assert seen_jks, "no arranged state landed in the new stores"
 
 
-def test_reshard_stores_refuses_uncovered_tail_on_shrink(tmp_path):
+def _arranged_rows(root):
+    """Consolidated (jk, key) -> summed diff across every arranged node
+    in a store — the fold-equality fingerprint for reshard round-trips."""
+    from pathway_tpu.persistence._runtime_glue import PersistenceDriver
+    from pathway_tpu.persistence.backends import FilesystemStore
+    from pathway_tpu.persistence.segments import load_arrangement
+
+    import pickle
+
+    store = FilesystemStore(root)
+    meta = json.loads(store.get("metadata.json").decode())
+    snap = meta["state"]
+    out: dict = {}
+    for ident in snap["nodes"]:
+        blob = pickle.loads(
+            store.get(PersistenceDriver._state_key(snap["gen"], ident))
+        )
+        if not (isinstance(blob, dict) and blob.get("__pw_arranged__")):
+            continue
+        for name, man in blob["manifests"].items():
+            arr = load_arrangement(
+                man,
+                lambda sid, n=name, e=man["epoch"], i=ident,
+                s=store: s.get_buffer(
+                    PersistenceDriver._segment_key(i, n, e, sid)
+                ),
+            )
+            rows = arr.entries()
+            for jk, key, cnt in zip(rows.jk, rows.key, rows.count):
+                k = (ident, name, int(jk), int(key))
+                out[k] = out.get(k, 0) + int(cnt)
+    return {k: v for k, v in out.items() if v != 0}
+
+
+def test_reshard_segment_level_split_and_intact_merge(tmp_path, job_secret):
+    """Segment-level ownership: a 1→2 split slices straddler segments
+    (counted), and the 2→1 merge back ships every segment INTACT — no
+    row decode — while the round-tripped state stays value-equal."""
+    from pathway_tpu.elastic.mesh import reshard_stores
+
+    words = [f"w{i % 13}" for i in range(60)]
+    _run_persisted_wordcount(tmp_path, words)
+    src = str(tmp_path / "pstorage")
+    before = _arranged_rows(src)
+    assert before
+
+    two = [str(tmp_path / "two0"), str(tmp_path / "two1")]
+    up = reshard_stores([src], two, via_wire=False)
+    # a 13-key segment straddles both new owners, so the split path ran
+    assert up["segments_split"] >= 1
+    handled = (
+        up["segments_split"]
+        + up["segments_shipped_intact"]
+        + up["segments_kept"]
+    )
+    assert handled >= 1
+    assert up["transfer_seconds"] > 0
+
+    one = [str(tmp_path / "one0")]
+    down = reshard_stores(two, one, via_wire=False)
+    # n_new == 1: every segment is wholly owned by rank 0 — the merge
+    # must never decode a row
+    assert down["segments_split"] == 0
+    assert down["segments_shipped_intact"] >= 1  # rank 1's segments move
+    assert down["moved_rows"] > 0
+
+    after = _arranged_rows(one[0])
+    assert after == before
     from pathway_tpu.elastic.handover import HandoverError
     from pathway_tpu.elastic.mesh import reshard_stores
     from pathway_tpu.persistence.backends import FilesystemStore

@@ -558,3 +558,65 @@ def test_doctor_tenant_fairness_info_on_ttl_only_cache(monkeypatch):
     monkeypatch.setenv("PATHWAY_ROUTER_CACHE_WRITER", "127.0.0.1:9999")
     report = run_doctor(list(pw.internals.parse_graph.G.outputs))
     assert not report.by_rule("tenant-fairness")
+
+
+# --- router WFQ dispatch window --------------------------------------------
+
+
+def test_router_wfq_dispatch_orders_by_virtual_finish():
+    """With the window full, a cold tenant's first request releases
+    ahead of the hot tenant's queued backlog (WFQ tag order, not FIFO)."""
+    import asyncio
+
+    from pathway_tpu.serving.router import _WfqDispatch
+
+    async def scenario():
+        ledger = TenantLedger(_config(), route="router")
+        disp = _WfqDispatch(ledger, width=1)
+        order: list[str] = []
+
+        # occupy the single slot
+        await disp.acquire("hot", None)
+
+        async def routed(tenant):
+            await disp.acquire(tenant, None)
+            order.append(tenant)
+            disp.release()
+
+        # hot tenant queues three more, THEN a cold tenant arrives
+        tasks = [asyncio.ensure_future(routed("hot")) for _ in range(3)]
+        await asyncio.sleep(0)  # let the hot backlog enqueue first
+        tasks.append(asyncio.ensure_future(routed("cold")))
+        await asyncio.sleep(0)
+        assert disp.queued == 4
+        disp.release()  # free the occupied slot
+        await asyncio.gather(*tasks)
+        return order
+
+    order = asyncio.run(scenario())
+    # cold's first virtual-finish tag ties hot's SECOND (seq breaks the
+    # tie) and sorts strictly below hot's third and fourth: FIFO would
+    # have released [hot, hot, hot, cold]
+    assert order == ["hot", "cold", "hot", "hot"]
+
+
+def test_router_wfq_dispatch_width_bounds_inflight():
+    import asyncio
+
+    from pathway_tpu.serving.router import _WfqDispatch
+
+    async def scenario():
+        ledger = TenantLedger(_config(), route="router")
+        disp = _WfqDispatch(ledger, width=2)
+        t1, w1 = await disp.acquire("a", None)
+        t2, w2 = await disp.acquire("b", None)
+        assert not w1 and not w2
+        third = asyncio.ensure_future(disp.acquire("c", None))
+        await asyncio.sleep(0)
+        assert not third.done() and disp.queued == 1
+        disp.release()
+        _t3, w3 = await third
+        assert w3
+        return True
+
+    assert asyncio.run(scenario())
